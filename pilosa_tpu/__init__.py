@@ -1,0 +1,24 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A from-scratch rebuild of the capabilities of Pilosa (the reference
+distributed bitmap index, see SURVEY.md): a sharded boolean-matrix index
+queried through PQL, executed as XLA computations over packed-word bitmaps
+staged in TPU HBM rather than Go loops over roaring containers.
+
+Layering (mirrors SURVEY.md §1):
+  L0 roaring/   — CPU source-of-truth bitmap engine + reference file format
+  L0 ops/       — packed-word XLA/Pallas kernels (the TPU data plane)
+  L1 core/      — holder → index → field → view → fragment storage tree
+  L2 core/row   — cross-shard query-result rows
+  L3 pql/       — PQL parser/AST
+  L4 executor/  — PQL call tree → per-shard kernels + map/reduce
+  L5 parallel/  — shard placement, device mesh, cluster, replication
+  L6/7 server/  — programmatic API + HTTP + server runtime
+  L8 cli/       — command line
+"""
+
+__version__ = "0.1.0"
+
+# Width of a single shard in columns (bits). Matches the reference's
+# compile-time constant (reference fragment.go:47-48).
+SHARD_WIDTH = 1 << 20

@@ -1,0 +1,33 @@
+"""CPU roaring-bitmap engine + reference file-format compatibility (L0)."""
+
+from .bitmap import (
+    ARRAY_MAX_SIZE,
+    BITMAP_N,
+    CONTAINER_ARRAY,
+    CONTAINER_BITMAP,
+    CONTAINER_RUN,
+    Bitmap,
+    Container,
+    highbits,
+    lowbits,
+    marshal_op,
+    positions_to_words,
+    unmarshal_op,
+    words_to_positions,
+)
+
+__all__ = [
+    "ARRAY_MAX_SIZE",
+    "BITMAP_N",
+    "CONTAINER_ARRAY",
+    "CONTAINER_BITMAP",
+    "CONTAINER_RUN",
+    "Bitmap",
+    "Container",
+    "highbits",
+    "lowbits",
+    "marshal_op",
+    "positions_to_words",
+    "unmarshal_op",
+    "words_to_positions",
+]

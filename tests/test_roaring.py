@@ -1,0 +1,187 @@
+"""Roaring bitmap engine tests — property tests against a Python-set oracle
+plus serialization round-trips (mirrors the reference's
+roaring/roaring_internal_test.go strategy)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.roaring import (
+    ARRAY_MAX_SIZE,
+    Bitmap,
+    Container,
+    marshal_op,
+    unmarshal_op,
+)
+
+
+def make_cases(seed=7):
+    rng = np.random.default_rng(seed)
+    cases = []
+    # array-form: sparse small values
+    cases.append(sorted(rng.choice(1 << 18, size=500, replace=False).tolist()))
+    # bitmap-form: dense in one container
+    cases.append(sorted(rng.choice(1 << 16, size=8000, replace=False).tolist()))
+    # run-form: contiguous ranges
+    runs = []
+    for start in (0, 70000, 1 << 20):
+        runs.extend(range(start, start + 3000))
+    cases.append(runs)
+    # spanning many containers + large positions
+    cases.append(
+        sorted(
+            set(
+                rng.choice(1 << 30, size=2000, replace=False).tolist()
+                + [2**40, 2**40 + 1, 2**50]
+            )
+        )
+    )
+    cases.append([])
+    return cases
+
+
+CASES = make_cases()
+
+
+def mk(vals):
+    b = Bitmap.from_sorted(np.array(sorted(vals), dtype=np.uint64))
+    return b
+
+
+@pytest.mark.parametrize("i", range(len(CASES)))
+@pytest.mark.parametrize("j", range(len(CASES)))
+def test_set_algebra_matches_python_sets(i, j):
+    a_vals, b_vals = set(CASES[i]), set(CASES[j])
+    a, b = mk(a_vals), mk(b_vals)
+    assert sorted(a_vals & b_vals) == a.intersect(b).slice_all().tolist()
+    assert sorted(a_vals | b_vals) == a.union(b).slice_all().tolist()
+    assert sorted(a_vals - b_vals) == a.difference(b).slice_all().tolist()
+    assert sorted(a_vals ^ b_vals) == a.xor(b).slice_all().tolist()
+    assert len(a_vals & b_vals) == a.intersection_count(b)
+    assert len(a_vals) == a.count()
+
+
+@pytest.mark.parametrize("i", range(len(CASES)))
+def test_add_remove_contains(i):
+    vals = set(CASES[i])
+    b = Bitmap()
+    for v in CASES[i]:
+        assert b.add(v)
+        assert not b.add(v)
+    assert b.count() == len(vals)
+    for v in list(vals)[:100]:
+        assert b.contains(v)
+        assert b.remove(v)
+        assert not b.remove(v)
+        assert not b.contains(v)
+
+
+def test_count_range():
+    vals = CASES[3]
+    b = mk(vals)
+    arr = np.array(sorted(vals), dtype=np.uint64)
+    for start, end in [(0, 1 << 30), (100, 2**40 + 1), (2**40, 2**50 + 1), (5, 5)]:
+        want = int(((arr >= start) & (arr < end)).sum())
+        assert b.count_range(start, end) == want, (start, end)
+
+
+def test_slice_range():
+    b = mk(CASES[0])
+    arr = np.array(sorted(CASES[0]), dtype=np.uint64)
+    got = b.slice_range(1000, 100000)
+    want = arr[(arr >= 1000) & (arr < 100000)]
+    assert got.tolist() == want.tolist()
+
+
+@pytest.mark.parametrize("i", range(len(CASES)))
+def test_serialization_roundtrip(i):
+    b = mk(CASES[i])
+    data = b.to_bytes()
+    b2 = Bitmap.unmarshal_binary(data)
+    assert b.slice_all().tolist() == b2.slice_all().tolist()
+    # serialize again: stable
+    assert b2.to_bytes() == data
+
+
+def test_serialization_with_oplog():
+    b = mk(CASES[0])
+    data = b.to_bytes()
+    # Simulate an op log appended after the snapshot.
+    extra = marshal_op(0, 12345678) + marshal_op(1, CASES[0][0]) + marshal_op(0, 7)
+    b2 = Bitmap.unmarshal_binary(data + extra)
+    want = set(CASES[0]) | {12345678, 7}
+    want.discard(CASES[0][0])
+    assert b2.slice_all().tolist() == sorted(want)
+    assert b2.op_n == 3
+
+
+def test_op_marshal_roundtrip():
+    for typ, val in [(0, 0), (1, 2**63 + 11), (0, 42)]:
+        assert unmarshal_op(marshal_op(typ, val)) == (typ, val)
+    with pytest.raises(ValueError):
+        unmarshal_op(b"\x00" * 13)
+
+
+def test_offset_range():
+    # bits in shard-1 positions, offset to absolute column space
+    vals = [2**20 + 5, 2**20 + 99, 2**20 + 65536]
+    b = mk(vals)
+    out = b.offset_range(3 * 2**20, 2**20, 2 * 2**20)
+    assert out.slice_all().tolist() == [3 * 2**20 + 5, 3 * 2**20 + 99, 3 * 2**20 + 65536]
+
+
+def test_words_range_roundtrip():
+    vals = CASES[1]
+    b = mk(vals)
+    words = b.to_words_range(0, 1 << 20)
+    assert int(np.bitwise_count(words).sum()) == len(set(vals))
+    b2 = Bitmap.from_words_range(words)
+    assert b2.slice_all().tolist() == sorted(set(vals))
+
+
+def test_container_form_transitions():
+    c = Container()
+    # array -> bitmap when exceeding ARRAY_MAX_SIZE
+    for v in range(ARRAY_MAX_SIZE + 1):
+        c.add(v)
+    assert c.typ == 2  # bitmap
+    assert c.n == ARRAY_MAX_SIZE + 1
+    # optimize to run form (fully contiguous)
+    c.optimize()
+    assert c.typ == 3  # run
+    assert c.n == ARRAY_MAX_SIZE + 1
+    assert c.contains(17)
+    assert not c.contains(ARRAY_MAX_SIZE + 1)
+
+
+def test_flip():
+    b = mk([1, 3, 5])
+    f = b.flip(0, 6)
+    assert f.slice_all().tolist() == [0, 2, 4, 6]
+
+
+def test_bulk_from_sorted_dense():
+    vals = np.arange(0, 300000, 2, dtype=np.uint64)
+    b = Bitmap.from_sorted(vals)
+    assert b.count() == vals.size
+    assert b.slice_all().tolist() == vals.tolist()
+
+
+REFERENCE_FIXTURE = "/root/reference/testdata/sample_view/0"
+
+
+def test_parse_reference_fixture():
+    """Ingest a roaring file produced by the reference Go implementation."""
+    import os
+
+    if not os.path.exists(REFERENCE_FIXTURE):
+        pytest.skip("reference fixture unavailable")
+    with open(REFERENCE_FIXTURE, "rb") as f:
+        b = Bitmap.unmarshal_binary(f.read())
+    assert b.count() == 35001
+    assert len(b.containers) == 14207
+    a = b.slice_all()
+    assert int(a[0]) == 32966 and int(a[-1]) == 1048560182
+    b2 = Bitmap.unmarshal_binary(b.to_bytes())
+    assert a.tolist() == b2.slice_all().tolist()
