@@ -1,0 +1,24 @@
+"""Storage tree (L1/L2): holder → index → field → view → fragment; Row."""
+
+from pilosa_tpu.core.fragment import Fragment, TopOptions, pos
+from pilosa_tpu.core.field import BSIGroup, Field, FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import Index
+from pilosa_tpu.core.row import Row, union_rows
+from pilosa_tpu.core.view import VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD, View
+
+__all__ = [
+    "BSIGroup",
+    "Field",
+    "FieldOptions",
+    "Fragment",
+    "Holder",
+    "Index",
+    "Row",
+    "TopOptions",
+    "VIEW_BSI_GROUP_PREFIX",
+    "VIEW_STANDARD",
+    "View",
+    "pos",
+    "union_rows",
+]

@@ -1,0 +1,192 @@
+"""TopN row-count caches (reference cache.go).
+
+The rank cache bounds which rows are *eligible* TopN candidates — its
+threshold/trim behavior is part of the reference's observable TopN
+semantics, so it is reproduced here exactly (thresholdFactor 1.1,
+maxEntries trim, count-descending ranking, 10s invalidation debounce).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import OrderedDict
+from typing import Optional
+
+# reference cache.go:29-31
+THRESHOLD_FACTOR = 1.1
+# reference field.go:38-44
+CACHE_TYPE_LRU = "lru"
+CACHE_TYPE_RANKED = "ranked"
+CACHE_TYPE_NONE = "none"
+DEFAULT_CACHE_SIZE = 50000
+
+# reference rankCache.invalidate's hard-coded debounce (cache.go:233-241)
+INVALIDATE_DEBOUNCE_SECONDS = 10.0
+
+
+def sort_pairs(pairs: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    """Count-descending, id-ascending tiebreak.
+
+    The reference uses Go's unstable sort with count-only comparison
+    (cache.go:342); ties are therefore unspecified there — we pin them
+    to ascending id for determinism.
+    """
+    return sorted(pairs, key=lambda p: (-p[1], p[0]))
+
+
+class RankCache:
+    """Sorted top-K cache (reference rankCache, cache.go:136-286)."""
+
+    def __init__(self, max_entries: int) -> None:
+        self.max_entries = max_entries
+        self.threshold_buffer = int(THRESHOLD_FACTOR * max_entries)
+        self.entries: dict[int, int] = {}
+        self.rankings: list[tuple[int, int]] = []
+        self.threshold_value = 0
+        self._update_time = 0.0
+
+    def add(self, id_: int, n: int) -> None:
+        if n < self.threshold_value:
+            return
+        self.entries[id_] = n
+        self.invalidate()
+
+    def bulk_add(self, id_: int, n: int) -> None:
+        if n < self.threshold_value:
+            return
+        self.entries[id_] = n
+
+    def get(self, id_: int) -> int:
+        return self.entries.get(id_, 0)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def ids(self) -> list[int]:
+        return sorted(self.entries)
+
+    def invalidate(self) -> None:
+        if time.monotonic() - self._update_time < INVALIDATE_DEBOUNCE_SECONDS:
+            return
+        self.recalculate()
+
+    def recalculate(self) -> None:
+        rankings = sort_pairs(list(self.entries.items()))
+        remove_items: list[tuple[int, int]] = []
+        if len(rankings) > self.max_entries:
+            self.threshold_value = rankings[self.max_entries][1]
+            remove_items = rankings[self.max_entries :]
+            rankings = rankings[: self.max_entries]
+        else:
+            self.threshold_value = 1
+        self.rankings = rankings
+        self._update_time = time.monotonic()
+        if len(self.entries) > self.threshold_buffer:
+            for id_, _ in remove_items:
+                self.entries.pop(id_, None)
+
+    def top(self) -> list[tuple[int, int]]:
+        return self.rankings
+
+    def clear(self) -> None:
+        self.entries.clear()
+        self.rankings = []
+        self.threshold_value = 0
+        self._update_time = 0.0
+
+
+class LRUCache:
+    """LRU row-count cache (reference lruCache over lru/lru.go)."""
+
+    def __init__(self, max_entries: int) -> None:
+        self.max_entries = max_entries
+        self._lru: OrderedDict[int, int] = OrderedDict()
+
+    def add(self, id_: int, n: int) -> None:
+        if id_ in self._lru:
+            self._lru.move_to_end(id_)
+        self._lru[id_] = n
+        if self.max_entries and len(self._lru) > self.max_entries:
+            self._lru.popitem(last=False)
+
+    bulk_add = add
+
+    def get(self, id_: int) -> int:
+        n = self._lru.get(id_)
+        if n is None:
+            return 0
+        self._lru.move_to_end(id_)
+        return n
+
+    def __len__(self) -> int:
+        return len(self._lru)
+
+    def ids(self) -> list[int]:
+        return sorted(self._lru)
+
+    def invalidate(self) -> None:
+        pass
+
+    def recalculate(self) -> None:
+        pass
+
+    def top(self) -> list[tuple[int, int]]:
+        return sort_pairs(list(self._lru.items()))
+
+    def clear(self) -> None:
+        self._lru.clear()
+
+
+class NopCache:
+    """No-op cache (cache type \"none\")."""
+
+    def add(self, id_: int, n: int) -> None:
+        pass
+
+    bulk_add = add
+
+    def get(self, id_: int) -> int:
+        return 0
+
+    def __len__(self) -> int:
+        return 0
+
+    def ids(self) -> list[int]:
+        return []
+
+    def invalidate(self) -> None:
+        pass
+
+    def recalculate(self) -> None:
+        pass
+
+    def top(self) -> list[tuple[int, int]]:
+        return []
+
+    def clear(self) -> None:
+        pass
+
+
+def new_cache(cache_type: str, cache_size: int):
+    if cache_type == CACHE_TYPE_RANKED:
+        return RankCache(cache_size)
+    if cache_type == CACHE_TYPE_LRU:
+        return LRUCache(cache_size)
+    if cache_type == CACHE_TYPE_NONE:
+        return NopCache()
+    raise ValueError(f"unknown cache type: {cache_type}")
+
+
+def write_cache(path: str, ids: list[int]) -> None:
+    """Persist cached row ids (reference .cache protobuf; we use JSON)."""
+    with open(path, "w") as f:
+        json.dump(ids, f)
+
+
+def read_cache(path: str) -> Optional[list[int]]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None

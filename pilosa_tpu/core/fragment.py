@@ -1,0 +1,645 @@
+"""Fragment — one shard of one field-view (L1).
+
+Mirrors the reference's fragment (reference fragment.go): a bitmap over
+positions ``pos = rowID * 2^20 + (columnID % 2^20)`` backed by one
+roaring file whose tail doubles as an append-only op log, snapshotted
+once the op count passes MAX_OP_N (reference fragment.go:62-64,
+1399-1468). Row materialisation is a container-level OffsetRange + clone
+(reference fragment.go:330-359).
+
+TPU integration: the fragment is the CPU source of truth; it exports
+packed-word row matrices / BSI plane stacks for HBM staging and keeps a
+``generation`` counter so the device stager can invalidate staged blocks
+on mutation (SURVEY.md §7 step 3).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import math
+import os
+import threading
+from typing import Iterable, Optional
+
+import numpy as np
+
+from pilosa_tpu import SHARD_WIDTH
+from pilosa_tpu.roaring import Bitmap
+from pilosa_tpu.core.row import Row
+from pilosa_tpu.core import cache as cache_mod
+
+# reference fragment.go:55-64
+HASH_BLOCK_SIZE = 100
+MAX_OP_N = 2000
+
+DEFAULT_MIN_THRESHOLD = 1  # reference executor.go defaultMinThreshold
+
+
+def pos(row_id: int, column_id: int) -> int:
+    """reference fragment.go:1935."""
+    return row_id * SHARD_WIDTH + (column_id % SHARD_WIDTH)
+
+
+class TopOptions:
+    """reference topOptions (fragment.go:1046-1058)."""
+
+    def __init__(
+        self,
+        n: int = 0,
+        src: Optional[Row] = None,
+        row_ids: Optional[list[int]] = None,
+        min_threshold: int = DEFAULT_MIN_THRESHOLD,
+        filter_name: str = "",
+        filter_values: Optional[list] = None,
+        tanimoto_threshold: int = 0,
+    ) -> None:
+        self.n = n
+        self.src = src
+        self.row_ids = row_ids or []
+        self.min_threshold = min_threshold
+        self.filter_name = filter_name
+        self.filter_values = filter_values or []
+        self.tanimoto_threshold = tanimoto_threshold
+
+
+class Fragment:
+    """One (index, field, view, shard) bitmap fragment."""
+
+    def __init__(
+        self,
+        path: Optional[str],
+        index: str,
+        field: str,
+        view: str,
+        shard: int,
+        cache_type: str = cache_mod.CACHE_TYPE_RANKED,
+        cache_size: int = cache_mod.DEFAULT_CACHE_SIZE,
+        row_attr_store=None,
+    ) -> None:
+        self.path = path
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+        self.cache_type = cache_type
+        self.cache = cache_mod.new_cache(cache_type, cache_size)
+        self.row_attr_store = row_attr_store
+
+        self.storage = Bitmap()
+        self.op_n = 0
+        self.max_op_n = MAX_OP_N
+        self.max_row_id = 0
+        self.generation = 0  # bumped on every mutation; device-stager key
+        self.checksums: dict[int, bytes] = {}
+        self.mu = threading.RLock()
+        self._row_cache: dict[int, Row] = {}
+        self._op_file = None
+        self._open = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self) -> None:
+        with self.mu:
+            if self._open:
+                return
+            if self.path and os.path.exists(self.path):
+                with open(self.path, "rb") as f:
+                    data = f.read()
+                if data:
+                    self.storage = Bitmap.unmarshal_binary(data)
+                    self.op_n = self.storage.op_n
+            if self.path and not os.path.exists(self.path):
+                # Initialise new files with an empty snapshot header so the
+                # trailing op log always follows a valid roaring prefix
+                # (reference openStorage, fragment.go:167-224).
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                with open(self.path, "wb") as f:
+                    self.storage.write_to(f)
+            if self.path:
+                self._op_file = open(self.path, "ab")
+                self.storage.op_writer = self._op_file
+            self._recompute_max_row_id()
+            self._open_cache()
+            self._open = True
+
+    def close(self) -> None:
+        with self.mu:
+            if self._op_file:
+                self.flush_cache()
+                self._op_file.close()
+                self._op_file = None
+                self.storage.op_writer = None
+            self._open = False
+
+    def _recompute_max_row_id(self) -> None:
+        keys = self.storage.sorted_keys()
+        self.max_row_id = (keys[-1] << 16) // SHARD_WIDTH if keys else 0
+
+    def cache_path(self) -> Optional[str]:
+        return self.path + ".cache" if self.path else None
+
+    def _open_cache(self) -> None:
+        """Restore cached row ids with a recount (reference openCache,
+        fragment.go:227-266)."""
+        p = self.cache_path()
+        if not p:
+            return
+        ids = cache_mod.read_cache(p)
+        if not ids:
+            return
+        for row_id in ids:
+            self.cache.bulk_add(row_id, self.row(row_id).count())
+        self.cache.invalidate()
+
+    def flush_cache(self) -> None:
+        p = self.cache_path()
+        if p:
+            cache_mod.write_cache(p, self.cache.ids())
+
+    # -- row materialisation -------------------------------------------------
+
+    def row(self, row_id: int) -> Row:
+        with self.mu:
+            return self._unprotected_row(row_id)
+
+    def _unprotected_row(self, row_id: int, update_cache: bool = True) -> Row:
+        r = self._row_cache.get(row_id)
+        if r is not None:
+            return r
+        data = self.storage.offset_range(
+            self.shard * SHARD_WIDTH, row_id * SHARD_WIDTH, (row_id + 1) * SHARD_WIDTH
+        ).clone()
+        r = Row.from_segment(self.shard, data)
+        if update_cache:
+            self._row_cache[row_id] = r
+        return r
+
+    def row_ids(self) -> list[int]:
+        """All rows with at least one bit (container key >> 4 = row id,
+        since 2^20/2^16 = 16 containers per row)."""
+        return sorted({(k << 16) // SHARD_WIDTH for k in self.storage.containers})
+
+    # -- bit ops -------------------------------------------------------------
+
+    def set_bit(self, row_id: int, column_id: int) -> bool:
+        with self.mu:
+            return self._unprotected_set_bit(row_id, column_id)
+
+    def _check_pos(self, row_id: int, column_id: int) -> int:
+        min_col = self.shard * SHARD_WIDTH
+        if not (min_col <= column_id < min_col + SHARD_WIDTH):
+            raise ValueError("column out of bounds")
+        return pos(row_id, column_id)
+
+    def _unprotected_set_bit(self, row_id: int, column_id: int) -> bool:
+        p = self._check_pos(row_id, column_id)
+        if not self.storage.add(p):
+            return False
+        self.generation += 1
+        self.checksums.pop(row_id // HASH_BLOCK_SIZE, None)
+        self._increment_op_n()
+        row = self._unprotected_row(row_id)
+        row.set_bit(column_id)
+        self.cache.add(row_id, row.count())
+        if row_id > self.max_row_id:
+            self.max_row_id = row_id
+        return True
+
+    def clear_bit(self, row_id: int, column_id: int) -> bool:
+        with self.mu:
+            return self._unprotected_clear_bit(row_id, column_id)
+
+    def _unprotected_clear_bit(self, row_id: int, column_id: int) -> bool:
+        p = self._check_pos(row_id, column_id)
+        if not self.storage.remove(p):
+            return False
+        self.generation += 1
+        self.checksums.pop(row_id // HASH_BLOCK_SIZE, None)
+        self._increment_op_n()
+        row = self._unprotected_row(row_id)
+        row.clear_bit(column_id)
+        self.cache.add(row_id, row.count())
+        return True
+
+    def bit(self, row_id: int, column_id: int) -> bool:
+        return self.storage.contains(self._check_pos(row_id, column_id))
+
+    def _increment_op_n(self) -> None:
+        self.op_n += 1
+        if self.op_n > self.max_op_n:
+            self.snapshot()
+
+    # -- BSI value ops (reference fragment.go:467-836) -----------------------
+
+    def value(self, column_id: int, bit_depth: int) -> tuple[int, bool]:
+        with self.mu:
+            if not self.bit(bit_depth, column_id):
+                return 0, False
+            v = 0
+            for i in range(bit_depth):
+                if self.bit(i, column_id):
+                    v |= 1 << i
+            return v, True
+
+    def set_value(self, column_id: int, bit_depth: int, value: int) -> bool:
+        with self.mu:
+            changed = False
+            for i in range(bit_depth):
+                if (value >> i) & 1:
+                    changed |= self._unprotected_set_bit(i, column_id)
+                else:
+                    changed |= self._unprotected_clear_bit(i, column_id)
+            changed |= self._unprotected_set_bit(bit_depth, column_id)
+            return changed
+
+    def sum(self, filter_row: Optional[Row], bit_depth: int) -> tuple[int, int]:
+        row = self.row(bit_depth)
+        count = row.intersection_count(filter_row) if filter_row is not None else row.count()
+        total = 0
+        for i in range(bit_depth):
+            r = self.row(i)
+            cnt = r.intersection_count(filter_row) if filter_row is not None else r.count()
+            total += (1 << i) * cnt
+        return total, count
+
+    def min(self, filter_row: Optional[Row], bit_depth: int) -> tuple[int, int]:
+        consider = self.row(bit_depth)
+        if filter_row is not None:
+            consider = consider.intersect(filter_row)
+        if consider.count() == 0:
+            return 0, 0
+        vmin = 0
+        count = 0
+        for ii in reversed(range(bit_depth)):
+            row = self.row(ii)
+            x = consider.difference(row)
+            count = x.count()
+            if count > 0:
+                consider = x
+            else:
+                vmin += 1 << ii
+                if ii == 0:
+                    count = consider.count()
+        return vmin, count
+
+    def max(self, filter_row: Optional[Row], bit_depth: int) -> tuple[int, int]:
+        consider = self.row(bit_depth)
+        if filter_row is not None:
+            consider = consider.intersect(filter_row)
+        if consider.count() == 0:
+            return 0, 0
+        vmax = 0
+        count = 0
+        for ii in reversed(range(bit_depth)):
+            row = self.row(ii)
+            x = row.intersect(consider)
+            count = x.count()
+            if count > 0:
+                vmax += 1 << ii
+                consider = x
+            elif ii == 0:
+                count = consider.count()
+        return vmax, count
+
+    def range_op(self, op: str, bit_depth: int, predicate: int) -> Row:
+        if op == "==":
+            return self.range_eq(bit_depth, predicate)
+        if op == "!=":
+            return self.range_neq(bit_depth, predicate)
+        if op in ("<", "<="):
+            return self.range_lt(bit_depth, predicate, op == "<=")
+        if op in (">", ">="):
+            return self.range_gt(bit_depth, predicate, op == ">=")
+        raise ValueError(f"invalid range operation: {op}")
+
+    def range_eq(self, bit_depth: int, predicate: int) -> Row:
+        b = self.row(bit_depth)
+        for i in reversed(range(bit_depth)):
+            row = self.row(i)
+            if (predicate >> i) & 1:
+                b = b.intersect(row)
+            else:
+                b = b.difference(row)
+        return b
+
+    def range_neq(self, bit_depth: int, predicate: int) -> Row:
+        return self.row(bit_depth).difference(self.range_eq(bit_depth, predicate))
+
+    def range_lt(self, bit_depth: int, predicate: int, allow_equality: bool) -> Row:
+        keep = Row()
+        b = self.row(bit_depth)
+        leading_zeros = True
+        for i in reversed(range(bit_depth)):
+            row = self.row(i)
+            bit = (predicate >> i) & 1
+            if leading_zeros:
+                if bit == 0:
+                    b = b.difference(row)
+                    continue
+                leading_zeros = False
+            if i == 0 and not allow_equality:
+                if bit == 0:
+                    return keep
+                return b.difference(row.difference(keep))
+            if bit == 0:
+                b = b.difference(row.difference(keep))
+                continue
+            if i > 0:
+                keep = keep.union(b.difference(row))
+        return b
+
+    def range_gt(self, bit_depth: int, predicate: int, allow_equality: bool) -> Row:
+        b = self.row(bit_depth)
+        keep = Row()
+        for i in reversed(range(bit_depth)):
+            row = self.row(i)
+            bit = (predicate >> i) & 1
+            if i == 0 and not allow_equality:
+                if bit == 1:
+                    return keep
+                return b.difference(b.difference(row).difference(keep))
+            if bit == 1:
+                b = b.difference(b.difference(row).difference(keep))
+                continue
+            if i > 0:
+                keep = keep.union(b.intersect(row))
+        return b
+
+    def not_null(self, bit_depth: int) -> Row:
+        return self.row(bit_depth)
+
+    def range_between(self, bit_depth: int, pred_min: int, pred_max: int) -> Row:
+        b = self.row(bit_depth)
+        keep1 = Row()
+        keep2 = Row()
+        for i in reversed(range(bit_depth)):
+            row = self.row(i)
+            bit1 = (pred_min >> i) & 1
+            bit2 = (pred_max >> i) & 1
+            if bit1 == 1:
+                b = b.difference(b.difference(row).difference(keep1))
+            elif i > 0:
+                keep1 = keep1.union(b.intersect(row))
+            if bit2 == 0:
+                b = b.difference(row.difference(keep2))
+            elif i > 0:
+                keep2 = keep2.union(b.difference(row))
+        return b
+
+    # -- TopN (reference fragment.top:867-1002) ------------------------------
+
+    def top(self, opt: TopOptions) -> list[tuple[int, int]]:
+        """Returns [(row_id, count)] ranked descending, reproducing the
+        reference's ranked-cache + threshold-pruning walk."""
+        pairs = self._top_bitmap_pairs(opt.row_ids)
+        n = 0 if opt.row_ids else opt.n
+
+        filters = None
+        if opt.filter_name and opt.filter_values:
+            filters = set()
+            for v in opt.filter_values:
+                filters.add(v if not isinstance(v, list) else tuple(v))
+
+        tanimoto_threshold = 0
+        min_tanimoto = max_tanimoto = 0.0
+        src_count = 0
+        if opt.tanimoto_threshold > 0 and opt.src is not None:
+            tanimoto_threshold = opt.tanimoto_threshold
+            src_count = opt.src.count()
+            min_tanimoto = float(src_count * tanimoto_threshold) / 100
+            max_tanimoto = float(src_count * 100) / float(tanimoto_threshold)
+
+        results: list[tuple[int, int]] = []  # min-heap of (count, row_id)
+        for row_id, cnt in pairs:
+            if cnt <= 0:
+                continue
+            if tanimoto_threshold > 0:
+                if float(cnt) <= min_tanimoto or float(cnt) >= max_tanimoto:
+                    continue
+            elif cnt < opt.min_threshold:
+                continue
+            if filters is not None:
+                attr = (
+                    self.row_attr_store.attrs(row_id) if self.row_attr_store else None
+                )
+                if not attr:
+                    continue
+                value = attr.get(opt.filter_name)
+                if value is None or value not in filters:
+                    continue
+
+            if n == 0 or len(results) < n:
+                count = cnt
+                if opt.src is not None:
+                    count = opt.src.intersection_count(self.row(row_id))
+                if count == 0:
+                    continue
+                if tanimoto_threshold > 0:
+                    tanimoto = math.ceil(
+                        float(count * 100) / float(cnt + src_count - count)
+                    )
+                    if tanimoto <= float(tanimoto_threshold):
+                        continue
+                elif count < opt.min_threshold:
+                    continue
+                heapq.heappush(results, (count, row_id))
+                if n > 0 and len(results) == n and opt.src is None:
+                    break
+                continue
+
+            threshold = results[0][0]
+            if threshold < opt.min_threshold or cnt < threshold:
+                break
+            count = opt.src.intersection_count(self.row(row_id))
+            if count < threshold:
+                continue
+            heapq.heappush(results, (count, row_id))
+
+        out = []
+        while results:
+            count, row_id = heapq.heappop(results)
+            out.append((row_id, count))
+        out.reverse()
+        return out
+
+    def _top_bitmap_pairs(self, row_ids: list[int]) -> list[tuple[int, int]]:
+        """reference topBitmapPairs (fragment.go:1004-1044)."""
+        if self.cache_type == cache_mod.CACHE_TYPE_NONE:
+            return self.cache.top()
+        if not row_ids:
+            with self.mu:
+                self.cache.invalidate()
+                return self.cache.top()
+        pairs = []
+        for row_id in row_ids:
+            n = self.cache.get(row_id)
+            if n > 0:
+                pairs.append((row_id, n))
+                continue
+            row = self.row(row_id)
+            if row.count() > 0:
+                pairs.append((row_id, row.count()))
+        return cache_mod.sort_pairs(pairs)
+
+    # -- bulk import (reference bulkImport:1296-1397) ------------------------
+
+    def bulk_import(self, row_ids: Iterable[int], column_ids: Iterable[int]) -> None:
+        """Vectorised set of many bits, bypassing the op log, then snapshot.
+
+        The reference loops storage.Add per bit; we merge a bulk-built
+        bitmap (union of sorted positions) — same result, orders of
+        magnitude faster in Python, and the post-import snapshot persists
+        identically.
+        """
+        rows = np.asarray(list(row_ids), dtype=np.uint64)
+        cols = np.asarray(list(column_ids), dtype=np.uint64)
+        if rows.size != cols.size:
+            raise ValueError("row/column id mismatch")
+        if rows.size == 0:
+            return
+        with self.mu:
+            positions = rows * np.uint64(SHARD_WIDTH) + (
+                cols % np.uint64(SHARD_WIDTH)
+            )
+            positions = np.unique(positions)
+            add = Bitmap.from_sorted(positions)
+            op_writer = self.storage.op_writer
+            merged = self.storage.union(add)
+            merged.op_writer = op_writer
+            self.storage = merged
+            self.generation += 1
+            self._row_cache.clear()
+            self.checksums.clear()
+            touched = sorted(set((int(r) for r in rows)))
+            for row_id in touched:
+                self.cache.bulk_add(row_id, self._unprotected_row(row_id).count())
+                if row_id > self.max_row_id:
+                    self.max_row_id = row_id
+            self.cache.invalidate()
+            self.snapshot()
+
+    def import_value(
+        self, column_ids: Iterable[int], values: Iterable[int], bit_depth: int
+    ) -> None:
+        """Bulk BSI import (reference importValue:1363-1397)."""
+        cols = list(column_ids)
+        vals = list(values)
+        if len(cols) != len(vals):
+            raise ValueError("column/value mismatch")
+        with self.mu:
+            for c, v in zip(cols, vals):
+                for i in range(bit_depth):
+                    p = self._check_pos(i, c)
+                    if (v >> i) & 1:
+                        self.storage.add_no_oplog(p)
+                    else:
+                        self.storage.remove_no_oplog(p)
+                self.storage.add_no_oplog(self._check_pos(bit_depth, c))
+            self.generation += 1
+            self._row_cache.clear()
+            self.checksums.clear()
+            self._recompute_max_row_id()
+            self.snapshot()
+
+    # -- snapshot / persistence ---------------------------------------------
+
+    def snapshot(self) -> None:
+        """Write a full roaring snapshot and truncate the op log
+        (reference snapshot:1425-1468)."""
+        with self.mu:
+            self.generation += 1
+            if not self.path:
+                self.op_n = 0
+                self.storage.op_n = 0
+                return
+            if self._op_file:
+                self._op_file.close()
+                self._op_file = None
+            tmp = self.path + ".snapshotting"
+            with open(tmp, "wb") as f:
+                self.storage.write_to(f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            self._op_file = open(self.path, "ab")
+            self.storage.op_writer = self._op_file
+            self.op_n = 0
+            self.storage.op_n = 0
+
+    # -- block checksums for anti-entropy (reference Blocks:1078) ------------
+
+    def checksum(self) -> bytes:
+        """Checksum of the entire fragment."""
+        h = hashlib.blake2b(digest_size=16)
+        for _, digest in self.blocks():
+            h.update(digest)
+        return h.digest()
+
+    def blocks(self) -> list[tuple[int, bytes]]:
+        """(block_id, checksum) for each 100-row block with any bits."""
+        out: dict[int, "hashlib._Hash"] = {}
+        order: list[int] = []
+        for key in self.storage.sorted_keys():
+            c = self.storage.containers[key]
+            if not c.n:
+                continue
+            row_id = (key << 16) // SHARD_WIDTH
+            block = row_id // HASH_BLOCK_SIZE
+            h = out.get(block)
+            if h is None:
+                h = hashlib.blake2b(digest_size=16)
+                out[block] = h
+                order.append(block)
+            h.update(key.to_bytes(8, "little"))
+            h.update(c.positions().tobytes())
+        return [(b, out[b].digest()) for b in order]
+
+    def block_data(self, block_id: int) -> tuple[np.ndarray, np.ndarray]:
+        """(row_ids, column_ids) pairs for one block (reference
+        fragment.rowColumnPairs path used by BlockData)."""
+        start = block_id * HASH_BLOCK_SIZE * SHARD_WIDTH
+        end = (block_id + 1) * HASH_BLOCK_SIZE * SHARD_WIDTH
+        positions = self.storage.slice_range(start, end)
+        rows = positions // np.uint64(SHARD_WIDTH)
+        cols = positions % np.uint64(SHARD_WIDTH)
+        return rows, cols
+
+    def import_block_pairs(self, rows: np.ndarray, cols: np.ndarray, clear_rows=None, clear_cols=None) -> None:
+        """Apply an anti-entropy block merge: set the given pairs, clear others."""
+        with self.mu:
+            if clear_rows is not None and len(clear_rows):
+                for r, c in zip(clear_rows, clear_cols):
+                    p = pos(int(r), int(c))
+                    self.storage.remove_no_oplog(p)
+            for r, c in zip(rows, cols):
+                self.storage.add_no_oplog(pos(int(r), int(c)))
+            self.generation += 1
+            self._row_cache.clear()
+            self.checksums.clear()
+            self._recompute_max_row_id()
+
+    # -- packed-word export for device staging -------------------------------
+
+    def row_words(self, row_id: int) -> np.ndarray:
+        """One row as packed uint64[16384] (2^20 bits)."""
+        return self.storage.to_words_range(
+            row_id * SHARD_WIDTH, (row_id + 1) * SHARD_WIDTH
+        )
+
+    def packed_rows(self, row_ids: list[int]) -> np.ndarray:
+        """Stack of rows: uint64[len(row_ids), 16384]."""
+        out = np.zeros((len(row_ids), SHARD_WIDTH // 64), dtype=np.uint64)
+        for i, r in enumerate(row_ids):
+            out[i] = self.row_words(r)
+        return out
+
+    def row_matrix(self) -> tuple[list[int], np.ndarray]:
+        """(row_ids, uint64[R, 16384]) for all non-empty rows — the HBM
+        staging block for whole-fragment scans (TopN)."""
+        ids = self.row_ids()
+        return ids, self.packed_rows(ids)
+
+    def bsi_planes(self, bit_depth: int) -> np.ndarray:
+        """uint64[bit_depth+1, 16384] plane stack (plane bit_depth = not-null)."""
+        return self.packed_rows(list(range(bit_depth + 1)))

@@ -1,0 +1,341 @@
+"""Storage-tree tests (fragment/view/field/index/holder) — mirrors the
+scenarios of the reference's fragment_internal_test.go / field_internal_test.go."""
+
+import os
+from datetime import datetime
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SHARD_WIDTH
+from pilosa_tpu.core import (
+    Field,
+    FieldOptions,
+    Fragment,
+    Holder,
+    Row,
+    TopOptions,
+    VIEW_STANDARD,
+)
+from pilosa_tpu.core.field import FIELD_TYPE_INT, FIELD_TYPE_SET, FIELD_TYPE_TIME
+
+
+def mem_fragment(shard=0, **kw):
+    f = Fragment(None, "i", "f", VIEW_STANDARD, shard, **kw)
+    f.open()
+    return f
+
+
+class TestFragment:
+    def test_set_clear_bit(self):
+        f = mem_fragment()
+        assert f.set_bit(120, 1)
+        assert f.set_bit(120, 6)
+        assert not f.set_bit(120, 1)
+        assert f.row(120).columns().tolist() == [1, 6]
+        assert f.clear_bit(120, 1)
+        assert not f.clear_bit(120, 1)
+        assert f.row(120).columns().tolist() == [6]
+        assert f.bit(120, 6) and not f.bit(120, 1)
+
+    def test_shard_offset_rows(self):
+        f = mem_fragment(shard=2)
+        col = 2 * SHARD_WIDTH + 7
+        assert f.set_bit(5, col)
+        assert f.row(5).columns().tolist() == [col]
+        with pytest.raises(ValueError):
+            f.set_bit(5, 3)  # column outside shard
+
+    def test_max_row_id(self):
+        f = mem_fragment()
+        f.set_bit(100, 0)
+        f.set_bit(3, 1)
+        assert f.max_row_id == 100
+
+    def test_value_roundtrip(self):
+        f = mem_fragment()
+        assert f.set_value(100, 16, 3829)
+        assert f.value(100, 16) == (3829, True)
+        assert f.value(101, 16) == (0, False)
+        # overwrite
+        f.set_value(100, 16, 121)
+        assert f.value(100, 16) == (121, True)
+
+    def test_sum_min_max(self):
+        f = mem_fragment()
+        vals = {10: 7, 20: 3, 30: 9, 40: 9, 50: 0}
+        for col, v in vals.items():
+            f.set_value(col, 8, v)
+        s, c = f.sum(None, 8)
+        assert (s, c) == (sum(vals.values()), len(vals))
+        mn, cn = f.min(None, 8)
+        assert (mn, cn) == (0, 1)
+        mx, cx = f.max(None, 8)
+        assert (mx, cx) == (9, 2)
+        filt = Row(10, 20, 30)
+        s, c = f.sum(filt, 8)
+        assert (s, c) == (19, 3)
+        mn, cn = f.min(filt, 8)
+        assert (mn, cn) == (3, 1)
+        mx, cx = f.max(filt, 8)
+        assert (mx, cx) == (9, 1)
+
+    @pytest.mark.parametrize("op,pred,want", [
+        ("==", 7, {10}),
+        ("!=", 9, {10, 20, 50}),
+        ("<", 9, {10, 20, 50}),
+        ("<=", 9, {10, 20, 30, 40, 50}),
+        (">", 3, {10, 30, 40}),
+        (">=", 7, {10, 30, 40}),
+    ])
+    def test_range_ops(self, op, pred, want):
+        f = mem_fragment()
+        for col, v in {10: 7, 20: 3, 30: 9, 40: 9, 50: 0}.items():
+            f.set_value(col, 8, v)
+        got = set(f.range_op(op, 8, pred).columns().tolist())
+        assert got == want
+
+    def test_range_between(self):
+        f = mem_fragment()
+        for col, v in {10: 7, 20: 3, 30: 9, 40: 9, 50: 0}.items():
+            f.set_value(col, 8, v)
+        assert set(f.range_between(8, 3, 7).columns().tolist()) == {10, 20}
+        assert set(f.range_between(8, 0, 9).columns().tolist()) == {10, 20, 30, 40, 50}
+
+    def test_top_basic(self):
+        f = mem_fragment()
+        for col in range(10):
+            f.set_bit(1, col)
+        for col in range(5):
+            f.set_bit(2, col)
+        for col in range(8):
+            f.set_bit(3, col)
+        f.cache.recalculate()
+        top = f.top(TopOptions(n=2))
+        assert top == [(1, 10), (3, 8)]
+
+    def test_top_with_src(self):
+        f = mem_fragment()
+        for col in range(10):
+            f.set_bit(1, col)
+        for col in range(0, 10, 2):
+            f.set_bit(2, col)
+        for col in range(3):
+            f.set_bit(3, col)
+        f.cache.recalculate()
+        src = f.row(2)  # cols 0,2,4,6,8
+        top = f.top(TopOptions(n=3, src=src))
+        assert top[0] == (1, 5) or top[0] == (2, 5)
+        got = dict(top)
+        assert got[1] == 5 and got[2] == 5 and got[3] == 2
+
+    def test_top_row_ids(self):
+        f = mem_fragment()
+        for col in range(10):
+            f.set_bit(1, col)
+        for col in range(5):
+            f.set_bit(2, col)
+        f.cache.recalculate()
+        top = f.top(TopOptions(n=1, row_ids=[2]))
+        assert top == [(2, 5)]
+
+    def test_bulk_import(self, tmp_path):
+        f = Fragment(str(tmp_path / "frag"), "i", "f", VIEW_STANDARD, 0)
+        f.open()
+        rows = [0, 0, 1, 2, 2, 2]
+        cols = [1, 5, 1, 0, 1, 2]
+        f.bulk_import(rows, cols)
+        assert f.row(0).columns().tolist() == [1, 5]
+        assert f.row(2).columns().tolist() == [0, 1, 2]
+        # snapshot persisted: reopen and verify
+        f.close()
+        f2 = Fragment(str(tmp_path / "frag"), "i", "f", VIEW_STANDARD, 0)
+        f2.open()
+        assert f2.row(2).columns().tolist() == [0, 1, 2]
+
+    def test_persistence_oplog_and_snapshot(self, tmp_path):
+        p = str(tmp_path / "frag")
+        f = Fragment(p, "i", "f", VIEW_STANDARD, 0)
+        f.open()
+        f.set_bit(1, 10)
+        f.set_bit(1, 20)
+        f.clear_bit(1, 10)
+        f.close()
+        # ops are in the file tail; reopen replays them
+        f2 = Fragment(p, "i", "f", VIEW_STANDARD, 0)
+        f2.open()
+        assert f2.row(1).columns().tolist() == [20]
+        # force snapshot, then more ops
+        f2.snapshot()
+        f2.set_bit(2, 30)
+        f2.close()
+        f3 = Fragment(p, "i", "f", VIEW_STANDARD, 0)
+        f3.open()
+        assert f3.row(1).columns().tolist() == [20]
+        assert f3.row(2).columns().tolist() == [30]
+
+    def test_snapshot_trigger_on_max_opn(self, tmp_path):
+        p = str(tmp_path / "frag")
+        f = Fragment(p, "i", "f", VIEW_STANDARD, 0)
+        f.open()
+        f.max_op_n = 10
+        for i in range(25):
+            f.set_bit(0, i)
+        assert f.op_n <= 10
+        f.close()
+        f2 = Fragment(p, "i", "f", VIEW_STANDARD, 0)
+        f2.open()
+        assert f2.row(0).count() == 25
+
+    def test_blocks_checksums(self):
+        f = mem_fragment()
+        f.set_bit(0, 1)
+        f.set_bit(100, 1)
+        f.set_bit(250, 1)
+        blocks = dict(f.blocks())
+        assert set(blocks) == {0, 1, 2}
+        g = mem_fragment()
+        g.set_bit(0, 1)
+        g.set_bit(100, 1)
+        g.set_bit(250, 2)
+        gb = dict(g.blocks())
+        assert gb[0] == blocks[0] and gb[1] == blocks[1] and gb[2] != blocks[2]
+
+    def test_block_data(self):
+        f = mem_fragment()
+        f.set_bit(0, 5)
+        f.set_bit(150, 7)
+        rows, cols = f.block_data(1)
+        assert rows.tolist() == [150] and cols.tolist() == [7]
+
+    def test_packed_export(self):
+        f = mem_fragment(shard=1)
+        base = SHARD_WIDTH
+        f.set_bit(3, base + 0)
+        f.set_bit(3, base + 64)
+        f.set_bit(7, base + 100)
+        ids, mat = f.row_matrix()
+        assert ids == [3, 7]
+        assert int(np.bitwise_count(mat[0]).sum()) == 2
+        assert (int(mat[0][0]) & 1) == 1
+        assert (int(mat[0][1]) & 1) == 1
+        assert int(np.bitwise_count(mat[1]).sum()) == 1
+
+
+class TestField:
+    def test_set_field_time_views(self, tmp_path):
+        f = Field(
+            str(tmp_path / "f"),
+            "i",
+            "f",
+            FieldOptions(type=FIELD_TYPE_TIME, time_quantum="YMD"),
+        )
+        f.open()
+        t = datetime(2018, 2, 3)
+        assert f.set_bit(1, 100, t)
+        assert sorted(f.views) == [
+            "standard",
+            "standard_2018",
+            "standard_201802",
+            "standard_20180203",
+        ]
+        assert f.row(1).columns().tolist() == [100]
+        # hierarchical clear
+        assert f.clear_bit(1, 100)
+        for v in f.views.values():
+            assert v.row(1).count() == 0
+
+    def test_int_field_value(self, tmp_path):
+        f = Field(
+            str(tmp_path / "f"),
+            "i",
+            "f",
+            FieldOptions(type=FIELD_TYPE_INT, min=-10, max=1000),
+        )
+        f.open()
+        assert f.set_value(1, 500)
+        assert f.value(1) == (500, True)
+        assert f.set_value(2, -10)
+        assert f.value(2) == (-10, True)
+        assert f.value(3) == (0, False)
+        with pytest.raises(ValueError):
+            f.set_value(4, 2000)
+
+    def test_import_bits_with_time(self, tmp_path):
+        f = Field(
+            str(tmp_path / "f"),
+            "i",
+            "f",
+            FieldOptions(type=FIELD_TYPE_TIME, time_quantum="YM"),
+        )
+        f.open()
+        f.import_bits(
+            [1, 1, 2],
+            [10, SHARD_WIDTH + 3, 20],
+            [datetime(2018, 1, 1), datetime(2018, 2, 1), None],
+        )
+        assert f.row(1).columns().tolist() == [10, SHARD_WIDTH + 3]
+        assert f.view("standard_201801").row(1).columns().tolist() == [10]
+        assert f.view("standard_201802").row(1).columns().tolist() == [SHARD_WIDTH + 3]
+        assert f.available_shards() == [0, 1]
+
+    def test_import_values(self, tmp_path):
+        f = Field(
+            str(tmp_path / "f"), "i", "f",
+            FieldOptions(type=FIELD_TYPE_INT, min=0, max=100),
+        )
+        f.open()
+        f.import_values([1, 2, SHARD_WIDTH + 1], [10, 20, 30])
+        assert f.value(1) == (10, True)
+        assert f.value(2) == (20, True)
+        assert f.value(SHARD_WIDTH + 1) == (30, True)
+
+
+class TestHolder:
+    def test_create_and_reopen(self, tmp_path):
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        idx = h.create_index("myidx")
+        fld = idx.create_field("myfield")
+        fld.set_bit(1, 100)
+        fld.set_bit(1, SHARD_WIDTH * 3 + 5)
+        assert idx.max_shard() == 3
+        h.close()
+
+        h2 = Holder(str(tmp_path / "data"))
+        h2.open()
+        idx2 = h2.index("myidx")
+        assert idx2 is not None
+        f2 = idx2.field("myfield")
+        assert f2.row(1).columns().tolist() == [100, SHARD_WIDTH * 3 + 5]
+        assert idx2.max_shard() == 3
+
+    def test_schema_apply(self, tmp_path):
+        h = Holder(str(tmp_path / "a"))
+        h.open()
+        idx = h.create_index("i1")
+        idx.create_field("f1", FieldOptions(type=FIELD_TYPE_INT, min=0, max=10))
+        schema = h.schema()
+
+        h2 = Holder(str(tmp_path / "b"))
+        h2.open()
+        h2.apply_schema(schema)
+        assert h2.index("i1").field("f1").options.type == FIELD_TYPE_INT
+        assert h2.schema() == schema
+
+    def test_node_id_persists(self, tmp_path):
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        nid = h.load_node_id()
+        assert h.load_node_id() == nid
+        h2 = Holder(str(tmp_path / "data"))
+        assert h2.load_node_id() == nid
+
+    def test_delete(self, tmp_path):
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        idx = h.create_index("i1")
+        idx.create_field("f1")
+        h.delete_index("i1")
+        assert h.index("i1") is None
+        assert not os.path.exists(os.path.join(str(tmp_path / "data"), "i1"))
