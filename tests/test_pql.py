@@ -1,0 +1,192 @@
+"""PQL parser tests (mirrors reference pql/parser_test.go scenarios)."""
+
+import pytest
+
+from pilosa_tpu.pql import BETWEEN, Call, Condition, ParseError, parse
+
+
+def one(q):
+    query = parse(q)
+    assert len(query.calls) == 1
+    return query.calls[0]
+
+
+class TestBasicCalls:
+    def test_row(self):
+        c = one("Row(stargazer=5)")
+        assert c.name == "Row"
+        assert c.args == {"stargazer": 5}
+        assert c.field_arg() == "stargazer"
+        assert c.uint_arg("stargazer") == (5, True)
+
+    def test_set(self):
+        c = one("Set(33, stargazer=5)")
+        assert c.name == "Set"
+        assert c.args == {"_col": 33, "stargazer": 5}
+
+    def test_set_with_timestamp(self):
+        c = one("Set(10, stargazer=1, 2017-01-02T03:04)")
+        assert c.args == {
+            "_col": 10,
+            "stargazer": 1,
+            "_timestamp": "2017-01-02T03:04",
+        }
+
+    def test_set_quoted_col(self):
+        c = one('Set("foo", stargazer=5)')
+        assert c.args["_col"] == "foo"
+
+    def test_clear(self):
+        c = one("Clear(10, stargazer=1)")
+        assert c.name == "Clear"
+        assert c.args == {"_col": 10, "stargazer": 1}
+
+    def test_nested(self):
+        c = one("Count(Intersect(Row(a=1), Row(b=2)))")
+        assert c.name == "Count"
+        assert len(c.children) == 1
+        inner = c.children[0]
+        assert inner.name == "Intersect"
+        assert [ch.name for ch in inner.children] == ["Row", "Row"]
+        assert inner.children[0].args == {"a": 1}
+        assert inner.children[1].args == {"b": 2}
+
+    def test_multiple_calls(self):
+        q = parse("Set(1, f=2)Set(3, f=4)\nCount(Row(f=2))")
+        assert [c.name for c in q.calls] == ["Set", "Set", "Count"]
+        assert q.write_call_n() == 2
+
+    def test_union_empty(self):
+        c = one("Union()")
+        assert c.name == "Union" and not c.children and not c.args
+
+
+class TestTopN:
+    def test_plain(self):
+        c = one("TopN(stargazer, n=10)")
+        assert c.args == {"_field": "stargazer", "n": 10}
+
+    def test_with_child(self):
+        c = one("TopN(stargazer, Row(language=5), n=3)")
+        assert c.args == {"_field": "stargazer", "n": 3}
+        assert c.children[0].name == "Row"
+
+    def test_with_ids_and_filters(self):
+        c = one(
+            'TopN(f, Row(other=7), n=4, ids=[5,10,15], attrName="category", attrValues=["a","b"])'
+        )
+        assert c.args["ids"] == [5, 10, 15]
+        assert c.args["attrName"] == "category"
+        assert c.args["attrValues"] == ["a", "b"]
+        assert c.uint_slice_arg("ids") == ([5, 10, 15], True)
+
+    def test_no_args(self):
+        c = one("TopN(f)")
+        assert c.args == {"_field": "f"}
+
+
+class TestRange:
+    def test_condition_ops(self):
+        for op in ("<", "<=", ">", ">=", "==", "!="):
+            c = one(f"Range(bytes {op} 1000)")
+            assert c.args == {"bytes": Condition(op, 1000)}
+            assert c.has_condition_arg()
+
+    def test_between_op(self):
+        c = one("Range(bytes >< [10, 20])")
+        assert c.args == {"bytes": Condition("><", [10, 20])}
+
+    def test_conditional_form(self):
+        # a < field < b  (see reference endConditional quirk)
+        c = one("Range(4 < bytes < 1000)")
+        assert c.args == {"bytes": Condition(BETWEEN, [5, 1000])}
+        c = one("Range(4 <= bytes < 1000)")
+        assert c.args == {"bytes": Condition(BETWEEN, [4, 1000])}
+        # reference quirk: <= on the right increments high
+        c = one("Range(4 <= bytes <= 1000)")
+        assert c.args == {"bytes": Condition(BETWEEN, [4, 1001])}
+
+    def test_neq_null(self):
+        c = one("Range(bytes != null)")
+        assert c.args == {"bytes": Condition("!=", None)}
+
+    def test_timerange(self):
+        c = one("Range(stargazer=1, 2010-01-01T00:00, 2017-03-02T03:00)")
+        assert c.args == {
+            "stargazer": 1,
+            "_start": "2010-01-01T00:00",
+            "_end": "2017-03-02T03:00",
+        }
+
+    def test_timerange_quoted(self):
+        c = one('Range(f=1, "2010-01-01T00:00", "2017-03-02T03:00")')
+        assert c.args["_start"] == "2010-01-01T00:00"
+
+
+class TestAttrs:
+    def test_set_row_attrs(self):
+        c = one('SetRowAttrs(stargazer, 10, foo="bar", baz=123, active=true, quux=null)')
+        assert c.args == {
+            "_field": "stargazer",
+            "_row": 10,
+            "foo": "bar",
+            "baz": 123,
+            "active": True,
+            "quux": None,
+        }
+
+    def test_set_column_attrs(self):
+        c = one('SetColumnAttrs(10, foo="bar", x=1.5)')
+        assert c.args == {"_col": 10, "foo": "bar", "x": 1.5}
+
+
+class TestValues:
+    def test_negative_and_float(self):
+        c = one("Range(f > -10)")
+        assert c.args == {"f": Condition(">", -10)}
+        c = one("F(x=1.25, y=-0.5)")
+        assert c.args == {"x": 1.25, "y": -0.5}
+
+    def test_bare_word_value(self):
+        c = one("F(x=hello-world)")
+        assert c.args == {"x": "hello-world"}
+
+    def test_list_value(self):
+        c = one("F(x=[1, 2, 3])")
+        assert c.args == {"x": [1, 2, 3]}
+
+    def test_string_escapes(self):
+        c = one('F(x="a\\"b")')
+        assert c.args == {"x": 'a"b'}
+
+
+class TestErrors:
+    def test_unclosed(self):
+        with pytest.raises(ParseError):
+            parse("Row(")
+
+    def test_bad_call(self):
+        with pytest.raises(ParseError):
+            parse("1234()")
+
+    def test_garbage_tail(self):
+        with pytest.raises(ParseError):
+            parse("Row(f=1) garbage&^%")
+
+
+class TestStringRoundtrip:
+    def test_str(self):
+        c = one("Count(Intersect(Row(a=1), Row(b=2)))")
+        assert str(c) == "Count(Intersect(Row(a=1), Row(b=2)))"
+        c = one("Range(bytes >< [10, 20])")
+        assert "10" in str(c) and "20" in str(c)
+        # parse(str(x)) == x for generic calls (positional forms like
+        # Set/TopN stringify with _col/_field args, as in the reference)
+        for q in [
+            "Count(Intersect(Row(a=1), Row(b=2)))",
+            "Union(Row(a=1), Row(b=2), Row(c=3))",
+            'F(x="hello", y=[1,2,3], z=null)',
+        ]:
+            c = one(q)
+            assert one(str(c)) == c
+        assert str(one("Set(33, stargazer=5)")) == "Set(_col=33, stargazer=5)"
