@@ -1,0 +1,11 @@
+"""Query executor (L4): PQL call trees → shard kernels + map/reduce."""
+
+from pilosa_tpu.executor.executor import (
+    ExecOptions,
+    Executor,
+    ValCount,
+    pairs_add,
+)
+from pilosa_tpu.executor.stager import DeviceStager
+
+__all__ = ["DeviceStager", "ExecOptions", "Executor", "ValCount", "pairs_add"]

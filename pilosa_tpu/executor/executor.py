@@ -1,0 +1,880 @@
+"""Query executor (L4) — lowers PQL call trees onto shard kernels.
+
+Mirrors the reference's executor (reference executor.go): top-level
+dispatch by call name, per-shard leaf functions, cross-shard map/reduce.
+Two execution paths per shard:
+
+  * CPU   — roaring Row algebra (the correctness oracle, always available)
+  * device — packed-word XLA kernels over HBM-staged fragment state:
+             bitmap subtrees fold elementwise, Count/Sum/Min/Max reduce
+             via popcount kernels, TopN batches every candidate's
+             intersection count into one matrix pass
+             (replacing the reference's per-candidate heap loop).
+
+Both paths are bit-identical; `device_policy` picks ("never" | "auto" |
+"always"). Cross-node distribution plugs in through the `cluster`
+seam (reference mapReduce, executor.go:1464) — single-node runs use a
+local loop over shards.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from datetime import datetime
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from pilosa_tpu import SHARD_WIDTH, ops
+from pilosa_tpu.core import Row, TopOptions, VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD
+from pilosa_tpu.core.cache import CACHE_TYPE_NONE, sort_pairs
+from pilosa_tpu.core.field import FIELD_TYPE_SET
+from pilosa_tpu.core.fragment import DEFAULT_MIN_THRESHOLD
+from pilosa_tpu.core.timequantum import TIME_FORMAT, views_by_time_range
+from pilosa_tpu.executor.stager import DeviceStager
+from pilosa_tpu.pql import BETWEEN, Call, Condition, NEQ, Query, parse
+from pilosa_tpu.roaring import Bitmap
+
+_W32 = SHARD_WIDTH // 32
+
+# Minimum packed words across a query's fragments before "auto" picks the
+# device path (tiny fragments are faster in roaring on host).
+AUTO_DEVICE_MIN_CONTAINERS = 64
+
+
+@dataclass
+class ValCount:
+    """reference executor.go:1762."""
+
+    val: int = 0
+    count: int = 0
+
+    def add(self, other: "ValCount") -> "ValCount":
+        return ValCount(self.val + other.val, self.count + other.count)
+
+    def smaller(self, other: "ValCount") -> "ValCount":
+        if self.count == 0 or (other.val < self.val and other.count > 0):
+            return other
+        return ValCount(self.val, self.count)
+
+    def larger(self, other: "ValCount") -> "ValCount":
+        if self.count == 0 or (other.val > self.val and other.count > 0):
+            return other
+        return ValCount(self.val, self.count)
+
+
+def pairs_add(a: list[tuple[int, int]], b: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    """Merge id/count pair lists, summing counts (reference Pairs.Add)."""
+    m = dict(a)
+    for id_, cnt in b:
+        m[id_] = m.get(id_, 0) + cnt
+    return list(m.items())
+
+
+@dataclass
+class ExecOptions:
+    """reference execOptions (executor.go:1714)."""
+
+    remote: bool = False
+    exclude_row_attrs: bool = False
+    exclude_columns: bool = False
+
+
+class _NotDeviceable(Exception):
+    """Raised when a call subtree can't run on the device path."""
+
+
+class Executor:
+    def __init__(
+        self,
+        holder,
+        cluster=None,
+        node=None,
+        stager: Optional[DeviceStager] = None,
+        device_policy: str = "auto",
+        translate_store=None,
+        max_writes_per_request: int = 5000,
+    ) -> None:
+        self.holder = holder
+        self.cluster = cluster  # None = single-node
+        self.node = node
+        self.stager = stager or DeviceStager()
+        self.device_policy = device_policy
+        self.translate_store = translate_store
+        self.max_writes_per_request = max_writes_per_request
+
+    # -- entry point (reference Execute, executor.go:83) ---------------------
+
+    def execute(
+        self,
+        index_name: str,
+        query,
+        shards: Optional[list[int]] = None,
+        opt: Optional[ExecOptions] = None,
+    ) -> list[Any]:
+        if isinstance(query, str):
+            query = parse(query)
+        opt = opt or ExecOptions()
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise KeyError(f"index not found: {index_name}")
+        if (
+            self.max_writes_per_request
+            and query.write_call_n() > self.max_writes_per_request
+        ):
+            raise ValueError(
+                f"too many writes: {query.write_call_n()} > {self.max_writes_per_request}"
+            )
+        if shards is None and self._needs_shards(query.calls):
+            shards = list(range(idx.max_shard() + 1))
+        results = []
+        for call in query.calls:
+            results.append(self._execute_call(index_name, call, shards, opt))
+        return results
+
+    @staticmethod
+    def _needs_shards(calls: list[Call]) -> bool:
+        for c in calls:
+            if c.name not in ("Clear", "Set", "SetRowAttrs", "SetColumnAttrs", "SetValue"):
+                return True
+        return False
+
+    # -- dispatch (reference executeCall, executor.go:165) -------------------
+
+    def _execute_call(self, index, c: Call, shards, opt) -> Any:
+        name = c.name
+        if name == "Sum":
+            return self._execute_sum(index, c, shards, opt)
+        if name == "Min":
+            return self._execute_min(index, c, shards, opt)
+        if name == "Max":
+            return self._execute_max(index, c, shards, opt)
+        if name == "Clear":
+            return self._execute_clear_bit(index, c, opt)
+        if name == "Count":
+            return self._execute_count(index, c, shards, opt)
+        if name == "Set":
+            return self._execute_set_bit(index, c, opt)
+        if name == "SetValue":
+            self._execute_set_value(index, c, opt)
+            return None
+        if name == "SetRowAttrs":
+            self._execute_set_row_attrs(index, c, opt)
+            return None
+        if name == "SetColumnAttrs":
+            self._execute_set_column_attrs(index, c, opt)
+            return None
+        if name == "TopN":
+            return self._execute_topn(index, c, shards, opt)
+        return self._execute_bitmap_call(index, c, shards, opt)
+
+    # -- map/reduce seam -----------------------------------------------------
+
+    def _map_reduce(self, index, shards, c, opt, map_fn, reduce_fn, zero=None):
+        """Single-node: loop shards in order (deterministic reduce order —
+        the reference's goroutine fan-in is arrival-ordered). The cluster
+        layer overrides this via self.cluster.map_reduce."""
+        if self.cluster is not None and not opt.remote:
+            return self.cluster.map_reduce(
+                index, shards, c, opt, map_fn, reduce_fn, zero
+            )
+        result = zero
+        for shard in shards:
+            v = map_fn(shard)
+            result = v if result is None else reduce_fn(result, v)
+        return result
+
+    # -- bitmap calls ---------------------------------------------------------
+
+    def _execute_bitmap_call(self, index, c: Call, shards, opt) -> Row:
+        def map_fn(shard):
+            return self._bitmap_call_shard(index, c, shard)
+
+        def reduce_fn(prev: Row, v: Row) -> Row:
+            prev.merge(v)
+            return prev
+
+        other = self._map_reduce(index, shards, c, opt, map_fn, reduce_fn, zero=Row())
+
+        # Attach attributes for top-level Row() calls
+        # (reference executeBitmapCall, executor.go:338-385).
+        if c.name == "Row" and not opt.exclude_row_attrs:
+            field_name = c.field_arg()
+            fld = self.holder.field(index, field_name)
+            if fld is not None and fld.row_attr_store is not None:
+                row_id, ok = c.uint_arg(field_name)
+                if ok:
+                    attrs = fld.row_attr_store.attrs(row_id)
+                    other.attrs = attrs or {}
+        return other
+
+    def _bitmap_call_shard(self, index, c: Call, shard: int) -> Row:
+        """reference executeBitmapCallShard (executor.go:388-405)."""
+        if self._use_device(index, c, shard):
+            try:
+                words = self._device_bitmap(index, c, shard)
+                return _row_from_device(words, shard)
+            except _NotDeviceable:
+                pass
+        return self._bitmap_call_shard_cpu(index, c, shard)
+
+    def _bitmap_call_shard_cpu(self, index, c: Call, shard: int) -> Row:
+        name = c.name
+        if name == "Row":
+            return self._row_shard(index, c, shard)
+        if name == "Difference":
+            return self._nary_shard(index, c, shard, "difference", require=True)
+        if name == "Intersect":
+            return self._nary_shard(index, c, shard, "intersect", require=True)
+        if name == "Range":
+            return self._range_shard(index, c, shard)
+        if name == "Union":
+            return self._nary_shard(index, c, shard, "union", require=False)
+        if name == "Xor":
+            return self._nary_shard(index, c, shard, "xor", require=False)
+        raise ValueError(f"unknown call: {name}")
+
+    def _row_shard(self, index, c: Call, shard: int) -> Row:
+        field_name = c.field_arg()
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise KeyError(f"field not found: {field_name}")
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise ValueError(f"Row() must specify {field_name}")
+        frag = self.holder.fragment(index, field_name, VIEW_STANDARD, shard)
+        if frag is None:
+            return Row()
+        return frag.row(row_id)
+
+    def _nary_shard(self, index, c: Call, shard: int, op: str, require: bool) -> Row:
+        if require and not c.children:
+            raise ValueError(f"empty {c.name} query is currently not supported")
+        other = Row()
+        for i, child in enumerate(c.children):
+            row = self._bitmap_call_shard(index, child, shard)
+            other = row if i == 0 else getattr(other, op)(row)
+        other.invalidate_count()
+        return other
+
+    def _range_shard(self, index, c: Call, shard: int) -> Row:
+        """reference executeRangeShard / executeBSIGroupRangeShard."""
+        if c.has_condition_arg():
+            return self._bsi_range_shard(index, c, shard)
+        # time range over quantum views
+        field_name = c.field_arg()
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise KeyError(f"field not found: {field_name}")
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise ValueError("Range() must specify row")
+        start_str, ok = c.string_arg("_start")
+        if not ok:
+            raise ValueError("Range() start time required")
+        end_str, ok = c.string_arg("_end")
+        if not ok:
+            raise ValueError("Range() end time required")
+        start = datetime.strptime(start_str, TIME_FORMAT)
+        end = datetime.strptime(end_str, TIME_FORMAT)
+        q = f.time_quantum()
+        if not q:
+            return Row()
+        row = Row()
+        for view in views_by_time_range(VIEW_STANDARD, start, end, q):
+            frag = self.holder.fragment(index, field_name, view, shard)
+            if frag is None:
+                continue
+            row = row.union(frag.row(row_id))
+        return row
+
+    def _bsi_range_shard(self, index, c: Call, shard: int) -> Row:
+        if len(c.args) == 0:
+            raise ValueError("Range(): condition required")
+        if len(c.args) > 1:
+            raise ValueError("Range(): too many arguments")
+        ((field_name, cond),) = c.args.items()
+        if not isinstance(cond, Condition):
+            raise ValueError(f"Range(): expected condition argument, got {cond!r}")
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise KeyError(f"field not found: {field_name}")
+        bsig = f.bsi_group(field_name)
+        if bsig is None:
+            raise KeyError(f"bsiGroup not found: {field_name}")
+        frag = self.holder.fragment(
+            index, field_name, VIEW_BSI_GROUP_PREFIX + field_name, shard
+        )
+
+        # != null
+        if cond.op == NEQ and cond.value is None:
+            if frag is None:
+                return Row()
+            return frag.not_null(bsig.bit_depth())
+
+        if cond.op == BETWEEN:
+            predicates = cond.int_slice_value()
+            if len(predicates) != 2:
+                raise ValueError(
+                    "Range(): BETWEEN condition requires exactly two integer values"
+                )
+            base_min, base_max, out_of_range = bsig.base_value_between(*predicates)
+            if out_of_range:
+                return Row()
+            if frag is None:
+                return Row()
+            if predicates[0] <= bsig.min and predicates[1] >= bsig.max:
+                return frag.not_null(bsig.bit_depth())
+            return frag.range_between(bsig.bit_depth(), base_min, base_max)
+
+        value = cond.value
+        if not isinstance(value, int) or isinstance(value, bool):
+            raise ValueError("Range(): conditions only support integer values")
+        base_value, out_of_range = bsig.base_value(cond.op, value)
+        if out_of_range and cond.op != NEQ:
+            return Row()
+        if frag is None:
+            return Row()
+        # fully-encompassing ranges return all not-null
+        if (
+            (cond.op == "<" and value > bsig.max)
+            or (cond.op == "<=" and value >= bsig.max)
+            or (cond.op == ">" and value < bsig.min)
+            or (cond.op == ">=" and value <= bsig.min)
+        ):
+            return frag.not_null(bsig.bit_depth())
+        if out_of_range and cond.op == NEQ:
+            return frag.not_null(bsig.bit_depth())
+        return frag.range_op(cond.op, bsig.bit_depth(), base_value)
+
+    # -- device path ---------------------------------------------------------
+
+    def _use_device(self, index, c: Call, shard: int) -> bool:
+        if self.device_policy == "never":
+            return False
+        if self.device_policy == "always":
+            return True
+        # auto: worthwhile once fragments are dense enough
+        total = 0
+        for frag in self._involved_fragments(index, c, shard):
+            total += len(frag.storage.containers)
+        return total >= AUTO_DEVICE_MIN_CONTAINERS
+
+    def _involved_fragments(self, index, c: Call, shard: int):
+        out = []
+        if c.name == "Row":
+            try:
+                fname = c.field_arg()
+            except ValueError:
+                return out
+            frag = self.holder.fragment(index, fname, VIEW_STANDARD, shard)
+            if frag:
+                out.append(frag)
+        elif c.name == "Range" and c.has_condition_arg():
+            for fname in c.args:
+                frag = self.holder.fragment(
+                    index, fname, VIEW_BSI_GROUP_PREFIX + fname, shard
+                )
+                if frag:
+                    out.append(frag)
+        for child in c.children:
+            out.extend(self._involved_fragments(index, child, shard))
+        return out
+
+    def _device_bitmap(self, index, c: Call, shard: int):
+        """Lower a bitmap call subtree to a device u32[W] word vector."""
+        name = c.name
+        if name == "Row":
+            field_name = c.field_arg()
+            f = self.holder.field(index, field_name)
+            if f is None:
+                raise KeyError(f"field not found: {field_name}")
+            row_id, ok = c.uint_arg(field_name)
+            if not ok:
+                raise ValueError(f"Row() must specify {field_name}")
+            frag = self.holder.fragment(index, field_name, VIEW_STANDARD, shard)
+            if frag is None:
+                return np.zeros(_W32, dtype=np.uint32)
+            return self.stager.row(frag, row_id)
+        if name in ("Intersect", "Union", "Xor", "Difference"):
+            if not c.children:
+                if name in ("Intersect", "Difference"):
+                    raise ValueError(f"empty {name} query is currently not supported")
+                return np.zeros(_W32, dtype=np.uint32)
+            acc = self._device_bitmap(index, c.children[0], shard)
+            for child in c.children[1:]:
+                w = self._device_bitmap(index, child, shard)
+                if name == "Intersect":
+                    acc = ops.and_(acc, w)
+                elif name == "Union":
+                    acc = ops.or_(acc, w)
+                elif name == "Xor":
+                    acc = ops.xor_(acc, w)
+                else:
+                    acc = ops.andnot(acc, w)
+            return acc
+        if name == "Range":
+            return self._device_range(index, c, shard)
+        raise _NotDeviceable(name)
+
+    def _device_range(self, index, c: Call, shard: int):
+        if not c.has_condition_arg():
+            # time range: union staged rows across quantum views
+            field_name = c.field_arg()
+            f = self.holder.field(index, field_name)
+            if f is None:
+                raise KeyError(f"field not found: {field_name}")
+            row_id, ok = c.uint_arg(field_name)
+            start_str, ok1 = c.string_arg("_start")
+            end_str, ok2 = c.string_arg("_end")
+            if not (ok and ok1 and ok2):
+                raise _NotDeviceable("Range")
+            q = f.time_quantum()
+            if not q:
+                return np.zeros(_W32, dtype=np.uint32)
+            start = datetime.strptime(start_str, TIME_FORMAT)
+            end = datetime.strptime(end_str, TIME_FORMAT)
+            acc = None
+            for view in views_by_time_range(VIEW_STANDARD, start, end, q):
+                frag = self.holder.fragment(index, field_name, view, shard)
+                if frag is None:
+                    continue
+                w = self.stager.row(frag, row_id)
+                acc = w if acc is None else ops.or_(acc, w)
+            return acc if acc is not None else np.zeros(_W32, dtype=np.uint32)
+
+        ((field_name, cond),) = c.args.items()
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise KeyError(f"field not found: {field_name}")
+        bsig = f.bsi_group(field_name)
+        if bsig is None:
+            raise KeyError(f"bsiGroup not found: {field_name}")
+        frag = self.holder.fragment(
+            index, field_name, VIEW_BSI_GROUP_PREFIX + field_name, shard
+        )
+        depth = bsig.bit_depth()
+        zeros = np.zeros(_W32, dtype=np.uint32)
+
+        if cond.op == NEQ and cond.value is None:
+            if frag is None:
+                return zeros
+            return self.stager.row(frag, depth)
+        if cond.op == BETWEEN:
+            predicates = cond.int_slice_value()
+            base_min, base_max, out_of_range = bsig.base_value_between(*predicates)
+            if out_of_range or frag is None:
+                return zeros
+            planes = self.stager.planes(frag, depth)
+            if predicates[0] <= bsig.min and predicates[1] >= bsig.max:
+                return planes[-1]
+            return ops.bsi_range_between(
+                planes, np.uint32(base_min), np.uint32(base_max), bit_depth=depth
+            )
+        value = cond.value
+        if not isinstance(value, int) or isinstance(value, bool):
+            raise ValueError("Range(): conditions only support integer values")
+        base_value, out_of_range = bsig.base_value(cond.op, value)
+        if out_of_range and cond.op != NEQ:
+            return zeros
+        if frag is None:
+            return zeros
+        planes = self.stager.planes(frag, depth)
+        if (
+            (cond.op == "<" and value > bsig.max)
+            or (cond.op == "<=" and value >= bsig.max)
+            or (cond.op == ">" and value < bsig.min)
+            or (cond.op == ">=" and value <= bsig.min)
+        ):
+            return planes[-1]
+        if out_of_range and cond.op == NEQ:
+            return planes[-1]
+        pred = np.uint32(base_value)
+        if cond.op == "==":
+            return ops.bsi_range_eq(planes, pred, bit_depth=depth)
+        if cond.op == "!=":
+            return ops.bsi_range_neq(planes, pred, bit_depth=depth)
+        if cond.op in ("<", "<="):
+            return ops.bsi_range_lt(
+                planes, pred, bit_depth=depth, allow_equality=cond.op == "<="
+            )
+        if cond.op in (">", ">="):
+            return ops.bsi_range_gt(
+                planes, pred, bit_depth=depth, allow_equality=cond.op == ">="
+            )
+        raise ValueError(f"invalid range operation: {cond.op}")
+
+    # -- Count ---------------------------------------------------------------
+
+    def _execute_count(self, index, c: Call, shards, opt) -> int:
+        if len(c.children) == 0:
+            raise ValueError("Count() requires an input bitmap")
+        if len(c.children) > 1:
+            raise ValueError("Count() only accepts a single bitmap input")
+        child = c.children[0]
+
+        def map_fn(shard):
+            if self._use_device(index, child, shard):
+                try:
+                    words = self._device_bitmap(index, child, shard)
+                    return int(ops.count_bits(words))
+                except _NotDeviceable:
+                    pass
+            return self._bitmap_call_shard_cpu(index, child, shard).count()
+
+        result = self._map_reduce(
+            index, shards, c, opt, map_fn, lambda a, b: a + b, zero=0
+        )
+        return int(result or 0)
+
+    # -- Sum / Min / Max -----------------------------------------------------
+
+    def _bsi_shard_parts(self, index, c: Call, shard: int):
+        """(fragment, bsig, filter) for a Sum/Min/Max shard; None if missing."""
+        field_name, _ = c.string_arg("field")
+        f = self.holder.field(index, field_name)
+        if f is None:
+            return None
+        bsig = f.bsi_group(field_name)
+        if bsig is None:
+            return None
+        frag = self.holder.fragment(
+            index, field_name, VIEW_BSI_GROUP_PREFIX + field_name, shard
+        )
+        if frag is None:
+            return None
+        return frag, bsig
+
+    def _bsi_filter(self, index, c: Call, shard: int) -> Optional[Row]:
+        if len(c.children) == 1:
+            return self._bitmap_call_shard(index, c.children[0], shard)
+        return None
+
+    def _device_filter(self, index, c: Call, shard: int):
+        """(filter_words, has_filter) on the device path."""
+        if len(c.children) == 1:
+            return self._device_bitmap(index, c.children[0], shard), True
+        return np.zeros(_W32, dtype=np.uint32), False
+
+    def _execute_sum(self, index, c: Call, shards, opt) -> ValCount:
+        if not c.args.get("field"):
+            raise ValueError("Sum(): field required")
+        if len(c.children) > 1:
+            raise ValueError("Sum() only accepts a single bitmap input")
+
+        def map_fn(shard):
+            parts = self._bsi_shard_parts(index, c, shard)
+            if parts is None:
+                return ValCount()
+            frag, bsig = parts
+            depth = bsig.bit_depth()
+            if self._use_device(index, c, shard) or (
+                self.device_policy != "never"
+                and len(frag.storage.containers) >= AUTO_DEVICE_MIN_CONTAINERS
+            ):
+                try:
+                    filt, has_filter = self._device_filter(index, c, shard)
+                    planes = self.stager.planes(frag, depth)
+                    counts = np.asarray(
+                        ops.bsi_plane_counts(
+                            planes, filt, bit_depth=depth, has_filter=has_filter
+                        )
+                    )
+                    vsum = sum(int(counts[i]) << i for i in range(depth))
+                    vcount = int(counts[depth])
+                    return ValCount(vsum + vcount * bsig.min, vcount)
+                except _NotDeviceable:
+                    pass
+            filt = self._bsi_filter(index, c, shard)
+            vsum, vcount = frag.sum(filt, depth)
+            return ValCount(vsum + vcount * bsig.min, vcount)
+
+        result = self._map_reduce(
+            index, shards, c, opt, map_fn, lambda a, b: a.add(b), zero=ValCount()
+        )
+        if result is None or result.count == 0:
+            return ValCount()
+        return result
+
+    def _execute_min(self, index, c: Call, shards, opt) -> ValCount:
+        return self._execute_minmax(index, c, shards, opt, is_min=True)
+
+    def _execute_max(self, index, c: Call, shards, opt) -> ValCount:
+        return self._execute_minmax(index, c, shards, opt, is_min=False)
+
+    def _execute_minmax(self, index, c: Call, shards, opt, is_min: bool) -> ValCount:
+        if not c.args.get("field"):
+            raise ValueError(f"{'Min' if is_min else 'Max'}(): field required")
+        if len(c.children) > 1:
+            raise ValueError(
+                f"{'Min' if is_min else 'Max'}() only accepts a single bitmap input"
+            )
+
+        def map_fn(shard):
+            parts = self._bsi_shard_parts(index, c, shard)
+            if parts is None:
+                return ValCount()
+            frag, bsig = parts
+            depth = bsig.bit_depth()
+            if self._use_device(index, c, shard) or (
+                self.device_policy != "never"
+                and len(frag.storage.containers) >= AUTO_DEVICE_MIN_CONTAINERS
+            ):
+                try:
+                    filt, has_filter = self._device_filter(index, c, shard)
+                    planes = self.stager.planes(frag, depth)
+                    kernel = ops.bsi_min if is_min else ops.bsi_max
+                    bits, count = kernel(
+                        planes, filt, bit_depth=depth, has_filter=has_filter
+                    )
+                    count = int(count)
+                    if count == 0:
+                        return ValCount()
+                    val = sum(1 << i for i, b in enumerate(np.asarray(bits)) if b)
+                    return ValCount(val + bsig.min, count)
+                except _NotDeviceable:
+                    pass
+            filt = self._bsi_filter(index, c, shard)
+            val, count = (frag.min if is_min else frag.max)(filt, depth)
+            return ValCount(val + bsig.min, count)
+
+        reduce_fn = (
+            (lambda a, b: a.smaller(b)) if is_min else (lambda a, b: a.larger(b))
+        )
+        result = self._map_reduce(
+            index, shards, c, opt, map_fn, reduce_fn, zero=ValCount()
+        )
+        if result is None or result.count == 0:
+            return ValCount()
+        return result
+
+    # -- TopN (reference executeTopN two-pass, executor.go:521-585) ----------
+
+    def _execute_topn(self, index, c: Call, shards, opt) -> list[dict]:
+        ids_arg, _ = c.uint_slice_arg("ids")
+        n, _ = c.uint_arg("n")
+        pairs = self._execute_topn_shards(index, c, shards, opt)
+        if not pairs or ids_arg or opt.remote:
+            return _pairs_result(pairs)
+        # Pass 2: re-query the union of candidate ids for exact counts.
+        other = c.clone()
+        other.args["ids"] = sorted(p[0] for p in pairs)
+        trimmed = self._execute_topn_shards(index, other, shards, opt)
+        if n and n < len(trimmed):
+            trimmed = trimmed[:n]
+        return _pairs_result(trimmed)
+
+    def _execute_topn_shards(self, index, c: Call, shards, opt) -> list[tuple[int, int]]:
+        def map_fn(shard):
+            return self._execute_topn_shard(index, c, shard)
+
+        result = self._map_reduce(index, shards, c, opt, map_fn, pairs_add, zero=[])
+        return sort_pairs(result or [])
+
+    def _execute_topn_shard(self, index, c: Call, shard: int) -> list[tuple[int, int]]:
+        field, _ = c.string_arg("_field")
+        n, _ = c.uint_arg("n")
+        attr_name, _ = c.string_arg("attrName")
+        row_ids, _ = c.uint_slice_arg("ids")
+        min_threshold, has_threshold = c.uint_arg("threshold")
+        attr_values = c.args.get("attrValues") or []
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+
+        src = None
+        if len(c.children) == 1:
+            src = self._bitmap_call_shard(index, c.children[0], shard)
+        elif len(c.children) > 1:
+            raise ValueError("TopN() can only have one input bitmap")
+
+        frag = self.holder.fragment(index, field, VIEW_STANDARD, shard)
+        if frag is None:
+            return []
+        if min_threshold <= 0:
+            min_threshold = DEFAULT_MIN_THRESHOLD
+        if tanimoto > 100:
+            raise ValueError("Tanimoto Threshold is from 1 to 100 only")
+        opt_ = TopOptions(
+            n=int(n),
+            src=src,
+            row_ids=row_ids,
+            min_threshold=min_threshold,
+            filter_name=attr_name,
+            filter_values=attr_values,
+            tanimoto_threshold=tanimoto,
+        )
+        if src is not None and self._use_device(index, c, shard):
+            return self._top_device(frag, opt_, index, c, shard)
+        return frag.top(opt_)
+
+    def _top_device(self, frag, opt_: TopOptions, index, c: Call, shard: int):
+        """Device-accelerated TopN: batch all candidate intersection counts
+        into one matrix kernel pass, then replay the reference's ranked
+        walk on the precomputed scores (bit-identical outputs)."""
+        pairs = frag._top_bitmap_pairs(opt_.row_ids)
+        if not pairs:
+            return []
+        candidate_ids = tuple(p[0] for p in pairs)
+        try:
+            src_words = self._device_bitmap(index, c.children[0], shard)
+        except _NotDeviceable:
+            return frag.top(opt_)
+        mat = self.stager.rows(frag, candidate_ids)
+        scores = np.asarray(ops.intersection_counts_matrix(src_words, mat))
+        score_by_id = dict(zip(candidate_ids, (int(s) for s in scores)))
+
+        # Replay fragment.top's walk with precomputed counts.
+        import heapq
+        import math
+
+        n = 0 if opt_.row_ids else opt_.n
+        filters = set(opt_.filter_values) if (opt_.filter_name and opt_.filter_values) else None
+        tanimoto_threshold = 0
+        min_tanimoto = max_tanimoto = 0.0
+        src_count = 0
+        if opt_.tanimoto_threshold > 0:
+            tanimoto_threshold = opt_.tanimoto_threshold
+            src_count = opt_.src.count()
+            min_tanimoto = float(src_count * tanimoto_threshold) / 100
+            max_tanimoto = float(src_count * 100) / float(tanimoto_threshold)
+
+        results: list[tuple[int, int]] = []
+        for row_id, cnt in pairs:
+            if cnt <= 0:
+                continue
+            if tanimoto_threshold > 0:
+                if float(cnt) <= min_tanimoto or float(cnt) >= max_tanimoto:
+                    continue
+            elif cnt < opt_.min_threshold:
+                continue
+            if filters is not None:
+                attr = frag.row_attr_store.attrs(row_id) if frag.row_attr_store else None
+                if not attr:
+                    continue
+                value = attr.get(opt_.filter_name)
+                if value is None or value not in filters:
+                    continue
+            if n == 0 or len(results) < n:
+                count = score_by_id[row_id]
+                if count == 0:
+                    continue
+                if tanimoto_threshold > 0:
+                    t = math.ceil(float(count * 100) / float(cnt + src_count - count))
+                    if t <= float(tanimoto_threshold):
+                        continue
+                elif count < opt_.min_threshold:
+                    continue
+                heapq.heappush(results, (count, row_id))
+                continue
+            threshold = results[0][0]
+            if threshold < opt_.min_threshold or cnt < threshold:
+                break
+            count = score_by_id[row_id]
+            if count < threshold:
+                continue
+            heapq.heappush(results, (count, row_id))
+
+        out = []
+        while results:
+            count, row_id = heapq.heappop(results)
+            out.append((row_id, count))
+        out.reverse()
+        return out
+
+    # -- writes (reference executor.go:998-1258) -----------------------------
+
+    def _shard_nodes_local(self, index, shard) -> bool:
+        """True when this node owns the shard (single-node: always)."""
+        return True
+
+    def _execute_set_bit(self, index, c: Call, opt) -> bool:
+        field_name = c.field_arg()
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise KeyError(f"field not found: {field_name}")
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise ValueError("Set() row argument required")
+        col_id, ok = c.uint_arg("_col")
+        if not ok:
+            raise ValueError("Set() col argument required")
+        timestamp = None
+        ts_str, ok = c.string_arg("_timestamp")
+        if ok:
+            timestamp = datetime.strptime(ts_str, TIME_FORMAT)
+        if self.cluster is not None and not opt.remote:
+            return self.cluster.set_bit(index, c, f, row_id, col_id, timestamp, opt)
+        return f.set_bit(row_id, col_id, timestamp)
+
+    def _execute_clear_bit(self, index, c: Call, opt) -> bool:
+        field_name = c.field_arg()
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise KeyError(f"field not found: {field_name}")
+        row_id, ok = c.uint_arg(field_name)
+        if not ok:
+            raise ValueError("Clear() row argument required")
+        col_id, ok = c.uint_arg("_col")
+        if not ok:
+            raise ValueError("Clear() col argument required")
+        if self.cluster is not None and not opt.remote:
+            return self.cluster.clear_bit(index, c, f, row_id, col_id, opt)
+        return f.clear_bit(row_id, col_id)
+
+    def _execute_set_value(self, index, c: Call, opt) -> None:
+        col_id, ok = c.uint_arg("col")
+        if not ok:
+            raise ValueError("SetValue() col argument required")
+        args = {k: v for k, v in c.args.items() if k != "col"}
+        for name, value in args.items():
+            f = self.holder.field(index, name)
+            if f is None:
+                raise KeyError(f"field not found: {name}")
+            if isinstance(value, bool) or not isinstance(value, int):
+                raise ValueError("invalid BSI group value type")
+            f.set_value(col_id, value)
+        if self.cluster is not None and not opt.remote:
+            self.cluster.forward_to_all(index, c, opt)
+
+    def _execute_set_row_attrs(self, index, c: Call, opt) -> None:
+        field_name, ok = c.string_arg("_field")
+        if not ok:
+            raise ValueError("SetRowAttrs() field required")
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise KeyError(f"field not found: {field_name}")
+        row_id, ok = c.uint_arg("_row")
+        if not ok:
+            raise ValueError("SetRowAttrs() row required")
+        attrs = {
+            k: v for k, v in c.args.items() if k not in ("_field", "_row")
+        }
+        if f.row_attr_store is None:
+            raise ValueError("row attr store not configured")
+        f.row_attr_store.set_attrs(row_id, attrs)
+        if self.cluster is not None and not opt.remote:
+            self.cluster.forward_to_all(index, c, opt)
+
+    def _execute_set_column_attrs(self, index, c: Call, opt) -> None:
+        idx = self.holder.index(index)
+        col_id, ok = c.uint_arg("_col")
+        if not ok:
+            raise ValueError("SetColumnAttrs() col required")
+        attrs = {k: v for k, v in c.args.items() if k != "_col"}
+        if idx.column_attrs is None:
+            raise ValueError("column attr store not configured")
+        idx.column_attrs.set_attrs(col_id, attrs)
+        if self.cluster is not None and not opt.remote:
+            self.cluster.forward_to_all(index, c, opt)
+
+
+def _row_from_device(words, shard: int) -> Row:
+    w32 = np.asarray(words)
+    w64 = np.ascontiguousarray(w32).view("<u8")
+    seg = Bitmap.from_words_range(w64, start=shard * SHARD_WIDTH)
+    return Row.from_segment(shard, seg)
+
+
+def _pairs_result(pairs: list[tuple[int, int]]) -> list[dict]:
+    """JSON-shaped Pair list (reference Pair, cache.go:360)."""
+    return [{"id": p[0], "count": p[1]} for p in pairs]

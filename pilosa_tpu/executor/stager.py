@@ -1,0 +1,105 @@
+"""HBM staging manager — the device-side cache of fragment state.
+
+Fragments are CPU source of truth (roaring + op log); queries run on
+packed-word copies staged in device memory. Entries are keyed by
+(fragment identity, generation): any mutation bumps the fragment's
+generation and the stale staged block is simply re-staged on next use
+(SURVEY.md §7 'Mutations vs staged state').
+
+Staged forms:
+  * row      — u32[W]            one fragment row
+  * matrix   — u32[R, W]         all non-empty rows (TopN scans)
+  * planes   — u32[D+1, W]       BSI bit planes + not-null
+
+Eviction is LRU by byte budget — the stager is the scheduler of HBM
+residency (SURVEY.md §7 hard part 2).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import jax
+import numpy as np
+
+from pilosa_tpu import SHARD_WIDTH
+
+
+class DeviceStager:
+    def __init__(self, budget_bytes: int = 8 << 30, device=None) -> None:
+        self.budget_bytes = budget_bytes
+        self.device = device
+        self._cache: OrderedDict[tuple, tuple[object, int]] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    # -- internal --
+
+    def _key(self, frag, kind: str, extra=()) -> tuple:
+        return (id(frag), frag.generation, kind) + tuple(extra)
+
+    def _get(self, key):
+        ent = self._cache.get(key)
+        if ent is None:
+            return None
+        self._cache.move_to_end(key)
+        self.hits += 1
+        return ent[0]
+
+    def _put(self, key, value, nbytes: int):
+        self.misses += 1
+        self._cache[key] = (value, nbytes)
+        self._bytes += nbytes
+        while self._bytes > self.budget_bytes and len(self._cache) > 1:
+            _, (old, old_bytes) = self._cache.popitem(last=False)
+            self._bytes -= old_bytes
+        return value
+
+    def _to_device(self, words64: np.ndarray):
+        w32 = np.ascontiguousarray(words64).view("<u4")
+        return jax.device_put(w32, self.device)
+
+    # -- staging entry points --
+
+    def row(self, frag, row_id: int):
+        """u32[W] for one row."""
+        key = self._key(frag, "row", (row_id,))
+        v = self._get(key)
+        if v is None:
+            words = frag.row_words(row_id)
+            v = self._put(key, self._to_device(words), words.nbytes)
+        return v
+
+    def rows(self, frag, row_ids: tuple[int, ...]):
+        """u32[K, W] stack of specific rows."""
+        key = self._key(frag, "rows", (row_ids,))
+        v = self._get(key)
+        if v is None:
+            words = frag.packed_rows(list(row_ids))
+            v = self._put(key, self._to_device(words), words.nbytes)
+        return v
+
+    def matrix(self, frag):
+        """(row_ids, u32[R, W]) for all non-empty rows."""
+        key = self._key(frag, "matrix")
+        v = self._get(key)
+        if v is None:
+            ids, words = frag.row_matrix()
+            dev = self._to_device(words) if len(ids) else None
+            v = self._put(key, (ids, dev), words.nbytes)
+        return v
+
+    def planes(self, frag, bit_depth: int):
+        """u32[bit_depth+1, W] BSI plane stack."""
+        key = self._key(frag, "planes", (bit_depth,))
+        v = self._get(key)
+        if v is None:
+            words = frag.bsi_planes(bit_depth)
+            v = self._put(key, self._to_device(words), words.nbytes)
+        return v
+
+    def clear(self) -> None:
+        self._cache.clear()
+        self._bytes = 0
