@@ -128,10 +128,94 @@ class Executor:
             )
         if shards is None and self._needs_shards(query.calls):
             shards = list(range(idx.max_shard() + 1))
+        if self.translate_store is not None and not opt.remote:
+            for call in query.calls:
+                self._translate_call(index_name, idx, call)
         results = []
         for call in query.calls:
             results.append(self._execute_call(index_name, call, shards, opt))
+        if self.translate_store is not None and not opt.remote:
+            results = [
+                self._translate_result(index_name, idx, call, r)
+                for call, r in zip(query.calls, results)
+            ]
         return results
+
+    # -- key translation (reference translateCall/translateResult,
+    #    executor.go:1595-1696) --------------------------------------------
+
+    def _translate_call(self, index, idx, c: Call) -> None:
+        if c.name in ("Set", "Clear", "Row"):
+            col_key = "_col"
+            try:
+                field_name = c.field_arg()
+            except ValueError:
+                field_name = ""
+            row_key = field_name
+        else:
+            col_key = "col"
+            field_name = c.args.get("field") or ""
+            row_key = "row"
+        ts = self.translate_store
+        if idx.keys:
+            v = c.args.get(col_key)
+            if v is not None and not isinstance(v, str):
+                raise ValueError(
+                    "column value must be a string when index 'keys' option enabled"
+                )
+            if isinstance(v, str) and v:
+                c.args[col_key] = ts.translate_columns_to_ids(index, [v])[0]
+        else:
+            if isinstance(c.args.get(col_key), str):
+                raise ValueError(
+                    "string 'col' value not allowed unless index 'keys' option enabled"
+                )
+        if field_name:
+            fld = idx.field(field_name)
+            if fld is None:
+                raise KeyError(f"field not found: {field_name}")
+            if fld.options.keys:
+                v = c.args.get(row_key)
+                if v is not None and not isinstance(v, str):
+                    raise ValueError(
+                        "row value must be a string when field 'keys' option enabled"
+                    )
+                if isinstance(v, str) and v:
+                    c.args[row_key] = ts.translate_rows_to_ids(
+                        index, field_name, [v]
+                    )[0]
+            else:
+                if isinstance(c.args.get(row_key), str):
+                    raise ValueError(
+                        "string 'row' value not allowed unless field 'keys' option enabled"
+                    )
+        for child in c.children:
+            self._translate_call(index, idx, child)
+
+    def _translate_result(self, index, idx, call: Call, result):
+        ts = self.translate_store
+        if isinstance(result, Row):
+            if idx.keys:
+                result.keys = [
+                    ts.translate_column_to_string(index, int(col))
+                    for col in result.columns()
+                ]
+            return result
+        if isinstance(result, list) and result and isinstance(result[0], dict) and "id" in result[0]:
+            field_name = call.args.get("_field") or ""
+            if field_name:
+                fld = idx.field(field_name)
+                if fld is not None and fld.options.keys:
+                    return [
+                        {
+                            "key": ts.translate_row_to_string(
+                                index, field_name, p["id"]
+                            ),
+                            "count": p["count"],
+                        }
+                        for p in result
+                    ]
+        return result
 
     @staticmethod
     def _needs_shards(calls: list[Call]) -> bool:

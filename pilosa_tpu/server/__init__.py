@@ -1,0 +1,18 @@
+"""API + HTTP + server runtime (L6/L7)."""
+
+from pilosa_tpu.server.api import API, APIError, NotFoundError
+from pilosa_tpu.server.config import ClusterConfig, Config
+from pilosa_tpu.server.http_handler import Handler, encode_result, make_http_server
+from pilosa_tpu.server.server import Server
+
+__all__ = [
+    "API",
+    "APIError",
+    "ClusterConfig",
+    "Config",
+    "Handler",
+    "NotFoundError",
+    "Server",
+    "encode_result",
+    "make_http_server",
+]

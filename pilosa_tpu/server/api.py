@@ -1,0 +1,393 @@
+"""Programmatic API (L6) — validated surface over holder/executor/cluster
+(reference api.go).
+
+Each method is gated on cluster state like the reference's
+validAPIMethods (api.go:70-93): while the cluster is RESIZING only a
+restricted set is callable.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+from typing import Any, Optional
+
+from pilosa_tpu import SHARD_WIDTH, __version__
+from pilosa_tpu.core import FieldOptions, Row
+from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.executor import ExecOptions
+from pilosa_tpu.pql import parse
+
+# cluster states (reference cluster.go:42-45)
+STATE_STARTING = "STARTING"
+STATE_NORMAL = "NORMAL"
+STATE_RESIZING = "RESIZING"
+
+# Methods permitted while RESIZING (reference api.go:70-93)
+_RESIZING_METHODS = {
+    "cluster_message",
+    "state",
+    "status",
+    "resize_abort",
+}
+
+
+class APIError(Exception):
+    def __init__(self, message: str, status: int = 400) -> None:
+        super().__init__(message)
+        self.status = status
+
+
+class NotFoundError(APIError):
+    def __init__(self, message: str) -> None:
+        super().__init__(message, status=404)
+
+
+class API:
+    def __init__(self, holder, executor, cluster=None, server=None) -> None:
+        self.holder = holder
+        self.executor = executor
+        self.cluster = cluster
+        self.server = server
+
+    # -- state gate --
+
+    def _state(self) -> str:
+        if self.cluster is None:
+            return STATE_NORMAL
+        return self.cluster.state
+
+    def _validate(self, method: str) -> None:
+        state = self._state()
+        if state == STATE_NORMAL:
+            return
+        if state == STATE_RESIZING and method in _RESIZING_METHODS:
+            return
+        if state == STATE_STARTING and method in _RESIZING_METHODS | {"schema"}:
+            return
+        raise APIError(
+            f"api method {method} unavailable in cluster state {state}", status=503
+        )
+
+    # -- query (reference api.Query:96-150) --
+
+    def query(
+        self,
+        index: str,
+        query: str,
+        shards: Optional[list[int]] = None,
+        remote: bool = False,
+        exclude_row_attrs: bool = False,
+        exclude_columns: bool = False,
+        column_attrs: bool = False,
+    ) -> dict:
+        self._validate("query")
+        opt = ExecOptions(
+            remote=remote,
+            exclude_row_attrs=exclude_row_attrs,
+            exclude_columns=exclude_columns,
+        )
+        try:
+            q = parse(query)
+        except Exception as e:
+            raise APIError(f"parsing: {e}") from e
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        results = self.executor.execute(index, q, shards, opt)
+        resp: dict = {"results": results}
+        if column_attrs and idx.column_attrs is not None:
+            cols = set()
+            for r in results:
+                if isinstance(r, Row):
+                    cols.update(int(c) for c in r.columns())
+            attr_sets = []
+            for col in sorted(cols):
+                attrs = idx.column_attrs.attrs(col)
+                if attrs:
+                    attr_sets.append({"id": col, "attrs": attrs})
+            resp["columnAttrs"] = attr_sets
+        return resp
+
+    # -- schema CRUD --
+
+    def create_index(self, name: str, keys: bool = False) -> None:
+        self._validate("create_index")
+        try:
+            self.holder.create_index(name, keys=keys)
+        except ValueError as e:
+            raise APIError(str(e), status=409 if "exists" in str(e) else 400)
+        if self.server is not None:
+            self.server.send_sync({"type": "create-index", "index": name, "keys": keys})
+
+    def delete_index(self, name: str) -> None:
+        self._validate("delete_index")
+        try:
+            self.holder.delete_index(name)
+        except ValueError as e:
+            raise NotFoundError(str(e))
+        if self.server is not None:
+            self.server.send_sync({"type": "delete-index", "index": name})
+
+    def create_field(self, index: str, field: str, options: dict) -> None:
+        self._validate("create_field")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        try:
+            idx.create_field(field, FieldOptions.from_dict(options or {}))
+        except ValueError as e:
+            raise APIError(str(e), status=409 if "exists" in str(e) else 400)
+        if self.server is not None:
+            self.server.send_sync(
+                {"type": "create-field", "index": index, "field": field,
+                 "options": options or {}}
+            )
+
+    def delete_field(self, index: str, field: str) -> None:
+        self._validate("delete_field")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        try:
+            idx.delete_field(field)
+        except ValueError as e:
+            raise NotFoundError(str(e))
+        if self.server is not None:
+            self.server.send_sync(
+                {"type": "delete-field", "index": index, "field": field}
+            )
+
+    def delete_view(self, index: str, field: str, view: str) -> None:
+        self._validate("delete_view")
+        f = self.holder.field(index, field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        v = f.views.pop(view, None)
+        if v is not None:
+            v.close()
+            if v.path:
+                import shutil
+
+                shutil.rmtree(v.path, ignore_errors=True)
+
+    def schema(self) -> list[dict]:
+        self._validate("schema")
+        return self.holder.schema()
+
+    def views(self, index: str, field: str) -> list[str]:
+        self._validate("views")
+        f = self.holder.field(index, field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        return sorted(f.views)
+
+    # -- imports (reference api.Import:652-696) --
+
+    def import_bits(
+        self,
+        index: str,
+        field: str,
+        row_ids: list[int],
+        column_ids: list[int],
+        timestamps: Optional[list] = None,
+        row_keys: Optional[list[str]] = None,
+        column_keys: Optional[list[str]] = None,
+    ) -> None:
+        self._validate("import")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        f = idx.field(field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        ts = self.executor.translate_store
+        if column_keys:
+            if ts is None:
+                raise APIError("translate store not configured")
+            column_ids = ts.translate_columns_to_ids(index, column_keys)
+        if row_keys:
+            if ts is None:
+                raise APIError("translate store not configured")
+            row_ids = ts.translate_rows_to_ids(index, field, row_keys)
+        parsed_ts = None
+        if timestamps and any(t for t in timestamps):
+            from datetime import datetime
+
+            parsed_ts = [
+                datetime.fromtimestamp(t) if isinstance(t, (int, float)) and t else None
+                for t in timestamps
+            ]
+        f.import_bits(row_ids, column_ids, parsed_ts)
+
+    def import_values(
+        self,
+        index: str,
+        field: str,
+        column_ids: list[int],
+        values: list[int],
+        column_keys: Optional[list[str]] = None,
+    ) -> None:
+        self._validate("import_value")
+        f = self.holder.field(index, field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        ts = self.executor.translate_store
+        if column_keys:
+            if ts is None:
+                raise APIError("translate store not configured")
+            column_ids = ts.translate_columns_to_ids(index, column_keys)
+        f.import_values(column_ids, values)
+
+    # -- export (reference api.ExportCSV:328) --
+
+    def export_csv(self, index: str, field: str, shard: int) -> str:
+        self._validate("export_csv")
+        f = self.holder.field(index, field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        frag = self.holder.fragment(index, field, VIEW_STANDARD, shard)
+        buf = io.StringIO()
+        w = csv.writer(buf)
+        if frag is not None:
+            positions = frag.storage.slice_all()
+            for p in positions:
+                row = int(p) // SHARD_WIDTH
+                col = frag.shard * SHARD_WIDTH + (int(p) % SHARD_WIDTH)
+                w.writerow([row, col])
+        return buf.getvalue()
+
+    # -- fragment sync endpoints (reference api.go:376-472) --
+
+    def fragment_blocks(self, index: str, field: str, shard: int) -> list[dict]:
+        self._validate("fragment_blocks")
+        frag = self.holder.fragment(index, field, VIEW_STANDARD, shard)
+        if frag is None:
+            raise NotFoundError("fragment not found")
+        return [
+            {"id": bid, "checksum": digest.hex()} for bid, digest in frag.blocks()
+        ]
+
+    def fragment_block_data(
+        self, index: str, field: str, view: str, shard: int, block: int
+    ) -> dict:
+        self._validate("fragment_block_data")
+        frag = self.holder.fragment(index, field, view, shard)
+        if frag is None:
+            raise NotFoundError("fragment not found")
+        rows, cols = frag.block_data(block)
+        return {"rows": rows.tolist(), "columns": cols.tolist()}
+
+    def marshal_fragment(self, index: str, field: str, view: str, shard: int) -> bytes:
+        self._validate("fragment_data")
+        frag = self.holder.fragment(index, field, view, shard)
+        if frag is None:
+            raise NotFoundError("fragment not found")
+        return frag.storage.to_bytes()
+
+    def unmarshal_fragment(
+        self, index: str, field: str, view: str, shard: int, data: bytes
+    ) -> None:
+        self._validate("fragment_data")
+        f = self.holder.field(index, field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        v = f.create_view_if_not_exists(view)
+        frag = v.create_fragment_if_not_exists(shard)
+        from pilosa_tpu.roaring import Bitmap
+
+        with frag.mu:
+            op_writer = frag.storage.op_writer
+            frag.storage = Bitmap.unmarshal_binary(data)
+            frag.storage.op_writer = op_writer
+            frag.generation += 1
+            frag._row_cache.clear()
+            frag.checksums.clear()
+            frag._recompute_max_row_id()
+            frag.snapshot()
+
+    # -- caches --
+
+    def recalculate_caches(self) -> None:
+        self._validate("recalculate_caches")
+        for idx in self.holder.indexes.values():
+            for f in idx.fields.values():
+                for v in f.views.values():
+                    for frag in v.fragments.values():
+                        frag.cache.recalculate()
+        if self.server is not None:
+            self.server.send_sync({"type": "recalculate-caches"})
+
+    # -- info / status --
+
+    def version(self) -> str:
+        return __version__
+
+    def info(self) -> dict:
+        import os
+
+        return {
+            "shardWidth": SHARD_WIDTH,
+            "cpuPhysicalCores": os.cpu_count(),
+            "cpuLogicalCores": os.cpu_count(),
+        }
+
+    def state(self) -> str:
+        return self._state()
+
+    def status(self) -> dict:
+        nodes = []
+        if self.cluster is not None:
+            nodes = [n.to_dict() for n in self.cluster.nodes]
+        return {
+            "state": self._state(),
+            "nodes": nodes,
+            "localID": getattr(self.cluster, "node_id", "") if self.cluster else "",
+        }
+
+    def hosts(self) -> list[dict]:
+        if self.cluster is None:
+            return []
+        return [n.to_dict() for n in self.cluster.nodes]
+
+    def shard_nodes(self, index: str, shard: int) -> list[dict]:
+        self._validate("shard_nodes")
+        if self.cluster is None:
+            return []
+        return [n.to_dict() for n in self.cluster.shard_nodes(index, shard)]
+
+    def max_shards(self) -> dict[str, int]:
+        return {
+            name: idx.max_shard() for name, idx in self.holder.indexes.items()
+        }
+
+    # -- cluster ops (wired by the cluster layer) --
+
+    def cluster_message(self, msg: dict) -> None:
+        if self.server is None:
+            raise APIError("cluster not configured")
+        self.server.receive_message(msg)
+
+    def set_coordinator(self, node_id: str) -> None:
+        self._validate("set_coordinator")
+        if self.cluster is None:
+            raise APIError("cluster not configured")
+        self.cluster.set_coordinator(node_id)
+
+    def remove_node(self, node_id: str) -> None:
+        self._validate("remove_node")
+        if self.cluster is None:
+            raise APIError("cluster not configured")
+        self.cluster.remove_node(node_id)
+
+    def resize_abort(self) -> None:
+        if self.cluster is None:
+            raise APIError("cluster not configured")
+        self.cluster.resize_abort()
+
+    def get_translate_data(self, offset: int) -> bytes:
+        ts = self.executor.translate_store
+        if ts is None:
+            raise APIError("translate store not configured")
+        data, _ = ts.read_from(offset)
+        return data

@@ -1,0 +1,104 @@
+"""Server configuration (reference server/config.go).
+
+Three-tier precedence (CLI flags > env PILOSA_TPU_* > TOML file) is
+implemented in the CLI layer; this module is the canonical option set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ClusterConfig:
+    disabled: bool = True  # single-node static cluster by default
+    coordinator: bool = False
+    replicas: int = 1
+    hosts: list[str] = field(default_factory=list)
+    long_query_time: float = 0.0
+
+
+@dataclass
+class Config:
+    data_dir: str = "~/.pilosa_tpu"
+    bind: str = "localhost:10101"
+    max_writes_per_request: int = 5000
+    log_path: str = ""
+    verbose: bool = False
+    # TPU execution
+    device_policy: str = "auto"  # never | auto | always
+    stager_budget_bytes: int = 8 << 30
+    # cluster
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    anti_entropy_interval: float = 600.0  # reference server.go:238 (10m)
+    metric: str = "expvar"  # expvar | none
+
+    @property
+    def host(self) -> str:
+        return self.bind.rsplit(":", 1)[0] or "localhost"
+
+    @property
+    def port(self) -> int:
+        parts = self.bind.rsplit(":", 1)
+        return int(parts[1]) if len(parts) == 2 and parts[1] else 10101
+
+    @classmethod
+    def from_toml(cls, path: str) -> "Config":
+        with open(path, "rb") as f:
+            raw = tomllib.load(f)
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: dict) -> "Config":
+        cfg = cls()
+        for k, v in raw.items():
+            key = k.replace("-", "_")
+            if key == "cluster" and isinstance(v, dict):
+                for ck, cv in v.items():
+                    cattr = ck.replace("-", "_")
+                    if hasattr(cfg.cluster, cattr):
+                        setattr(cfg.cluster, cattr, cv)
+            elif hasattr(cfg, key):
+                setattr(cfg, key, v)
+            else:
+                raise ValueError(f"unknown config key: {k}")
+        return cfg
+
+    def apply_env(self, env=None) -> None:
+        """PILOSA_TPU_* environment overrides (reference PILOSA_* env)."""
+        env = env if env is not None else os.environ
+        for f in dataclasses.fields(self):
+            if f.name == "cluster":
+                continue
+            key = "PILOSA_TPU_" + f.name.upper()
+            if key in env:
+                v: object = env[key]
+                if f.type in ("int",):
+                    v = int(v)  # type: ignore[arg-type]
+                elif f.type in ("float",):
+                    v = float(v)  # type: ignore[arg-type]
+                elif f.type in ("bool",):
+                    v = str(v).lower() in ("1", "true", "yes")
+                setattr(self, f.name, v)
+
+    def to_toml(self) -> str:
+        lines = [
+            f'data-dir = "{self.data_dir}"',
+            f'bind = "{self.bind}"',
+            f"max-writes-per-request = {self.max_writes_per_request}",
+            f'device-policy = "{self.device_policy}"',
+            f'metric = "{self.metric}"',
+            f"anti-entropy-interval = {self.anti_entropy_interval}",
+            "",
+            "[cluster]",
+            f"disabled = {'true' if self.cluster.disabled else 'false'}",
+            f"coordinator = {'true' if self.cluster.coordinator else 'false'}",
+            f"replicas = {self.cluster.replicas}",
+            f"hosts = {self.cluster.hosts!r}",
+            f"long-query-time = {self.cluster.long_query_time}",
+        ]
+        return "\n".join(lines) + "\n"

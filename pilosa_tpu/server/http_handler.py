@@ -1,0 +1,366 @@
+"""HTTP handler (L6) — REST surface over the API (reference
+http/handler.go).
+
+Public routes mirror the reference's router (handler.go:188-231); the
+wire format is JSON (the reference negotiates JSON or protobuf — JSON is
+the canonical format here; see docs/API.md for shapes).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from pilosa_tpu.core import Row
+from pilosa_tpu.executor import ValCount
+from pilosa_tpu.server.api import API, APIError
+from pilosa_tpu.utils.stats import NOP_STATS
+
+
+def encode_result(r: Any) -> Any:
+    """Query result → JSON shape (reference QueryResponse encoding)."""
+    if isinstance(r, Row):
+        if r.keys:
+            return {"attrs": r.attrs, "keys": r.keys}
+        return {"attrs": r.attrs, "columns": [int(c) for c in r.columns()]}
+    if isinstance(r, ValCount):
+        return {"value": r.val, "count": r.count}
+    return r
+
+
+class Route:
+    def __init__(self, method: str, pattern: str, fn: Callable) -> None:
+        self.method = method
+        self.re = re.compile("^" + pattern + "$")
+        self.fn = fn
+
+
+class Handler:
+    """Routing table + request glue, served by ThreadingHTTPServer."""
+
+    def __init__(self, api: API, logger=None, stats=NOP_STATS, long_query_time: float = 0.0) -> None:
+        self.api = api
+        self.logger = logger
+        self.stats = stats
+        self.long_query_time = long_query_time
+        a = api
+        self.routes = [
+            # public (reference handler.go:188-231)
+            Route("POST", r"/index/(?P<index>[^/]+)/query", self.post_query),
+            Route("GET", r"/schema", lambda req: {"indexes": a.schema()}),
+            Route("GET", r"/status", lambda req: a.status()),
+            Route("GET", r"/info", lambda req: a.info()),
+            Route("GET", r"/version", lambda req: {"version": a.version()}),
+            Route("GET", r"/index", lambda req: {"indexes": a.schema()}),
+            Route("GET", r"/index/(?P<index>[^/]+)", self.get_index),
+            Route("POST", r"/index/(?P<index>[^/]+)", self.post_index),
+            Route("DELETE", r"/index/(?P<index>[^/]+)", self.delete_index),
+            Route(
+                "POST",
+                r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)",
+                self.post_field,
+            ),
+            Route(
+                "DELETE",
+                r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)",
+                self.delete_field,
+            ),
+            Route(
+                "POST",
+                r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import",
+                self.post_import,
+            ),
+            Route(
+                "POST",
+                r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-value",
+                self.post_import_value,
+            ),
+            Route(
+                "GET",
+                r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/views",
+                self.get_views,
+            ),
+            Route(
+                "DELETE",
+                r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/view/(?P<view>[^/]+)",
+                self.delete_view,
+            ),
+            Route("GET", r"/export", self.get_export),
+            Route("POST", r"/recalculate-caches", self.post_recalculate_caches),
+            Route("POST", r"/cluster/resize/set-coordinator", self.post_set_coordinator),
+            Route("POST", r"/cluster/resize/remove-node", self.post_remove_node),
+            Route("POST", r"/cluster/resize/abort", self.post_resize_abort),
+            # internal (data plane between nodes)
+            Route("POST", r"/internal/cluster/message", self.post_cluster_message),
+            Route("GET", r"/internal/fragment/nodes", self.get_fragment_nodes),
+            Route("GET", r"/internal/fragment/blocks", self.get_fragment_blocks),
+            Route("GET", r"/internal/fragment/block/data", self.get_block_data),
+            Route("GET", r"/internal/fragment/data", self.get_fragment_data),
+            Route("POST", r"/internal/fragment/data", self.post_fragment_data),
+            Route("GET", r"/internal/shards/max", lambda req: {"standard": a.max_shards()}),
+            Route("GET", r"/internal/translate/data", self.get_translate_data),
+            Route(
+                "GET",
+                r"/internal/index/(?P<index>[^/]+)/attr/diff",
+                self.get_attr_diff_stub,
+            ),
+            Route("GET", r"/debug/vars", self.get_debug_vars),
+        ]
+
+    # -- route handlers --
+
+    def post_query(self, req) -> dict:
+        index = req.params["index"]
+        q = req.query
+        body = req.body.decode() if req.body else ""
+        shards = None
+        if "shards" in q:
+            shards = [int(s) for s in q["shards"][0].split(",") if s != ""]
+        t0 = time.monotonic()
+        resp = self.api.query(
+            index,
+            body,
+            shards=shards,
+            remote=q.get("remote", ["false"])[0] == "true",
+            exclude_row_attrs=q.get("excludeRowAttrs", ["false"])[0] == "true",
+            exclude_columns=q.get("excludeColumns", ["false"])[0] == "true",
+            column_attrs=q.get("columnAttrs", ["false"])[0] == "true",
+        )
+        dur = time.monotonic() - t0
+        # slow-query logging (reference handler.go:257-261)
+        if self.long_query_time and dur > self.long_query_time and self.logger:
+            self.logger.printf("%.3fs SLOW QUERY %s %s", dur, index, body[:500])
+            self.stats.count("slow_query", 1)
+        self.stats.with_tags(f"index:{index}").timing("query_time", dur)
+        out = {"results": [encode_result(r) for r in resp["results"]]}
+        if "columnAttrs" in resp:
+            out["columnAttrs"] = resp["columnAttrs"]
+        return out
+
+    def get_index(self, req) -> dict:
+        for ischema in self.api.schema():
+            if ischema["name"] == req.params["index"]:
+                return ischema
+        raise APIError(f"index not found: {req.params['index']}", status=404)
+
+    def post_index(self, req) -> dict:
+        body = json.loads(req.body or b"{}")
+        opts = body.get("options", {})
+        self.api.create_index(req.params["index"], keys=opts.get("keys", False))
+        return {}
+
+    def delete_index(self, req) -> dict:
+        self.api.delete_index(req.params["index"])
+        return {}
+
+    def post_field(self, req) -> dict:
+        body = json.loads(req.body or b"{}")
+        self.api.create_field(
+            req.params["index"], req.params["field"], body.get("options", {})
+        )
+        return {}
+
+    def delete_field(self, req) -> dict:
+        self.api.delete_field(req.params["index"], req.params["field"])
+        return {}
+
+    def post_import(self, req) -> dict:
+        body = json.loads(req.body or b"{}")
+        self.api.import_bits(
+            req.params["index"],
+            req.params["field"],
+            body.get("rowIDs", []),
+            body.get("columnIDs", []),
+            timestamps=body.get("timestamps"),
+            row_keys=body.get("rowKeys"),
+            column_keys=body.get("columnKeys"),
+        )
+        return {}
+
+    def post_import_value(self, req) -> dict:
+        body = json.loads(req.body or b"{}")
+        self.api.import_values(
+            req.params["index"],
+            req.params["field"],
+            body.get("columnIDs", []),
+            body.get("values", []),
+            column_keys=body.get("columnKeys"),
+        )
+        return {}
+
+    def get_views(self, req) -> dict:
+        return {"views": self.api.views(req.params["index"], req.params["field"])}
+
+    def delete_view(self, req) -> dict:
+        self.api.delete_view(
+            req.params["index"], req.params["field"], req.params["view"]
+        )
+        return {}
+
+    def get_export(self, req):
+        q = req.query
+        csv_text = self.api.export_csv(
+            q["index"][0], q["field"][0], int(q["shard"][0])
+        )
+        return RawResponse(csv_text.encode(), "text/csv")
+
+    def post_recalculate_caches(self, req) -> dict:
+        self.api.recalculate_caches()
+        return {}
+
+    def post_set_coordinator(self, req) -> dict:
+        body = json.loads(req.body or b"{}")
+        self.api.set_coordinator(body.get("id", ""))
+        return {}
+
+    def post_remove_node(self, req) -> dict:
+        body = json.loads(req.body or b"{}")
+        self.api.remove_node(body.get("id", ""))
+        return {}
+
+    def post_resize_abort(self, req) -> dict:
+        self.api.resize_abort()
+        return {}
+
+    def post_cluster_message(self, req) -> dict:
+        self.api.cluster_message(json.loads(req.body or b"{}"))
+        return {}
+
+    def get_fragment_nodes(self, req) -> list:
+        q = req.query
+        return self.api.shard_nodes(q["index"][0], int(q["shard"][0]))
+
+    def get_fragment_blocks(self, req) -> dict:
+        q = req.query
+        return {
+            "blocks": self.api.fragment_blocks(
+                q["index"][0], q["field"][0], int(q["shard"][0])
+            )
+        }
+
+    def get_block_data(self, req) -> dict:
+        q = req.query
+        return self.api.fragment_block_data(
+            q["index"][0],
+            q["field"][0],
+            q.get("view", ["standard"])[0],
+            int(q["shard"][0]),
+            int(q["block"][0]),
+        )
+
+    def get_fragment_data(self, req):
+        q = req.query
+        data = self.api.marshal_fragment(
+            q["index"][0],
+            q["field"][0],
+            q.get("view", ["standard"])[0],
+            int(q["shard"][0]),
+        )
+        return RawResponse(data, "application/octet-stream")
+
+    def post_fragment_data(self, req) -> dict:
+        q = req.query
+        self.api.unmarshal_fragment(
+            q["index"][0],
+            q["field"][0],
+            q.get("view", ["standard"])[0],
+            int(q["shard"][0]),
+            req.body,
+        )
+        return {}
+
+    def get_translate_data(self, req):
+        q = req.query
+        data = self.api.get_translate_data(int(q.get("offset", ["0"])[0]))
+        return RawResponse(data, "application/octet-stream")
+
+    def get_attr_diff_stub(self, req) -> dict:
+        return {"attrs": {}}
+
+    def get_debug_vars(self, req) -> dict:
+        if hasattr(self.stats, "snapshot"):
+            return self.stats.snapshot()
+        return {}
+
+    # -- dispatch --
+
+    def handle(self, method: str, path: str, query: dict, body: bytes):
+        for route in self.routes:
+            if route.method != method:
+                continue
+            m = route.re.match(path)
+            if m:
+                req = Request(m.groupdict(), query, body)
+                return route.fn(req)
+        raise APIError(f"no route for {method} {path}", status=404)
+
+
+class Request:
+    def __init__(self, params: dict, query: dict, body: bytes) -> None:
+        self.params = params
+        self.query = query
+        self.body = body
+
+
+class RawResponse:
+    def __init__(self, data: bytes, content_type: str) -> None:
+        self.data = data
+        self.content_type = content_type
+
+
+def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
+    """Build a ThreadingHTTPServer around the routing table."""
+
+    class _Req(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # silence default stderr logging
+            if handler.logger:
+                handler.logger.debugf(fmt, *args)
+
+        def _run(self, method: str):
+            parsed = urlparse(self.path)
+            body = b""
+            length = int(self.headers.get("Content-Length") or 0)
+            if length:
+                body = self.rfile.read(length)
+            try:
+                result = handler.handle(
+                    method, parsed.path, parse_qs(parsed.query), body
+                )
+                if isinstance(result, RawResponse):
+                    payload = result.data
+                    ctype = result.content_type
+                else:
+                    payload = json.dumps(result).encode()
+                    ctype = "application/json"
+                self.send_response(200)
+            except APIError as e:
+                payload = json.dumps({"error": str(e)}).encode()
+                ctype = "application/json"
+                self.send_response(e.status)
+            except Exception as e:  # panic recovery (reference ServeHTTP:239-276)
+                traceback.print_exc()
+                payload = json.dumps({"error": f"internal error: {e}"}).encode()
+                ctype = "application/json"
+                self.send_response(500)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def do_GET(self):
+            self._run("GET")
+
+        def do_POST(self):
+            self._run("POST")
+
+        def do_DELETE(self):
+            self._run("DELETE")
+
+    return ThreadingHTTPServer((host, port), _Req)

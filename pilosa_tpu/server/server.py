@@ -1,0 +1,156 @@
+"""Server runtime (L7) — wires holder/executor/API/HTTP + background
+loops (reference server.go / server/server.go Command).
+
+Single-node mode runs with cluster=None (the reference's
+``cluster.disabled`` static mode); the cluster layer plugs in through
+the same seams the reference uses: a broadcaster (send_sync/send_async),
+a message receiver, and the executor's cluster hook.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.executor import DeviceStager, Executor
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.config import Config
+from pilosa_tpu.server.http_handler import Handler, make_http_server
+from pilosa_tpu.utils.attrstore import new_attr_store
+from pilosa_tpu.utils.logger import NOP_LOGGER, StandardLogger
+from pilosa_tpu.utils.stats import ExpvarStatsClient, NOP_STATS
+from pilosa_tpu.utils.translate import TranslateStore
+
+
+class Server:
+    def __init__(self, config: Optional[Config] = None, cluster=None) -> None:
+        self.config = config or Config()
+        data_dir = os.path.expanduser(self.config.data_dir)
+        self.logger = (
+            StandardLogger(verbose=self.config.verbose)
+            if self.config.log_path != "nop"
+            else NOP_LOGGER
+        )
+        self.stats = (
+            ExpvarStatsClient() if self.config.metric == "expvar" else NOP_STATS
+        )
+        self.holder = Holder(data_dir, new_attr_store=new_attr_store)
+        self.translate_store = TranslateStore(os.path.join(data_dir, ".keys"))
+        self.cluster = cluster
+        self.stager = DeviceStager(budget_bytes=self.config.stager_budget_bytes)
+        self.executor = Executor(
+            self.holder,
+            cluster=cluster,
+            stager=self.stager,
+            device_policy=self.config.device_policy,
+            translate_store=self.translate_store,
+            max_writes_per_request=self.config.max_writes_per_request,
+        )
+        self.api = API(self.holder, self.executor, cluster=cluster, server=self)
+        self.handler = Handler(
+            self.api,
+            logger=self.logger,
+            stats=self.stats,
+            long_query_time=self.config.cluster.long_query_time,
+        )
+        self.httpd = None
+        self._serve_thread: Optional[threading.Thread] = None
+        self.node_id: str = ""
+        self._closed = threading.Event()
+
+    # -- lifecycle (reference Server.Open:312) --
+
+    def open(self) -> None:
+        self.holder.open()
+        self.node_id = self.holder.load_node_id()
+        if self.cluster is not None:
+            self.cluster.attach_server(self)
+        self.httpd = make_http_server(
+            self.handler, self.config.host, self.config.port
+        )
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self._serve_thread.start()
+        self.logger.printf(
+            "pilosa_tpu server listening on http://%s:%d", *self.address()
+        )
+
+    def address(self) -> tuple[str, int]:
+        if self.httpd is None:
+            return (self.config.host, self.config.port)
+        return self.httpd.server_address[:2]
+
+    @property
+    def uri(self) -> str:
+        host, port = self.address()
+        return f"http://{host}:{port}"
+
+    def close(self) -> None:
+        self._closed.set()
+        if self.httpd is not None:
+            self.httpd.shutdown()
+            self.httpd.server_close()
+        if self.cluster is not None:
+            self.cluster.close()
+        self.holder.close()
+        self.translate_store.close()
+
+    # -- broadcaster seam (reference broadcast.go:27-31) --
+
+    def send_sync(self, msg: dict) -> None:
+        if self.cluster is not None:
+            self.cluster.send_sync(msg)
+
+    def send_async(self, msg: dict) -> None:
+        if self.cluster is not None:
+            self.cluster.send_async(msg)
+
+    def send_to(self, node, msg: dict) -> None:
+        if self.cluster is not None:
+            self.cluster.send_to(node, msg)
+
+    # -- message application (reference Server.ReceiveMessage:435-517) --
+
+    def receive_message(self, msg: dict) -> None:
+        typ = msg.get("type")
+        if typ == "create-index":
+            self.holder.create_index_if_not_exists(
+                msg["index"], msg.get("keys", False)
+            )
+        elif typ == "delete-index":
+            try:
+                self.holder.delete_index(msg["index"])
+            except ValueError:
+                pass
+        elif typ == "create-field":
+            from pilosa_tpu.core.field import FieldOptions
+
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                idx.create_field_if_not_exists(
+                    msg["field"], FieldOptions.from_dict(msg.get("options", {}))
+                )
+        elif typ == "delete-field":
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                try:
+                    idx.delete_field(msg["field"])
+                except ValueError:
+                    pass
+        elif typ == "create-shard":
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                idx.set_remote_max_shard(msg["shard"])
+        elif typ == "recalculate-caches":
+            for idx in self.holder.indexes.values():
+                for f in idx.fields.values():
+                    for v in f.views.values():
+                        for frag in v.fragments.values():
+                            frag.cache.recalculate()
+        elif typ == "schema":
+            self.holder.apply_schema(msg.get("schema", []))
+        elif self.cluster is not None:
+            self.cluster.receive_message(msg)

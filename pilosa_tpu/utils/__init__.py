@@ -1,0 +1,25 @@
+"""Auxiliary components: attr stores, key translation, stats, logging."""
+
+from pilosa_tpu.utils.attrstore import ATTR_BLOCK_SIZE, AttrStore, new_attr_store
+from pilosa_tpu.utils.logger import NOP_LOGGER, NopLogger, StandardLogger
+from pilosa_tpu.utils.stats import (
+    ExpvarStatsClient,
+    MultiStatsClient,
+    NOP_STATS,
+    NopStatsClient,
+)
+from pilosa_tpu.utils.translate import TranslateStore
+
+__all__ = [
+    "ATTR_BLOCK_SIZE",
+    "AttrStore",
+    "ExpvarStatsClient",
+    "MultiStatsClient",
+    "NOP_LOGGER",
+    "NOP_STATS",
+    "NopLogger",
+    "NopStatsClient",
+    "StandardLogger",
+    "TranslateStore",
+    "new_attr_store",
+]

@@ -1,0 +1,126 @@
+"""Attribute store — arbitrary metadata k/v per row/column id.
+
+The reference stores attrs in BoltDB with an in-memory cache and
+100-id block checksums for anti-entropy diffing (reference attr.go,
+boltdb/attrstore.go). Here: an in-memory dict with an append-only JSONL
+log for durability and the same block-checksum diff protocol.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Optional
+
+ATTR_BLOCK_SIZE = 100  # reference attrBlockSize (boltdb/attrstore.go)
+
+
+class AttrStore:
+    def __init__(self, path: Optional[str] = None) -> None:
+        self.path = path
+        self._attrs: dict[int, dict] = {}
+        self.mu = threading.RLock()
+        self._log = None
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._replay()
+            self._log = open(path, "a")
+
+    def _replay(self) -> None:
+        try:
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    entry = json.loads(line)
+                    self._merge(int(entry["id"]), entry["attrs"])
+        except FileNotFoundError:
+            pass
+
+    def close(self) -> None:
+        if self._log:
+            self._log.close()
+            self._log = None
+
+    def _merge(self, id_: int, new_attrs: dict) -> dict:
+        cur = self._attrs.get(id_, {}).copy()
+        for k, v in new_attrs.items():
+            if v is None:
+                cur.pop(k, None)
+            else:
+                cur[k] = v
+        self._attrs[id_] = cur
+        return cur
+
+    # -- interface (reference attr.go:34-43) --
+
+    def attrs(self, id_: int) -> dict:
+        with self.mu:
+            return self._attrs.get(id_, {})
+
+    def set_attrs(self, id_: int, attrs: dict) -> None:
+        with self.mu:
+            self._merge(id_, attrs)
+            if self._log:
+                self._log.write(json.dumps({"id": id_, "attrs": attrs}) + "\n")
+                self._log.flush()
+
+    def set_bulk_attrs(self, attrs_by_id: dict[int, dict]) -> None:
+        with self.mu:
+            for id_, attrs in attrs_by_id.items():
+                self._merge(id_, attrs)
+                if self._log:
+                    self._log.write(json.dumps({"id": id_, "attrs": attrs}) + "\n")
+            if self._log:
+                self._log.flush()
+
+    def ids(self) -> list[int]:
+        with self.mu:
+            return sorted(self._attrs)
+
+    # -- anti-entropy blocks (reference AttrBlocks / Diff, attr.go:90-120) --
+
+    def blocks(self) -> list[tuple[int, bytes]]:
+        with self.mu:
+            by_block: dict[int, hashlib.blake2b] = {}
+            for id_ in sorted(self._attrs):
+                block = id_ // ATTR_BLOCK_SIZE
+                h = by_block.get(block)
+                if h is None:
+                    h = hashlib.blake2b(digest_size=16)
+                    by_block[block] = h
+                h.update(id_.to_bytes(8, "little"))
+                h.update(
+                    json.dumps(self._attrs[id_], sort_keys=True).encode()
+                )
+            return [(b, by_block[b].digest()) for b in sorted(by_block)]
+
+    def block_data(self, block_id: int) -> dict[int, dict]:
+        with self.mu:
+            lo = block_id * ATTR_BLOCK_SIZE
+            hi = lo + ATTR_BLOCK_SIZE
+            return {
+                id_: attrs.copy()
+                for id_, attrs in self._attrs.items()
+                if lo <= id_ < hi
+            }
+
+    @staticmethod
+    def diff_blocks(
+        mine: list[tuple[int, bytes]], theirs: list[tuple[int, bytes]]
+    ) -> list[int]:
+        """Block ids present/differing on their side that we must fetch."""
+        m = dict(mine)
+        out = []
+        for block, digest in theirs:
+            if m.get(block) != digest:
+                out.append(block)
+        return out
+
+
+def new_attr_store(path: Optional[str]):
+    """Factory handed to Holder/Index (store per field/index)."""
+    return AttrStore(path)

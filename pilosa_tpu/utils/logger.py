@@ -1,0 +1,40 @@
+"""Logger interface (reference logger.go): printf/debugf with nop,
+standard, and verbose implementations."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+class NopLogger:
+    def printf(self, fmt: str, *args) -> None:
+        pass
+
+    def debugf(self, fmt: str, *args) -> None:
+        pass
+
+
+class StandardLogger:
+    def __init__(self, stream=None, verbose: bool = False) -> None:
+        self.stream = stream or sys.stderr
+        self.verbose = verbose
+
+    def _emit(self, fmt: str, *args) -> None:
+        ts = time.strftime("%Y-%m-%d %H:%M:%S")
+        try:
+            msg = (fmt % args) if args else fmt
+        except TypeError:
+            msg = " ".join([fmt] + [str(a) for a in args])
+        self.stream.write(f"{ts} {msg}\n")
+        self.stream.flush()
+
+    def printf(self, fmt: str, *args) -> None:
+        self._emit(fmt, *args)
+
+    def debugf(self, fmt: str, *args) -> None:
+        if self.verbose:
+            self._emit(fmt, *args)
+
+
+NOP_LOGGER = NopLogger()

@@ -1,0 +1,116 @@
+"""Stats clients (reference stats.go): count/gauge/histogram/set/timing
+with tag propagation. Expvar-style in-process aggregation plus nop and
+multi fan-out implementations."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class NopStatsClient:
+    def tags(self) -> list[str]:
+        return []
+
+    def with_tags(self, *tags: str) -> "NopStatsClient":
+        return self
+
+    def count(self, name: str, value: int = 1, rate: float = 1.0) -> None:
+        pass
+
+    def gauge(self, name: str, value: float, rate: float = 1.0) -> None:
+        pass
+
+    def histogram(self, name: str, value: float, rate: float = 1.0) -> None:
+        pass
+
+    def set(self, name: str, value: str, rate: float = 1.0) -> None:
+        pass
+
+    def timing(self, name: str, value: float, rate: float = 1.0) -> None:
+        pass
+
+
+NOP_STATS = NopStatsClient()
+
+
+class ExpvarStatsClient:
+    """In-process aggregation exposed at /debug/vars (reference
+    stats.go:86-163)."""
+
+    def __init__(self, tags: Optional[list[str]] = None, root: Optional[dict] = None) -> None:
+        self._tags = tags or []
+        self._root = root if root is not None else {}
+        self._mu = threading.Lock()
+
+    def tags(self) -> list[str]:
+        return self._tags
+
+    def with_tags(self, *tags: str) -> "ExpvarStatsClient":
+        return ExpvarStatsClient(sorted(set(self._tags) | set(tags)), self._root)
+
+    def _key(self, name: str) -> str:
+        if self._tags:
+            return f"{name};{','.join(self._tags)}"
+        return name
+
+    def count(self, name: str, value: int = 1, rate: float = 1.0) -> None:
+        with self._mu:
+            k = self._key(name)
+            self._root[k] = self._root.get(k, 0) + value
+
+    def gauge(self, name: str, value: float, rate: float = 1.0) -> None:
+        with self._mu:
+            self._root[self._key(name)] = value
+
+    def histogram(self, name: str, value: float, rate: float = 1.0) -> None:
+        with self._mu:
+            k = self._key(name) + ".hist"
+            h = self._root.setdefault(k, {"count": 0, "sum": 0.0, "min": None, "max": None})
+            h["count"] += 1
+            h["sum"] += value
+            h["min"] = value if h["min"] is None else min(h["min"], value)
+            h["max"] = value if h["max"] is None else max(h["max"], value)
+
+    def set(self, name: str, value: str, rate: float = 1.0) -> None:
+        with self._mu:
+            self._root[self._key(name)] = value
+
+    def timing(self, name: str, value: float, rate: float = 1.0) -> None:
+        self.histogram(name + ".timing", value, rate)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return dict(self._root)
+
+
+class MultiStatsClient:
+    def __init__(self, *clients) -> None:
+        self.clients = list(clients)
+
+    def tags(self) -> list[str]:
+        return self.clients[0].tags() if self.clients else []
+
+    def with_tags(self, *tags: str) -> "MultiStatsClient":
+        return MultiStatsClient(*(c.with_tags(*tags) for c in self.clients))
+
+    def count(self, name, value=1, rate=1.0):
+        for c in self.clients:
+            c.count(name, value, rate)
+
+    def gauge(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.gauge(name, value, rate)
+
+    def histogram(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.histogram(name, value, rate)
+
+    def set(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.set(name, value, rate)
+
+    def timing(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.timing(name, value, rate)

@@ -1,0 +1,123 @@
+"""Key ↔ id translation store (reference translate.go).
+
+Maps string keys to dense uint64 ids per index (columns) and per
+(index, field) (rows). The reference uses an append-only WAL plus an
+mmapped robin-hood hash; here: dicts + the same append-only WAL replay
+discipline, with a monotonically increasing offset so replicas can
+stream the log (reference TranslateFile primary/replica replication).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Iterable, Optional, Sequence
+
+
+class TranslateStore:
+    def __init__(self, path: Optional[str] = None) -> None:
+        self.path = path
+        self.mu = threading.RLock()
+        # (index, field) -> {key: id}; field "" = column keys
+        self._fwd: dict[tuple[str, str], dict[str, int]] = {}
+        self._rev: dict[tuple[str, str], dict[int, str]] = {}
+        self._log = None
+        self._offset = 0
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._replay()
+            self._log = open(path, "a")
+
+    def _replay(self) -> None:
+        try:
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    e = json.loads(line)
+                    self._assign(e["index"], e.get("field", ""), e["key"], e["id"])
+                    self._offset += len(line) + 1
+        except FileNotFoundError:
+            pass
+
+    def close(self) -> None:
+        if self._log:
+            self._log.close()
+            self._log = None
+
+    def _assign(self, index: str, field: str, key: str, id_: int) -> None:
+        k = (index, field)
+        fwd = self._fwd.setdefault(k, {})
+        rev = self._rev.setdefault(k, {})
+        fwd[key] = id_
+        rev[id_] = key
+
+    def _translate(self, index: str, field: str, keys: Sequence[str], create: bool) -> list[Optional[int]]:
+        with self.mu:
+            k = (index, field)
+            fwd = self._fwd.setdefault(k, {})
+            out: list[Optional[int]] = []
+            for key in keys:
+                id_ = fwd.get(key)
+                if id_ is None:
+                    if not create:
+                        out.append(None)
+                        continue
+                    id_ = len(fwd) + 1  # ids start at 1 (reference semantics)
+                    self._assign(index, field, key, id_)
+                    if self._log:
+                        line = json.dumps(
+                            {"index": index, "field": field, "key": key, "id": id_}
+                        )
+                        self._log.write(line + "\n")
+                        self._log.flush()
+                        self._offset += len(line) + 1
+                out.append(id_)
+            return out
+
+    # -- interface (reference translate.go:38-48) --
+
+    def translate_columns_to_ids(self, index: str, keys: Sequence[str], create: bool = True):
+        return self._translate(index, "", keys, create)
+
+    def translate_column_to_string(self, index: str, id_: int) -> Optional[str]:
+        with self.mu:
+            return self._rev.get((index, ""), {}).get(id_)
+
+    def translate_rows_to_ids(self, index: str, field: str, keys: Sequence[str], create: bool = True):
+        return self._translate(index, field, keys, create)
+
+    def translate_row_to_string(self, index: str, field: str, id_: int) -> Optional[str]:
+        with self.mu:
+            return self._rev.get((index, field), {}).get(id_)
+
+    # -- replication streaming (reference monitorReplication:259-310) --
+
+    def offset(self) -> int:
+        return self._offset
+
+    def read_from(self, offset: int) -> tuple[bytes, int]:
+        """Raw WAL bytes from offset (for replica pull)."""
+        if not self.path:
+            return b"", self._offset
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            data = f.read()
+        return data, offset + len(data)
+
+    def apply_log(self, data: bytes) -> None:
+        """Apply WAL bytes pulled from a primary."""
+        with self.mu:
+            for line in data.decode().splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                e = json.loads(line)
+                self._assign(e["index"], e.get("field", ""), e["key"], e["id"])
+                if self._log:
+                    self._log.write(line + "\n")
+            if self._log:
+                self._log.flush()
+            self._offset += len(data)

@@ -1,15 +1,22 @@
 """Test configuration: force an 8-device virtual CPU mesh.
 
-Multi-chip shardings are validated on virtual CPU devices
-(xla_force_host_platform_device_count); real-TPU benchmarking happens in
-bench.py, not the test suite.
+The environment registers a real-TPU backend at interpreter startup
+(sitecustomize calls jax.config.update("jax_platforms", "axon,cpu"),
+which overrides the JAX_PLATFORMS env var). Tests must hard-override to
+CPU *before* any jax backend initialisation so the suite never depends
+on TPU-tunnel health. Multi-chip shardings are validated on 8 virtual
+CPU devices; real-TPU benchmarking happens in bench.py, not here.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

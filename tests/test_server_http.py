@@ -1,0 +1,270 @@
+"""End-to-end HTTP server tests — boots a real server on :0 and drives
+the reference's getting-started 'Star Trace' workflow over REST
+(mirrors reference server/handler_test.go TestHandler_Endpoints)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.server import Config, Server
+
+
+@pytest.fixture()
+def server(tmp_path):
+    cfg = Config(data_dir=str(tmp_path / "data"), bind="127.0.0.1:0", metric="expvar")
+    s = Server(cfg)
+    s.open()
+    yield s
+    s.close()
+
+
+def req(server, method, path, body=None, raw=False):
+    url = server.uri + path
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    r = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(r) as resp:
+            payload = resp.read()
+            return resp.status, payload if raw else json.loads(payload or b"{}")
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, payload if raw else json.loads(payload or b"{}")
+
+
+def test_version_info_status(server):
+    st, body = req(server, "GET", "/version")
+    assert st == 200 and "version" in body
+    st, body = req(server, "GET", "/info")
+    assert st == 200 and body["shardWidth"] == 1 << 20
+    st, body = req(server, "GET", "/status")
+    assert st == 200 and body["state"] == "NORMAL"
+
+
+def test_star_trace_workflow(server):
+    # schema
+    st, _ = req(server, "POST", "/index/repository", {})
+    assert st == 200
+    st, _ = req(
+        server, "POST", "/index/repository/field/stargazer",
+        {"options": {"type": "time", "timeQuantum": "YMD"}},
+    )
+    assert st == 200
+    st, _ = req(
+        server, "POST", "/index/repository/field/language", {"options": {}}
+    )
+    assert st == 200
+
+    # writes
+    st, body = req(
+        server, "POST", "/index/repository/query", b"Set(10, stargazer=1)"
+    )
+    assert st == 200 and body == {"results": [True]}
+    for q in [
+        "Set(20, stargazer=1)",
+        "Set(10, stargazer=2)",
+        "Set(30, stargazer=2)",
+        "Set(10, language=5)",
+        "Set(20, language=5)",
+        "Set(10, stargazer=3, 2017-05-01T00:00)",
+    ]:
+        st, body = req(server, "POST", "/index/repository/query", q.encode())
+        assert st == 200, body
+
+    # reads
+    st, body = req(server, "POST", "/index/repository/query", b"Row(stargazer=1)")
+    assert st == 200
+    assert body["results"][0]["columns"] == [10, 20]
+    st, body = req(
+        server,
+        "POST",
+        "/index/repository/query",
+        b"Intersect(Row(stargazer=1), Row(stargazer=2))",
+    )
+    assert body["results"][0]["columns"] == [10]
+    st, body = req(
+        server, "POST", "/index/repository/query", b"Count(Row(stargazer=2))"
+    )
+    assert body["results"][0] == 2
+    # the rank cache debounces recalculation (reference cache.go:233-241);
+    # force it like the reference's own tests do before TopN assertions
+    req(server, "POST", "/recalculate-caches")
+    st, body = req(
+        server, "POST", "/index/repository/query", b"TopN(stargazer, n=2)"
+    )
+    assert body["results"][0] == [
+        {"id": 1, "count": 2},
+        {"id": 2, "count": 2},
+    ]
+    # time range
+    st, body = req(
+        server,
+        "POST",
+        "/index/repository/query",
+        b"Range(stargazer=3, 2017-01-01T00:00, 2018-01-01T00:00)",
+    )
+    assert body["results"][0]["columns"] == [10]
+
+    # schema reflects everything
+    st, body = req(server, "GET", "/schema")
+    idx = body["indexes"][0]
+    assert idx["name"] == "repository"
+    assert {f["name"] for f in idx["fields"]} == {"stargazer", "language"}
+
+
+def test_bsi_over_http(server):
+    req(server, "POST", "/index/i", {})
+    req(
+        server, "POST", "/index/i/field/bytes",
+        {"options": {"type": "int", "min": 0, "max": 1000000}},
+    )
+    for col, v in [(1, 100), (2, 2000), (3, 30000)]:
+        st, body = req(
+            server, "POST", "/index/i/query",
+            f"SetValue(col={col}, bytes={v})".encode(),
+        )
+        assert st == 200, body
+    st, body = req(server, "POST", "/index/i/query", b'Sum(field="bytes")')
+    assert body["results"][0] == {"value": 32100, "count": 3}
+    st, body = req(server, "POST", "/index/i/query", b"Range(bytes > 1000)")
+    assert body["results"][0]["columns"] == [2, 3]
+
+
+def test_import_and_export(server):
+    req(server, "POST", "/index/i", {})
+    req(server, "POST", "/index/i/field/f", {})
+    st, body = req(
+        server,
+        "POST",
+        "/index/i/field/f/import",
+        {"rowIDs": [1, 1, 2], "columnIDs": [100, 200, 100]},
+    )
+    assert st == 200
+    st, body = req(server, "POST", "/index/i/query", b"Row(f=1)")
+    assert body["results"][0]["columns"] == [100, 200]
+    st, csv_data = req(server, "GET", "/export?index=i&field=f&shard=0", raw=True)
+    assert st == 200
+    lines = sorted(csv_data.decode().strip().splitlines())
+    assert lines == ["1,100", "1,200", "2,100"]
+
+
+def test_import_values(server):
+    req(server, "POST", "/index/i", {})
+    req(
+        server, "POST", "/index/i/field/v",
+        {"options": {"type": "int", "min": -10, "max": 10}},
+    )
+    st, _ = req(
+        server,
+        "POST",
+        "/index/i/field/v/import-value",
+        {"columnIDs": [1, 2, 3], "values": [-5, 0, 7]},
+    )
+    assert st == 200
+    st, body = req(server, "POST", "/index/i/query", b'Sum(field="v")')
+    assert body["results"][0] == {"value": 2, "count": 3}
+    st, body = req(server, "POST", "/index/i/query", b'Min(field="v")')
+    assert body["results"][0] == {"value": -5, "count": 1}
+
+
+def test_attrs(server):
+    req(server, "POST", "/index/i", {})
+    req(server, "POST", "/index/i/field/f", {})
+    st, body = req(
+        server, "POST", "/index/i/query",
+        b'Set(1, f=10)SetRowAttrs(f, 10, category="search")SetColumnAttrs(1, name="acme")',
+    )
+    assert st == 200, body
+    st, body = req(server, "POST", "/index/i/query", b"Row(f=10)")
+    assert body["results"][0]["attrs"] == {"category": "search"}
+    st, body = req(
+        server, "POST", "/index/i/query?columnAttrs=true", b"Row(f=10)"
+    )
+    assert body["columnAttrs"] == [{"id": 1, "attrs": {"name": "acme"}}]
+
+
+def test_key_translation(server):
+    req(server, "POST", "/index/users", {"options": {"keys": True}})
+    req(
+        server, "POST", "/index/users/field/likes",
+        {"options": {"keys": True}},
+    )
+    st, body = req(
+        server, "POST", "/index/users/query", b'Set("alice", likes="pizza")'
+    )
+    assert st == 200 and body["results"] == [True]
+    req(server, "POST", "/index/users/query", b'Set("bob", likes="pizza")')
+    st, body = req(server, "POST", "/index/users/query", b'Row(likes="pizza")')
+    assert body["results"][0]["keys"] == ["alice", "bob"]
+    st, body = req(server, "POST", "/index/users/query", b'TopN(likes, n=5)')
+    assert body["results"][0] == [{"key": "pizza", "count": 2}]
+
+
+def test_error_handling(server):
+    st, body = req(server, "POST", "/index/nope/query", b"Row(f=1)")
+    assert st == 404 and "error" in body
+    st, body = req(server, "POST", "/index/i", {})
+    st, body = req(server, "POST", "/index/i", {})
+    assert st == 409
+    st, body = req(server, "POST", "/index/i/query", b"BadCall(")
+    assert st == 400 and "error" in body
+    st, body = req(server, "GET", "/no/such/route")
+    assert st == 404
+
+
+def test_persistence_across_restart(tmp_path):
+    cfg = Config(data_dir=str(tmp_path / "data"), bind="127.0.0.1:0")
+    s = Server(cfg)
+    s.open()
+    req(s, "POST", "/index/i", {})
+    req(s, "POST", "/index/i/field/f", {})
+    req(s, "POST", "/index/i/query", b"Set(7, f=1)")
+    node_id = s.node_id
+    s.close()
+
+    s2 = Server(Config(data_dir=str(tmp_path / "data"), bind="127.0.0.1:0"))
+    s2.open()
+    try:
+        assert s2.node_id == node_id
+        st, body = req(s2, "POST", "/index/i/query", b"Row(f=1)")
+        assert body["results"][0]["columns"] == [7]
+    finally:
+        s2.close()
+
+
+def test_debug_vars_and_recalculate(server):
+    req(server, "POST", "/index/i", {})
+    req(server, "POST", "/index/i/field/f", {})
+    req(server, "POST", "/index/i/query", b"Set(1, f=1)")
+    st, _ = req(server, "POST", "/recalculate-caches")
+    assert st == 200
+    st, body = req(server, "GET", "/debug/vars")
+    assert st == 200
+
+
+def test_fragment_data_roundtrip(server):
+    req(server, "POST", "/index/i", {})
+    req(server, "POST", "/index/i/field/f", {})
+    req(server, "POST", "/index/i/query", b"Set(1, f=1)Set(2, f=1)")
+    st, data = req(
+        server, "GET", "/internal/fragment/data?index=i&field=f&shard=0", raw=True
+    )
+    assert st == 200
+    # blocks endpoint
+    st, body = req(
+        server, "GET", "/internal/fragment/blocks?index=i&field=f&shard=0"
+    )
+    assert st == 200 and len(body["blocks"]) == 1
+    # restore into a second field
+    req(server, "POST", "/index/i/field/g", {})
+    st, _ = req(
+        server,
+        "POST",
+        "/internal/fragment/data?index=i&field=g&shard=0",
+        data,
+    )
+    assert st == 200
+    st, body = req(server, "POST", "/index/i/query", b"Row(g=1)")
+    assert body["results"][0]["columns"] == [1, 2]
