@@ -1,0 +1,25 @@
+"""Distribution layer (L5): SPMD device-mesh execution + cluster."""
+
+from pilosa_tpu.parallel.spmd import (
+    SHARD_AXIS,
+    ShardBatchPlan,
+    bsi_sum_spmd,
+    count_fold_spmd,
+    make_mesh,
+    put_sharded,
+    row_algebra_spmd,
+    shard_spec,
+    topn_spmd,
+)
+
+__all__ = [
+    "SHARD_AXIS",
+    "ShardBatchPlan",
+    "bsi_sum_spmd",
+    "count_fold_spmd",
+    "make_mesh",
+    "put_sharded",
+    "row_algebra_spmd",
+    "shard_spec",
+    "topn_spmd",
+]

@@ -1,0 +1,193 @@
+"""SPMD query execution over a device mesh (L5 compute plane).
+
+The reference distributes per-shard work with one goroutine per shard
+and HTTP scatter-gather between nodes (reference executor.go:1444-1593,
+http/client.go). On TPU the same distribution is a *sharding*: fragments
+stack into ``uint32[shards, rows, words]`` laid out over a 1-D mesh
+axis ``"shards"`` and the cross-shard reduce runs as XLA collectives
+inside the compiled program — ``psum`` over ICI for Count/Sum (the
+reference's uint64-sum reduceFn), ``all_gather`` for TopN candidate
+sets (the reference's Pairs.Add merge) — instead of HTTP fan-out.
+
+The only parallel axis of a bitmap index is the shard (column) axis:
+SURVEY.md §2.5 — data parallelism = shard partitioning; rows are never
+split. Tensor/pipeline parallelism have no analog here; the mesh is 1-D
+by design, scaling to multi-host by making the "shards" axis span hosts
+(DCN hops ride the same collectives).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shards"
+
+
+def make_mesh(devices=None) -> Mesh:
+    """1-D mesh over the shard axis."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.array(devices), (SHARD_AXIS,))
+
+
+def shard_spec() -> P:
+    return P(SHARD_AXIS)
+
+
+def put_sharded(mesh: Mesh, arr: np.ndarray):
+    """Place a [S, ...] host array with the leading dim split over the
+    mesh — the HBM staging step for a shard batch."""
+    return jax.device_put(arr, NamedSharding(mesh, P(SHARD_AXIS)))
+
+
+# -- SPMD kernels ------------------------------------------------------------
+# Each takes shard-major stacked operands. Written against shard_map so
+# the collective structure is explicit (psum/all_gather over ICI).
+
+
+def count_fold_spmd(mesh: Mesh):
+    """Count(Intersect(rows...)) over all shards in one program.
+
+    stacked: u32[S, K, W] (K child rows per shard) -> i32 global count.
+    AND-fold + popcount locally, then psum over the shard axis — the
+    reference's executeCount sum-reduce (executor.go:966-996) as an ICI
+    collective.
+    """
+
+    def kernel(block):  # block: u32[s_local, K, W] per device
+        folded = jax.lax.reduce(
+            block, jnp.uint32(0xFFFFFFFF), jnp.bitwise_and, (1,)
+        )  # [s_local, W]
+        local = jnp.sum(jax.lax.population_count(folded).astype(jnp.int32))
+        return jax.lax.psum(local, SHARD_AXIS)
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(SHARD_AXIS),),
+            out_specs=P(),
+        )
+    )
+
+
+def topn_spmd(mesh: Mesh, k: int):
+    """TopN candidate generation over all shards in one program.
+
+    src: u32[S, W]; mat: u32[S, R, W] -> (ids i32[S*k], counts i32[S*k])
+    on every device: per-shard intersection scores + local top-k, then
+    all_gather of the candidate sets — the reference's two-pass TopN
+    candidate exchange (executor.go:521-561) riding ICI instead of HTTP.
+    The host performs the exact re-score pass (pass 2) as the reference
+    does.
+    """
+
+    def kernel(src, mat):
+        # per-device: src u32[s_local, W], mat u32[s_local, R, W]
+        scores = jnp.sum(
+            jax.lax.population_count(
+                jnp.bitwise_and(mat, src[:, None, :])
+            ).astype(jnp.int32),
+            axis=-1,
+        )  # [s_local, R]
+        counts, ids = jax.lax.top_k(scores, k)  # [s_local, k] each
+        counts = jax.lax.all_gather(counts.reshape(-1), SHARD_AXIS, tiled=True)
+        ids = jax.lax.all_gather(ids.reshape(-1), SHARD_AXIS, tiled=True)
+        return ids, counts
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+            out_specs=(P(), P()),
+            # all_gather's replicated output can't be statically inferred
+            # by the varying-manual-axes checker; results are replicated
+            # by construction.
+            check_vma=False,
+        )
+    )
+
+
+def bsi_sum_spmd(mesh: Mesh, bit_depth: int):
+    """Sum(field) over all shards: per-plane popcounts psum'd over ICI.
+
+    planes: u32[S, D+1, W], filter: u32[S, W], has_filter static.
+    Returns i32[D+1] global per-plane counts; host computes
+    Σ counts[i]<<i in exact Python ints.
+    """
+
+    def kernel(planes, filt):
+        block = jnp.bitwise_and(planes, filt[:, None, :])  # [s_local, D+1, W]
+        local = jnp.sum(
+            jax.lax.population_count(block).astype(jnp.int32), axis=(0, 2)
+        )  # [D+1]
+        return jax.lax.psum(local, SHARD_AXIS)
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+            out_specs=P(),
+        )
+    )
+
+
+def row_algebra_spmd(mesh: Mesh, op: str):
+    """Materialising bitmap algebra across shards: fold K rows per shard
+    elementwise; result stays sharded (each device keeps its shard's
+    result segment — no collective, like the reference's per-node Row
+    segments that only merge at the coordinator)."""
+
+    from pilosa_tpu.ops.packed import fold_rows
+
+    def kernel(mat):  # u32[s_local, K, W]
+        if op == "and":
+            init, fn = jnp.uint32(0xFFFFFFFF), jnp.bitwise_and
+        elif op == "or":
+            init, fn = jnp.uint32(0), jnp.bitwise_or
+        else:
+            init, fn = jnp.uint32(0), jnp.bitwise_xor
+        return jax.lax.reduce(mat, init, fn, (1,))
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(SHARD_AXIS),),
+            out_specs=P(SHARD_AXIS),
+        )
+    )
+
+
+class ShardBatchPlan:
+    """Host-side packing of a set of fragments into one shard-major batch.
+
+    Pads the shard list to the mesh size (empty shards contribute zero
+    words — identical results, since AND with missing shard never occurs:
+    padding shards carry no query rows and reduce as zeros).
+    """
+
+    def __init__(self, mesh: Mesh, shards: list[int]) -> None:
+        self.mesh = mesh
+        self.n_devices = mesh.devices.size
+        self.shards = list(shards)
+        pad = (-len(self.shards)) % self.n_devices
+        self.padded = self.shards + [-1] * pad
+
+    def stack_rows(self, words_by_shard: dict[int, np.ndarray], width: int) -> np.ndarray:
+        """words_by_shard: shard -> u32[K, W]; missing/padding → zeros."""
+        k = max((w.shape[0] for w in words_by_shard.values()), default=1)
+        out = np.zeros((len(self.padded), k, width), dtype=np.uint32)
+        for i, s in enumerate(self.padded):
+            w = words_by_shard.get(s)
+            if w is not None:
+                out[i, : w.shape[0]] = w
+        return out
