@@ -22,27 +22,31 @@ import numpy as np
 
 
 def main():
+    import os
+
     import jax
     import jax.numpy as jnp
 
-    R, W64 = 4096, 16384  # rows × uint64-words (2^20 columns)
-    DENSITY = 0.02
-    N_QUERIES = 64
+    # The image's sitecustomize force-sets jax_platforms to the TPU
+    # backend, overriding the JAX_PLATFORMS env var; re-assert it so
+    # CPU smoke runs work (the TPU driver leaves it unset/axon).
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import os
+
+    R = int(os.environ.get("PILOSA_BENCH_ROWS", 4096))
+    W64 = 16384  # uint64 words per row (2^20 columns)
+    DENSITY = 0.015625  # 2^-6 via 6-way AND
+    N_QUERIES = int(os.environ.get("PILOSA_BENCH_QUERIES", 64))
     TOPK = 10
 
     rng = np.random.default_rng(11)
-    # Synthetic packed fragment: each row ~2% density.
-    nbits_per_word = (
-        rng.random((R, W64)) < 0  # placeholder, filled below
-    )
-    # Generate sparse rows: choose set words, then random bits in them.
-    mat64 = np.zeros((R, W64), dtype=np.uint64)
-    for i in range(R):
-        nset = int(W64 * 64 * DENSITY)
-        cols = rng.choice(W64 * 64, size=nset, replace=False)
-        np.bitwise_or.at(
-            mat64, (i, cols // 64), np.uint64(1) << np.uint64(cols % 64).astype(np.uint64)
-        )
+    # Synthetic packed fragment at ~2^-6 ≈ 1.6% density: AND of 6
+    # uniform word streams (vectorised; per-bit P(set) = 0.5^6).
+    mat64 = rng.integers(0, 2**64, size=(R, W64), dtype=np.uint64)
+    for _ in range(5):
+        mat64 &= rng.integers(0, 2**64, size=(R, W64), dtype=np.uint64)
     mat32 = mat64.view("<u4")
 
     srcs = mat64[rng.integers(0, R, size=N_QUERIES)]  # reuse rows as src filters
@@ -77,22 +81,24 @@ def main():
     p50 = sorted(lat)[len(lat) // 2] * 1000
 
     # ---- CPU baseline: roaring per-candidate intersection counts ----
+    # A TopN query walks every candidate row computing
+    # src.intersection_count(row) (the reference's fragment.top hot loop).
+    # Building all R roaring rows in Python is prohibitive, so measure a
+    # SAMPLE of rows and extrapolate the per-query cost linearly in R —
+    # the walk is embarrassingly linear in candidate count.
     from pilosa_tpu.roaring import Bitmap
 
-    rows_cpu = [Bitmap.from_words_range(mat64[i]) for i in range(R)]
-    counts_cpu = [b.count() for b in rows_cpu]
-    order = sorted(range(R), key=lambda i: -counts_cpu[i])
-    n_cpu = min(4, N_QUERIES)
+    sample_n = 64
+    rows_cpu = [Bitmap.from_words_range(mat64[i]) for i in range(sample_n)]
+    src_b = Bitmap.from_words_range(srcs[0])
     t0 = time.perf_counter()
-    for q in range(n_cpu):
-        src_b = Bitmap.from_words_range(srcs[q])
-        scores = []
-        for i in order:
-            scores.append((src_b.intersection_count(rows_cpu[i]), i))
-        scores.sort(reverse=True)
-        _ = scores[:TOPK]
-    cpu_elapsed = time.perf_counter() - t0
-    cpu_qps = n_cpu / cpu_elapsed
+    reps = 2
+    for _ in range(reps):
+        for b in rows_cpu:
+            src_b.intersection_count(b)
+    per_row = (time.perf_counter() - t0) / (sample_n * reps)
+    cpu_query_s = per_row * R
+    cpu_qps = 1.0 / cpu_query_s
 
     print(
         json.dumps(

@@ -1,0 +1,165 @@
+"""InternalClient — node-to-node data plane over HTTP (reference
+http/client.go). JSON instead of protobuf; same endpoint map."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Optional
+
+
+class ClientError(Exception):
+    pass
+
+
+class InternalClient:
+    def __init__(self, timeout: float = 30.0) -> None:
+        self.timeout = timeout
+
+    def _request(
+        self,
+        method: str,
+        uri: str,
+        path: str,
+        body: Optional[bytes] = None,
+        query: Optional[dict] = None,
+        raw: bool = False,
+    ):
+        url = uri + path
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        req = urllib.request.Request(url, data=body, method=method)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                data = resp.read()
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", str(e))
+            except Exception:
+                msg = str(e)
+            raise ClientError(f"{method} {url}: {msg}") from e
+        except (urllib.error.URLError, OSError) as e:
+            raise ClientError(f"{method} {url}: {e}") from e
+        if raw:
+            return data
+        return json.loads(data or b"{}")
+
+    # -- query (reference QueryNode, http/client.go:225) --
+
+    def query_node(
+        self,
+        uri: str,
+        index: str,
+        query: str,
+        shards: Optional[list[int]] = None,
+        remote: bool = True,
+    ) -> list[dict]:
+        q = {"remote": "true" if remote else "false"}
+        if shards is not None:
+            q["shards"] = ",".join(str(s) for s in shards)
+        resp = self._request(
+            "POST",
+            uri,
+            f"/index/{index}/query",
+            body=query.encode(),
+            query=q,
+        )
+        return resp.get("results", [])
+
+    # -- imports (reference Import/ImportValue, http/client.go:276,428) --
+
+    def import_bits(self, uri: str, index: str, field: str, row_ids, column_ids, timestamps=None) -> None:
+        body = {"rowIDs": list(row_ids), "columnIDs": list(column_ids)}
+        if timestamps is not None:
+            body["timestamps"] = list(timestamps)
+        self._request(
+            "POST",
+            uri,
+            f"/index/{index}/field/{field}/import",
+            body=json.dumps(body).encode(),
+        )
+
+    def import_values(self, uri: str, index: str, field: str, column_ids, values) -> None:
+        body = {"columnIDs": list(column_ids), "values": list(values)}
+        self._request(
+            "POST",
+            uri,
+            f"/index/{index}/field/{field}/import-value",
+            body=json.dumps(body).encode(),
+        )
+
+    # -- fragment sync (reference FragmentBlocks/BlockData:637,682) --
+
+    def fragment_blocks(self, uri: str, index: str, field: str, shard: int) -> list[dict]:
+        resp = self._request(
+            "GET",
+            uri,
+            "/internal/fragment/blocks",
+            query={"index": index, "field": field, "shard": shard},
+        )
+        return resp.get("blocks", [])
+
+    def block_data(
+        self, uri: str, index: str, field: str, view: str, shard: int, block: int
+    ) -> dict:
+        return self._request(
+            "GET",
+            uri,
+            "/internal/fragment/block/data",
+            query={
+                "index": index,
+                "field": field,
+                "view": view,
+                "shard": shard,
+                "block": block,
+            },
+        )
+
+    # -- shard streaming for resize (reference RetrieveShardFromURI:544) --
+
+    def retrieve_fragment(
+        self, uri: str, index: str, field: str, view: str, shard: int
+    ) -> bytes:
+        return self._request(
+            "GET",
+            uri,
+            "/internal/fragment/data",
+            query={"index": index, "field": field, "view": view, "shard": shard},
+            raw=True,
+        )
+
+    def send_fragment(
+        self, uri: str, index: str, field: str, view: str, shard: int, data: bytes
+    ) -> None:
+        self._request(
+            "POST",
+            uri,
+            "/internal/fragment/data",
+            body=data,
+            query={"index": index, "field": field, "view": view, "shard": shard},
+        )
+
+    # -- control messages (reference SendMessage, http/client.go:822) --
+
+    def send_message(self, uri: str, msg: dict) -> None:
+        self._request(
+            "POST", uri, "/internal/cluster/message", body=json.dumps(msg).encode()
+        )
+
+    # -- misc --
+
+    def status(self, uri: str) -> dict:
+        return self._request("GET", uri, "/status")
+
+    def schema(self, uri: str) -> list[dict]:
+        return self._request("GET", uri, "/schema").get("indexes", [])
+
+    def max_shards(self, uri: str) -> dict:
+        return self._request("GET", uri, "/internal/shards/max").get("standard", {})
+
+    def translate_data(self, uri: str, offset: int) -> bytes:
+        return self._request(
+            "GET", uri, "/internal/translate/data", query={"offset": offset}, raw=True
+        )

@@ -1,0 +1,58 @@
+"""Cluster node identity + URI (reference pilosa.Node / uri.go)."""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass
+class URI:
+    scheme: str = "http"
+    host: str = "localhost"
+    port: int = 10101
+
+    @classmethod
+    def from_address(cls, addr: str) -> "URI":
+        m = re.fullmatch(
+            r"(?:(?P<scheme>[a-z][a-z0-9+.-]*)://)?(?P<host>[^:/]*)(?::(?P<port>\d+))?",
+            addr.strip(),
+        )
+        if m is None or (m.group("host") == "" and m.group("port") is None):
+            raise ValueError(f"invalid address: {addr!r}")
+        return cls(
+            scheme=m.group("scheme") or "http",
+            host=m.group("host") or "localhost",
+            port=int(m.group("port") or 10101),
+        )
+
+    def __str__(self) -> str:
+        return f"{self.scheme}://{self.host}:{self.port}"
+
+    def host_port(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+@dataclass
+class Node:
+    id: str
+    uri: str  # http://host:port
+    is_coordinator: bool = False
+    state: str = "READY"
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "uri": self.uri,
+            "isCoordinator": self.is_coordinator,
+            "state": self.state,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Node":
+        return cls(
+            id=d["id"],
+            uri=d["uri"],
+            is_coordinator=d.get("isCoordinator", False),
+            state=d.get("state", "READY"),
+        )

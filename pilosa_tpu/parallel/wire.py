@@ -1,0 +1,58 @@
+"""Wire encoding for node-to-node query results.
+
+The reference exchanges protobuf QueryResponse messages
+(internal/public.proto); here results travel as tagged JSON. Decoding
+needs the call shape (a Row vs pairs vs ValCount) — same reason the
+reference switches on result type in encodeQueryResponse.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pilosa_tpu.core import Row
+from pilosa_tpu.executor import ValCount
+
+
+def encode_shard_result(r: Any) -> dict:
+    """Result of one node's shard-map leg → JSON."""
+    if isinstance(r, Row):
+        return {"t": "row", "columns": [int(c) for c in r.columns()]}
+    if isinstance(r, ValCount):
+        return {"t": "valcount", "value": r.val, "count": r.count}
+    if isinstance(r, bool):
+        return {"t": "bool", "v": r}
+    if isinstance(r, int):
+        return {"t": "int", "v": r}
+    if isinstance(r, list):
+        # TopN pair lists: [{"id": .., "count": ..}]
+        return {"t": "pairs", "v": r}
+    if r is None:
+        return {"t": "null"}
+    raise TypeError(f"cannot encode result: {r!r}")
+
+
+def decode_shard_result(d: dict) -> Any:
+    t = d.get("t")
+    if t == "row":
+        r = Row(*d["columns"])
+        return r
+    if t == "valcount":
+        return ValCount(d["value"], d["count"])
+    if t == "bool":
+        return d["v"]
+    if t == "int":
+        return d["v"]
+    if t == "pairs":
+        return d["v"]
+    if t == "null":
+        return None
+    raise TypeError(f"cannot decode result: {d!r}")
+
+
+def pairs_to_tuples(pairs: list) -> list[tuple[int, int]]:
+    return [(p["id"], p["count"]) for p in pairs]
+
+
+def tuples_to_pairs(tuples: list[tuple[int, int]]) -> list[dict]:
+    return [{"id": i, "count": c} for i, c in tuples]

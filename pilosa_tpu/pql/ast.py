@@ -50,8 +50,8 @@ class Condition:
         return f"Condition({self.op!r}, {self.value!r})"
 
     def string_with_field(self, field: str) -> str:
-        if self.op == BETWEEN and isinstance(self.value, list) and len(self.value) == 2:
-            return f"{self.value[0]} <= {field} <= {self.value[1]}"
+        # BETWEEN prints as the `><` operator form so strings re-parse
+        # without re-applying the conditional-form bound adjustments.
         return f"{field} {self.op} {format_value(self.value)}"
 
 

@@ -90,18 +90,25 @@ class Parser:
         self._ws(False)
         self._expect("(")
         self._ws(False)
-        if ident == "Set":
-            call = self._set_call()
-        elif ident == "SetRowAttrs":
-            call = self._set_row_attrs_call()
-        elif ident == "SetColumnAttrs":
-            call = self._set_column_attrs_call()
-        elif ident == "Clear":
-            call = self._clear_call()
-        elif ident == "TopN":
-            call = self._topn_call()
-        elif ident == "Range":
-            call = self._range_call()
+        special = {
+            "Set": self._set_call,
+            "SetRowAttrs": self._set_row_attrs_call,
+            "SetColumnAttrs": self._set_column_attrs_call,
+            "Clear": self._clear_call,
+            "TopN": self._topn_call,
+            "Range": self._range_call,
+        }.get(ident)
+        if special is not None:
+            # PEG ordered choice: if the positional form fails, backtrack
+            # to the generic IDENT rule (reserved _col/_field/... args are
+            # legal there) — this is how the reference round-trips
+            # Call.String() for remote execution.
+            save = self.pos
+            try:
+                call = special()
+            except ParseError:
+                self.pos = save
+                call = self._generic_call(ident)
         else:
             call = self._generic_call(ident)
         self._ws(False)
