@@ -255,15 +255,19 @@ class Executor:
 
     # -- map/reduce seam -----------------------------------------------------
 
-    def _map_reduce(self, index, shards, c, opt, map_fn, reduce_fn, zero=None):
+    def _map_reduce(self, index, shards, c, opt, map_fn, reduce_fn, zero_factory=None):
         """Single-node: loop shards in order (deterministic reduce order —
         the reference's goroutine fan-in is arrival-ordered). The cluster
-        layer overrides this via self.cluster.map_reduce."""
+        layer overrides this via self.cluster.map_reduce.
+
+        zero_factory builds a FRESH accumulator: reduce_fn may mutate its
+        first argument (Row.merge), and mapped values can be cached
+        fragment rows that must never be mutated."""
         if self.cluster is not None and not opt.remote:
             return self.cluster.map_reduce(
-                index, shards, c, opt, map_fn, reduce_fn, zero
+                index, shards, c, opt, map_fn, reduce_fn, zero_factory
             )
-        result = zero
+        result = zero_factory() if zero_factory else None
         for shard in shards:
             v = map_fn(shard)
             result = v if result is None else reduce_fn(result, v)
@@ -279,7 +283,7 @@ class Executor:
             prev.merge(v)
             return prev
 
-        other = self._map_reduce(index, shards, c, opt, map_fn, reduce_fn, zero=Row())
+        other = self._map_reduce(index, shards, c, opt, map_fn, reduce_fn, zero_factory=Row)
 
         # Attach attributes for top-level Row() calls
         # (reference executeBitmapCall, executor.go:338-385).
@@ -608,7 +612,7 @@ class Executor:
             return self._bitmap_call_shard_cpu(index, child, shard).count()
 
         result = self._map_reduce(
-            index, shards, c, opt, map_fn, lambda a, b: a + b, zero=0
+            index, shards, c, opt, map_fn, lambda a, b: a + b, zero_factory=lambda: 0
         )
         return int(result or 0)
 
@@ -675,7 +679,7 @@ class Executor:
             return ValCount(vsum + vcount * bsig.min, vcount)
 
         result = self._map_reduce(
-            index, shards, c, opt, map_fn, lambda a, b: a.add(b), zero=ValCount()
+            index, shards, c, opt, map_fn, lambda a, b: a.add(b), zero_factory=ValCount
         )
         if result is None or result.count == 0:
             return ValCount()
@@ -727,7 +731,7 @@ class Executor:
             (lambda a, b: a.smaller(b)) if is_min else (lambda a, b: a.larger(b))
         )
         result = self._map_reduce(
-            index, shards, c, opt, map_fn, reduce_fn, zero=ValCount()
+            index, shards, c, opt, map_fn, reduce_fn, zero_factory=ValCount
         )
         if result is None or result.count == 0:
             return ValCount()
@@ -753,7 +757,7 @@ class Executor:
         def map_fn(shard):
             return self._execute_topn_shard(index, c, shard)
 
-        result = self._map_reduce(index, shards, c, opt, map_fn, pairs_add, zero=[])
+        result = self._map_reduce(index, shards, c, opt, map_fn, pairs_add, zero_factory=list)
         return sort_pairs(result or [])
 
     def _execute_topn_shard(self, index, c: Call, shard: int) -> list[tuple[int, int]]:

@@ -1,0 +1,701 @@
+"""Cluster — shard placement, replication, membership, resize,
+anti-entropy (reference cluster.go + holder.go syncer).
+
+Control plane: the reference coordinates membership with SWIM gossip
+(memberlist UDP probing); here the control plane is host-side HTTP to
+the coordinator — node-join messages, ClusterStatus broadcasts, resize
+instructions — carrying the same message set (reference
+broadcast.go:52-158). The data plane (queries, imports, fragment
+streaming) flows through InternalClient exactly as in the reference;
+on-device cross-shard reduction additionally rides ICI collectives
+(parallel/spmd.py).
+
+Placement is hash-identical to the reference (FNV partition + jump
+hash + ring replicas) so resizes move the same minimal fragment set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+from pilosa_tpu.parallel.client import ClientError, InternalClient
+from pilosa_tpu.parallel.hashing import DEFAULT_PARTITION_N, Jmphasher, partition
+from pilosa_tpu.parallel.node import Node
+from pilosa_tpu.parallel.wire import (
+    decode_shard_result,
+    encode_shard_result,
+    pairs_to_tuples,
+    tuples_to_pairs,
+)
+
+STATE_STARTING = "STARTING"
+STATE_NORMAL = "NORMAL"
+STATE_RESIZING = "RESIZING"
+
+
+class ShardUnavailableError(Exception):
+    """reference errShardUnavailable (executor.go:1699)."""
+
+
+class Cluster:
+    def __init__(
+        self,
+        node_id: str,
+        uri: str,
+        replica_n: int = 1,
+        partition_n: int = DEFAULT_PARTITION_N,
+        hasher=None,
+        static: bool = True,
+        coordinator: bool = True,
+        coordinator_uri: Optional[str] = None,
+        topology_path: Optional[str] = None,
+        logger=None,
+    ) -> None:
+        self.node_id = node_id
+        self.uri = uri
+        self.replica_n = replica_n
+        self.partition_n = partition_n
+        self.hasher = hasher or Jmphasher()
+        self.static = static
+        self.is_coordinator = coordinator
+        self.coordinator_uri = coordinator_uri
+        self.topology_path = topology_path
+        self.logger = logger
+        self.state = STATE_STARTING
+        self.nodes: list[Node] = []
+        self.client = InternalClient()
+        self.server = None  # attached Server (broadcaster target)
+        self.mu = threading.RLock()
+        self._joined = threading.Event()
+        self._resize_lock = threading.Lock()
+        self._resize_job: Optional[dict] = None
+        self._resize_abort = threading.Event()
+        self._pool = ThreadPoolExecutor(max_workers=16)
+
+    # -- wiring --------------------------------------------------------------
+
+    def attach_server(self, server) -> None:
+        self.server = server
+        me = Node(self.node_id, self.uri, is_coordinator=self.is_coordinator)
+        with self.mu:
+            if not any(n.id == me.id for n in self.nodes):
+                self.nodes.append(me)
+            self._sort_nodes()
+        if self.static:
+            self.state = STATE_NORMAL
+            self._save_topology()
+        elif self.is_coordinator:
+            self._load_topology()
+            self.state = STATE_NORMAL
+            self._save_topology()
+        else:
+            self._join()
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def _sort_nodes(self) -> None:
+        self.nodes.sort(key=lambda n: n.id)
+
+    def set_nodes(self, nodes: list[Node]) -> None:
+        """Static topology injection (tests / cluster.hosts config)."""
+        with self.mu:
+            self.nodes = list(nodes)
+            self._sort_nodes()
+
+    def local_node(self) -> Node:
+        for n in self.nodes:
+            if n.id == self.node_id:
+                return n
+        raise KeyError(self.node_id)
+
+    def coordinator_node(self) -> Optional[Node]:
+        for n in self.nodes:
+            if n.is_coordinator:
+                return n
+        return None
+
+    # -- topology persistence (reference .topology, cluster.go:1519-1554) ---
+
+    def _save_topology(self) -> None:
+        if not self.topology_path:
+            return
+        os.makedirs(os.path.dirname(self.topology_path) or ".", exist_ok=True)
+        with open(self.topology_path, "w") as f:
+            json.dump([n.to_dict() for n in self.nodes], f)
+
+    def _load_topology(self) -> None:
+        if not self.topology_path:
+            return
+        try:
+            with open(self.topology_path) as f:
+                saved = [Node.from_dict(d) for d in json.load(f)]
+        except FileNotFoundError:
+            return
+        with self.mu:
+            by_id = {n.id: n for n in self.nodes}
+            for n in saved:
+                if n.id not in by_id:
+                    self.nodes.append(n)
+            self._sort_nodes()
+
+    # -- membership (HTTP control plane replacing gossip) --------------------
+
+    def _join(self) -> None:
+        """Announce to the coordinator and wait for a ClusterStatus that
+        includes us in state NORMAL (reference nodeJoin path)."""
+        assert self.coordinator_uri
+        me = self.local_node()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                self.client.send_message(
+                    self.coordinator_uri,
+                    {"type": "node-join", "node": me.to_dict()},
+                )
+                break
+            except ClientError:
+                time.sleep(0.2)
+        if not self._joined.wait(timeout=60):
+            raise TimeoutError("timed out joining cluster")
+
+    def receive_message(self, msg: dict) -> None:
+        typ = msg.get("type")
+        if typ == "node-join":
+            self._handle_node_join(Node.from_dict(msg["node"]))
+        elif typ == "cluster-status":
+            self._apply_cluster_status(msg)
+        elif typ == "resize-instruction":
+            threading.Thread(
+                target=self._follow_resize_instruction, args=(msg,), daemon=True
+            ).start()
+        elif typ == "resize-complete":
+            self._mark_resize_complete(msg)
+        elif typ == "holder-clean":
+            self._holder_clean()
+        elif typ == "node-leave":
+            pass  # deliberate: no automatic removal (reference cluster.go:1629)
+        else:
+            raise ValueError(f"unknown cluster message: {typ}")
+
+    def _handle_node_join(self, node: Node) -> None:
+        """Coordinator-side join handling (reference nodeJoin,
+        cluster.go:1638-1697)."""
+        if not self.is_coordinator:
+            return
+        with self.mu:
+            known = any(n.id == node.id for n in self.nodes)
+            if known:
+                self._broadcast_status()
+                return
+            has_data = self.server is not None and self.server.holder.has_data()
+            if not has_data:
+                self.nodes.append(node)
+                self._sort_nodes()
+                self._save_topology()
+                self._broadcast_status()
+                return
+        # Data present: full resize dance.
+        self._start_resize(add_node=node)
+
+    def _apply_cluster_status(self, msg: dict) -> None:
+        with self.mu:
+            self.nodes = [Node.from_dict(d) for d in msg["nodes"]]
+            self._sort_nodes()
+            self.state = msg["state"]
+            self._save_topology()
+        if self.server is not None and msg.get("schema"):
+            self.server.holder.apply_schema(msg["schema"])
+        if self.server is not None:
+            for name, m in (msg.get("maxShards") or {}).items():
+                idx = self.server.holder.index(name)
+                if idx is not None:
+                    idx.set_remote_max_shard(m)
+        if any(n.id == self.node_id for n in self.nodes) and self.state == STATE_NORMAL:
+            self._joined.set()
+
+    def _broadcast_status(self) -> None:
+        msg = self._status_message()
+        self._apply_cluster_status(msg)
+        self.send_async(msg)
+
+    def _status_message(self) -> dict:
+        holder = self.server.holder if self.server else None
+        return {
+            "type": "cluster-status",
+            "state": self.state,
+            "nodes": [n.to_dict() for n in self.nodes],
+            "schema": holder.schema() if holder else [],
+            # reference NodeStatus carries MaxShards in gossip push/pull
+            # (server.go:602-630)
+            "maxShards": (
+                {name: idx.max_shard() for name, idx in holder.indexes.items()}
+                if holder
+                else {}
+            ),
+        }
+
+    # -- broadcaster (reference broadcast.go / server.go:520-547) ------------
+
+    def _other_nodes(self) -> list[Node]:
+        return [n for n in self.nodes if n.id != self.node_id]
+
+    def send_sync(self, msg: dict) -> None:
+        errs = []
+        for n in self._other_nodes():
+            try:
+                self.client.send_message(n.uri, msg)
+            except ClientError as e:
+                errs.append(e)
+        if errs:
+            raise errs[0]
+
+    def send_async(self, msg: dict) -> None:
+        for n in self._other_nodes():
+            try:
+                self.client.send_message(n.uri, msg)
+            except ClientError:
+                pass
+
+    def send_to(self, node: Node, msg: dict) -> None:
+        if node.id == self.node_id:
+            self.server.receive_message(msg)
+        else:
+            self.client.send_message(node.uri, msg)
+
+    # -- placement (reference cluster.go:776-857) ----------------------------
+
+    def partition(self, index: str, shard: int) -> int:
+        return partition(index, shard, self.partition_n)
+
+    def partition_nodes(self, partition_id: int) -> list[Node]:
+        with self.mu:
+            nodes = self.nodes
+            n = len(nodes)
+            if n == 0:
+                return []
+            idx = self.hasher.hash(partition_id, n)
+            replica_n = min(self.replica_n, n)
+            return [nodes[(idx + i) % n] for i in range(replica_n)]
+
+    def shard_nodes(self, index: str, shard: int) -> list[Node]:
+        return self.partition_nodes(self.partition(index, shard))
+
+    def owns_shard(self, index: str, shard: int) -> bool:
+        return any(n.id == self.node_id for n in self.shard_nodes(index, shard))
+
+    def contains_shards(self, index: str, max_shard: int) -> list[int]:
+        return [
+            s for s in range(max_shard + 1) if self.owns_shard(index, s)
+        ]
+
+    # -- distributed map/reduce (reference mapReduce, executor.go:1444-1593) -
+
+    def map_reduce(self, index, shards, c, opt, map_fn, reduce_fn, zero_factory=None):
+        shards = list(shards or [])
+        # Fresh accumulators everywhere: adopting a mapped value as the
+        # accumulator would let reduce_fn mutate cached fragment rows.
+        result = zero_factory() if zero_factory else None
+        pending = shards
+        banned_nodes: set[str] = set()
+        while pending:
+            by_node = self._shards_by_node(index, pending, banned_nodes)
+            if pending and not by_node:
+                raise ShardUnavailableError(f"shards unavailable: {pending}")
+            next_pending: list[int] = []
+            futures = []
+            for node, node_shards in by_node:
+                if node.id == self.node_id:
+                    futures.append(
+                        (node, node_shards, self._pool.submit(
+                            self._map_local, node_shards, map_fn, reduce_fn,
+                            zero_factory,
+                        ))
+                    )
+                else:
+                    futures.append(
+                        (node, node_shards, self._pool.submit(
+                            self._map_remote, node, index, c, node_shards
+                        ))
+                    )
+            for node, node_shards, fut in futures:
+                try:
+                    v = fut.result()
+                except (ClientError, ConnectionError) as e:
+                    # failover: ban the node, re-map its shards onto
+                    # replicas (reference mapReduce:1496-1509)
+                    banned_nodes.add(node.id)
+                    next_pending.extend(node_shards)
+                    if self.logger:
+                        self.logger.printf("node %s failed, re-mapping: %s", node.id, e)
+                    continue
+                result = v if result is None else reduce_fn(result, v)
+            pending = next_pending
+        return result
+
+    def _shards_by_node(self, index, shards, banned: set[str]) -> list:
+        """Assign each shard to its first live owner (reference
+        shardsByNode, executor.go:1444-1458)."""
+        by_id: dict[str, tuple[Node, list[int]]] = {}
+        for shard in shards:
+            for node in self.shard_nodes(index, shard):
+                if node.id in banned:
+                    continue
+                by_id.setdefault(node.id, (node, []))[1].append(shard)
+                break
+        return list(by_id.values())
+
+    def _map_local(self, shards, map_fn, reduce_fn, zero_factory=None):
+        result = zero_factory() if zero_factory else None
+        for shard in shards:
+            v = map_fn(shard)
+            result = v if result is None else reduce_fn(result, v)
+        return result
+
+    def _map_remote(self, node, index, c, shards):
+        """Remote leg: ship the call string; decode the single result
+        (reference remoteExec, executor.go:1393-1440)."""
+        results = self.client.query_node(
+            node.uri, index, str(c), shards=shards, remote=True
+        )
+        if not results:
+            return None
+        return self._decode_remote(c, results[0])
+
+    @staticmethod
+    def _decode_remote(c, raw):
+        """Map the JSON wire shape back to executor result types."""
+        from pilosa_tpu.core import Row
+        from pilosa_tpu.executor import ValCount
+
+        if isinstance(raw, dict):
+            if "columns" in raw or "keys" in raw or "attrs" in raw:
+                return Row(*raw.get("columns", []))
+            if "value" in raw and "count" in raw:
+                return ValCount(raw["value"], raw["count"])
+        if isinstance(raw, list):
+            return pairs_to_tuples(raw)
+        return raw
+
+    # -- write fan-out (reference executeSetBit/executeClearBit) -------------
+
+    def set_bit(self, index, c, field, row_id, col_id, timestamp, opt) -> bool:
+        return self._write_bit(
+            index, c, field, row_id, col_id, opt, lambda: field.set_bit(row_id, col_id, timestamp)
+        )
+
+    def clear_bit(self, index, c, field, row_id, col_id, opt) -> bool:
+        return self._write_bit(
+            index, c, field, row_id, col_id, opt, lambda: field.clear_bit(row_id, col_id)
+        )
+
+    def _write_bit(self, index, c, field, row_id, col_id, opt, local_fn) -> bool:
+        from pilosa_tpu import SHARD_WIDTH
+
+        shard = col_id // SHARD_WIDTH
+        ret = False
+        for node in self.shard_nodes(index, shard):
+            if node.id == self.node_id:
+                if local_fn():
+                    ret = True
+            elif not opt.remote:
+                res = self.client.query_node(
+                    node.uri, index, str(c), shards=None, remote=True
+                )
+                if res and res[0] is True:
+                    ret = True
+        return ret
+
+    def forward_to_all(self, index, c, opt) -> None:
+        """SetValue/attrs replicate to every node (reference
+        executeSetValue remote fan-out)."""
+        if opt.remote:
+            return
+        for node in self._other_nodes():
+            self.client.query_node(node.uri, index, str(c), shards=None, remote=True)
+
+    # -- resize (reference cluster.go:1080-1423) -----------------------------
+
+    def set_coordinator(self, node_id: str) -> None:
+        with self.mu:
+            for n in self.nodes:
+                n.is_coordinator = n.id == node_id
+            self.is_coordinator = self.node_id == node_id
+            self._save_topology()
+        self.send_async(self._status_message())
+
+    def remove_node(self, node_id: str) -> None:
+        """Operator-initiated removal (reference api.RemoveNode:776)."""
+        if not self.is_coordinator:
+            raise ValueError("removeNode can only be called on the coordinator")
+        target = next((n for n in self.nodes if n.id == node_id), None)
+        if target is None:
+            raise KeyError(f"node not found: {node_id}")
+        if self.server is not None and self.server.holder.has_data():
+            self._start_resize(remove_node=target)
+        else:
+            with self.mu:
+                self.nodes = [n for n in self.nodes if n.id != node_id]
+                self._save_topology()
+            self._broadcast_status()
+
+    def resize_abort(self) -> None:
+        self._resize_abort.set()
+        with self.mu:
+            if self.state == STATE_RESIZING:
+                self.state = STATE_NORMAL
+        self._broadcast_status()
+
+    def _start_resize(self, add_node: Optional[Node] = None, remove_node: Optional[Node] = None) -> None:
+        """Coordinator: compute fragment movements between the old and
+        new cluster shapes and drive the job (reference
+        generateResizeJob / fragSources)."""
+        with self._resize_lock:
+            self._resize_abort.clear()
+            old_nodes = list(self.nodes)
+            new_nodes = list(self.nodes)
+            if add_node is not None:
+                new_nodes = new_nodes + [add_node]
+            if remove_node is not None:
+                new_nodes = [n for n in new_nodes if n.id != remove_node.id]
+            new_nodes.sort(key=lambda n: n.id)
+
+            with self.mu:
+                self.state = STATE_RESIZING
+            self.send_async(self._status_message())
+
+            sources = self._frag_sources(old_nodes, new_nodes)
+            schema = self.server.holder.schema() if self.server else []
+
+            # instructions per receiving node
+            self._resize_job = {
+                "pending": {n.id for n in new_nodes},
+                "new_nodes": new_nodes,
+                "done": threading.Event(),
+            }
+            for node in new_nodes:
+                instr = {
+                    "type": "resize-instruction",
+                    "coordinator": self.uri,
+                    "schema": schema,
+                    "sources": sources.get(node.id, []),
+                    "node": node.to_dict(),
+                    "new_nodes": [n.to_dict() for n in new_nodes],
+                }
+                self.send_to(node, instr)
+
+            if not self._resize_job["done"].wait(timeout=120):
+                if not self._resize_abort.is_set():
+                    raise TimeoutError("resize did not complete")
+                return
+
+            with self.mu:
+                self.nodes = new_nodes
+                self._sort_nodes()
+                self.state = STATE_NORMAL
+                self._save_topology()
+            self._broadcast_status()
+            # every node drops fragments it no longer owns
+            self.send_async({"type": "holder-clean"})
+            self._holder_clean()
+
+    def _frag_sources(self, old_nodes: list[Node], new_nodes: list[Node]) -> dict:
+        """node_id -> [{index, field, view, shard, from_uri}] for each
+        fragment the node gains in the new shape (reference fragSources:689-773)."""
+        holder = self.server.holder
+        out: dict[str, list[dict]] = {}
+
+        def owners(nodes, index, shard):
+            n = len(nodes)
+            if n == 0:
+                return []
+            idx = self.hasher.hash(self.partition(index, shard), n)
+            rep = min(self.replica_n, n)
+            return [nodes[(idx + i) % n] for i in range(rep)]
+
+        for iname, idx in holder.indexes.items():
+            for fname, fld in idx.fields.items():
+                for vname, view in fld.views.items():
+                    for shard in view.fragments:
+                        old_owner_ids = {n.id for n in owners(old_nodes, iname, shard)}
+                        old_uris = {
+                            n.id: n.uri for n in old_nodes if n.id in old_owner_ids
+                        }
+                        for node in owners(new_nodes, iname, shard):
+                            if node.id in old_owner_ids:
+                                continue
+                            src_uri = next(iter(old_uris.values()), None)
+                            if src_uri is None:
+                                continue
+                            out.setdefault(node.id, []).append(
+                                {
+                                    "index": iname,
+                                    "field": fname,
+                                    "view": vname,
+                                    "shard": shard,
+                                    "from_uri": src_uri,
+                                }
+                            )
+        return out
+
+    def _follow_resize_instruction(self, msg: dict) -> None:
+        """Receiver side (reference followResizeInstruction:1179-1273)."""
+        try:
+            if self.server is not None and msg.get("schema"):
+                self.server.holder.apply_schema(msg["schema"])
+            for src in msg.get("sources", []):
+                if self._resize_abort.is_set():
+                    return
+                data = self.client.retrieve_fragment(
+                    src["from_uri"], src["index"], src["field"], src["view"], src["shard"]
+                )
+                self.server.api.unmarshal_fragment(
+                    src["index"], src["field"], src["view"], src["shard"], data
+                )
+            complete = {
+                "type": "resize-complete",
+                "node_id": self.node_id,
+                "ok": True,
+            }
+            coord_uri = msg.get("coordinator")
+            if coord_uri == self.uri:
+                self._mark_resize_complete(complete)
+            else:
+                self.client.send_message(coord_uri, complete)
+        except Exception as e:  # report failure to coordinator
+            if self.logger:
+                self.logger.printf("resize instruction failed: %s", e)
+
+    def _mark_resize_complete(self, msg: dict) -> None:
+        job = self._resize_job
+        if job is None:
+            return
+        job["pending"].discard(msg["node_id"])
+        if not job["pending"]:
+            job["done"].set()
+
+    def _holder_clean(self) -> None:
+        """Remove fragments this node no longer owns (reference
+        holderCleaner.CleanHolder, holder.go:799-827)."""
+        holder = self.server.holder
+        for iname, idx in list(holder.indexes.items()):
+            for fname, fld in list(idx.fields.items()):
+                for vname, view in list(fld.views.items()):
+                    for shard in list(view.fragments):
+                        if not self.owns_shard(iname, shard):
+                            frag = view.fragments.pop(shard)
+                            frag.close()
+                            if frag.path and os.path.exists(frag.path):
+                                os.remove(frag.path)
+
+    # -- anti-entropy (reference holderSyncer, holder.go:566-774) -----------
+
+    def sync_holder(self) -> None:
+        """One full anti-entropy sweep: for each locally-owned fragment
+        with replicas, diff 100-row block checksums against every
+        replica, pull differing blocks, and converge to the majority
+        consensus of all replicas (reference fragmentSyncer.syncBlock,
+        fragment.go:1737-1904)."""
+        if self.replica_n < 2 or self.server is None:
+            return
+        holder = self.server.holder
+        for iname, idx in holder.indexes.items():
+            for fname, fld in idx.fields.items():
+                for vname, view in fld.views.items():
+                    for shard, frag in list(view.fragments.items()):
+                        nodes = self.shard_nodes(iname, shard)
+                        if not any(n.id == self.node_id for n in nodes):
+                            continue
+                        remotes = [n for n in nodes if n.id != self.node_id]
+                        if remotes:
+                            self._sync_fragment(
+                                iname, fname, vname, shard, frag, remotes
+                            )
+
+    def _sync_fragment(self, index, field, view, shard, frag, remotes) -> None:
+        import numpy as np
+
+        my_blocks = dict(frag.blocks())
+        remote_blocks = {}
+        for node in remotes:
+            try:
+                blocks = self.client.fragment_blocks(node.uri, index, field, shard)
+                remote_blocks[node.id] = {
+                    b["id"]: bytes.fromhex(b["checksum"]) for b in blocks
+                }
+            except ClientError:
+                continue
+        diff_ids = set()
+        for node_id, blocks in remote_blocks.items():
+            for bid, digest in blocks.items():
+                if my_blocks.get(bid) != digest:
+                    diff_ids.add(bid)
+            for bid, digest in my_blocks.items():
+                if blocks.get(bid) != digest:
+                    diff_ids.add(bid)
+        for bid in sorted(diff_ids):
+            # Gather (row, col) sets from every replica incl. self.
+            sets = []
+            my_rows, my_cols = frag.block_data(bid)
+            sets.append(set(zip(my_rows.tolist(), my_cols.tolist())))
+            uris = []
+            for node in remotes:
+                if node.id not in remote_blocks:
+                    continue
+                try:
+                    d = self.client.block_data(
+                        node.uri, index, field, view, shard, bid
+                    )
+                except ClientError:
+                    continue
+                sets.append(set(zip(d["rows"], d["columns"])))
+                uris.append(node.uri)
+            # Majority consensus (reference mergeBlock: pair kept when
+            # present on >= (replicas+1)/2 of the copies).
+            total = len(sets)
+            threshold = (total + 1) // 2
+            from collections import Counter
+
+            counts = Counter()
+            for s in sets:
+                counts.update(s)
+            consensus = {pair for pair, cnt in counts.items() if cnt >= threshold}
+            # Apply locally.
+            to_set = consensus - sets[0]
+            to_clear = sets[0] - consensus
+            if to_set or to_clear:
+                frag.import_block_pairs(
+                    np.array([p[0] for p in to_set], dtype=np.uint64),
+                    np.array([p[1] for p in to_set], dtype=np.uint64),
+                    np.array([p[0] for p in to_clear], dtype=np.uint64),
+                    np.array([p[1] for p in to_clear], dtype=np.uint64),
+                )
+            # Push fixes to each remote as Set/Clear batches (reference
+            # syncs via generated PQL, fragment.go:1857-1904). Only the
+            # standard view is reachable through Set/Clear; time/BSI
+            # views converge when each replica runs its own sweep.
+            from pilosa_tpu import SHARD_WIDTH
+
+            if view != "standard":
+                continue
+
+            base = shard * SHARD_WIDTH
+            for i, node in enumerate(n for n in remotes if n.id in remote_blocks):
+                theirs = sets[i + 1]
+                fixes = []
+                for row, col in sorted(consensus - theirs):
+                    fixes.append(f"Set({base + col}, {field}={row})")
+                for row, col in sorted(theirs - consensus):
+                    fixes.append(f"Clear({base + col}, {field}={row})")
+                if fixes:
+                    try:
+                        self.client.query_node(
+                            node.uri, index, "".join(fixes), remote=True
+                        )
+                    except ClientError:
+                        pass

@@ -23,12 +23,17 @@ STATE_STARTING = "STARTING"
 STATE_NORMAL = "NORMAL"
 STATE_RESIZING = "RESIZING"
 
-# Methods permitted while RESIZING (reference api.go:70-93)
+# Methods permitted while RESIZING/STARTING (reference api.go:70-93;
+# fragment streaming must stay available mid-resize — it IS the resize)
 _RESIZING_METHODS = {
     "cluster_message",
     "state",
     "status",
     "resize_abort",
+    "fragment_data",
+    "fragment_blocks",
+    "fragment_block_data",
+    "schema",
 }
 
 

@@ -17,6 +17,9 @@ from typing import Optional
 class ClusterConfig:
     disabled: bool = True  # single-node static cluster by default
     coordinator: bool = False
+    # coordinator address a joining node announces to (the analog of the
+    # reference's gossip seed)
+    coordinator_host: str = ""
     replicas: int = 1
     hosts: list[str] = field(default_factory=list)
     long_query_time: float = 0.0

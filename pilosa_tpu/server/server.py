@@ -36,7 +36,11 @@ class Server:
         self.stats = (
             ExpvarStatsClient() if self.config.metric == "expvar" else NOP_STATS
         )
-        self.holder = Holder(data_dir, new_attr_store=new_attr_store)
+        self.holder = Holder(
+            data_dir,
+            new_attr_store=new_attr_store,
+            broadcaster=self._broadcast_create_shard,
+        )
         self.translate_store = TranslateStore(os.path.join(data_dir, ".keys"))
         self.cluster = cluster
         self.stager = DeviceStager(budget_bytes=self.config.stager_budget_bytes)
@@ -65,8 +69,8 @@ class Server:
     def open(self) -> None:
         self.holder.open()
         self.node_id = self.holder.load_node_id()
-        if self.cluster is not None:
-            self.cluster.attach_server(self)
+        # HTTP up first: join/resize messages must be receivable before
+        # the cluster attaches (reference SetupNetworking before Open).
         self.httpd = make_http_server(
             self.handler, self.config.host, self.config.port
         )
@@ -76,6 +80,53 @@ class Server:
         self._serve_thread.start()
         self.logger.printf(
             "pilosa_tpu server listening on http://%s:%d", *self.address()
+        )
+        if self.cluster is None and not self.config.cluster.disabled:
+            self.cluster = self._build_cluster()
+        if self.cluster is not None:
+            self.executor.cluster = self.cluster
+            self.api.cluster = self.cluster
+            self.cluster.attach_server(self)
+
+    def _build_cluster(self):
+        from pilosa_tpu.parallel.cluster import Cluster
+        from pilosa_tpu.parallel.node import Node
+
+        cc = self.config.cluster
+        data_dir = os.path.expanduser(self.config.data_dir)
+        topology_path = os.path.join(data_dir, ".topology")
+        if cc.hosts:
+            # Static topology: node identity = URI so every node derives
+            # the identical ring (the reference's cluster-disabled mode
+            # generalised to N fixed hosts).
+            cluster = Cluster(
+                node_id=self.uri,
+                uri=self.uri,
+                replica_n=cc.replicas,
+                static=True,
+                coordinator=cc.coordinator,
+                topology_path=topology_path,
+                logger=self.logger,
+            )
+            cluster.set_nodes(
+                [Node(id=h if h.startswith("http") else f"http://{h}",
+                      uri=h if h.startswith("http") else f"http://{h}")
+                 for h in cc.hosts]
+            )
+            return cluster
+        return Cluster(
+            node_id=self.node_id,
+            uri=self.uri,
+            replica_n=cc.replicas,
+            static=False,
+            coordinator=cc.coordinator,
+            coordinator_uri=(
+                cc.coordinator_host
+                if cc.coordinator_host.startswith("http")
+                else (f"http://{cc.coordinator_host}" if cc.coordinator_host else None)
+            ),
+            topology_path=topology_path,
+            logger=self.logger,
         )
 
     def address(self) -> tuple[str, int]:
@@ -99,6 +150,11 @@ class Server:
         self.translate_store.close()
 
     # -- broadcaster seam (reference broadcast.go:27-31) --
+
+    def _broadcast_create_shard(self, index: str, shard: int) -> None:
+        """New max shard appeared locally → tell the cluster (reference
+        view.go:216-247 CreateShardMessage)."""
+        self.send_async({"type": "create-shard", "index": index, "shard": shard})
 
     def send_sync(self, msg: dict) -> None:
         if self.cluster is not None:

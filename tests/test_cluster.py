@@ -1,0 +1,302 @@
+"""Multi-node cluster tests — N full servers in one process (mirrors
+reference server/cluster_test.go + cluster_internal_test.go)."""
+
+import json
+import socket
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import SHARD_WIDTH
+from pilosa_tpu.parallel.hashing import Jmphasher, ModHasher, fnv64a, jump_hash, partition
+from pilosa_tpu.parallel.node import Node, URI
+from pilosa_tpu.server import ClusterConfig, Config, Server
+
+
+def free_ports(n):
+    socks = []
+    ports = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def req(uri, method, path, body=None, raw=False):
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    r = urllib.request.Request(uri + path, data=data, method=method)
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            payload = resp.read()
+            return resp.status, payload if raw else json.loads(payload or b"{}")
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, payload if raw else json.loads(payload or b"{}")
+
+
+def boot_static_cluster(tmp_path, n=3, replicas=1):
+    ports = free_ports(n)
+    hosts = [f"127.0.0.1:{p}" for p in ports]
+    servers = []
+    for i, p in enumerate(ports):
+        cfg = Config(
+            data_dir=str(tmp_path / f"node{i}"),
+            bind=f"127.0.0.1:{p}",
+            device_policy="never",
+            metric="none",
+            cluster=ClusterConfig(
+                disabled=False,
+                coordinator=(i == 0),
+                replicas=replicas,
+                hosts=hosts,
+            ),
+        )
+        s = Server(cfg)
+        s.open()
+        servers.append(s)
+    return servers
+
+
+class TestHashing:
+    def test_fnv64a(self):
+        # FNV-1a 64 known vector
+        assert fnv64a(b"") == 0xCBF29CE484222325
+        assert fnv64a(b"a") == 0xAF63DC4C8601EC8C
+
+    def test_jump_hash_distribution(self):
+        counts = [0] * 5
+        for k in range(10000):
+            b = jump_hash(k, 5)
+            assert 0 <= b < 5
+            counts[b] += 1
+        assert min(counts) > 1500  # roughly uniform
+
+    def test_jump_hash_monotone_stability(self):
+        # adding a bucket only moves keys to the NEW bucket
+        for k in range(1000):
+            b5 = jump_hash(k, 5)
+            b6 = jump_hash(k, 6)
+            assert b6 == b5 or b6 == 5
+
+    def test_partition_deterministic(self):
+        assert partition("i", 0) == partition("i", 0)
+        parts = {partition("i", s) for s in range(1000)}
+        assert len(parts) > 200  # spreads over the 256 partitions
+
+
+class TestStaticCluster:
+    def test_three_node_query_distribution(self, tmp_path):
+        servers = boot_static_cluster(tmp_path, n=3)
+        try:
+            s0 = servers[0]
+            st, _ = req(s0.uri, "POST", "/index/i", {})
+            assert st == 200
+            st, _ = req(s0.uri, "POST", "/index/i/field/f", {})
+            assert st == 200
+            # schema propagated to all nodes
+            for s in servers:
+                assert s.holder.field("i", "f") is not None
+
+            # set bits across 6 shards via node 0
+            cols = [s * SHARD_WIDTH + 10 for s in range(6)]
+            for c in cols:
+                st, body = req(s0.uri, "POST", "/index/i/query", f"Set({c}, f=1)".encode())
+                assert st == 200 and body["results"] == [True], body
+
+            # every node answers the full query
+            for s in servers:
+                st, body = req(s.uri, "POST", "/index/i/query", b"Row(f=1)")
+                assert st == 200, body
+                assert body["results"][0]["columns"] == cols, s.uri
+                st, body = req(s.uri, "POST", "/index/i/query", b"Count(Row(f=1))")
+                assert body["results"][0] == 6
+
+            # data actually distributed: no node holds every fragment,
+            # and the union covers all 6 shards
+            held = []
+            for s in servers:
+                v = s.holder.view("i", "f", "standard")
+                held.append(set(v.fragments) if v else set())
+            assert set().union(*held) == set(range(6))
+            assert max(len(h) for h in held) < 6
+
+            # ownership matches the hash ring on every node
+            c0 = servers[0].cluster
+            for shard in range(6):
+                owner_ids = [n.id for n in c0.shard_nodes("i", shard)]
+                for s in servers[1:]:
+                    assert [n.id for n in s.cluster.shard_nodes("i", shard)] == owner_ids
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_topn_across_nodes(self, tmp_path):
+        servers = boot_static_cluster(tmp_path, n=2)
+        try:
+            s0 = servers[0]
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            # row 1: bits in 4 shards; row 2: bits in 2 shards
+            for shard in range(4):
+                req(s0.uri, "POST", "/index/i/query", f"Set({shard * SHARD_WIDTH}, f=1)".encode())
+            for shard in range(2):
+                req(s0.uri, "POST", "/index/i/query", f"Set({shard * SHARD_WIDTH + 1}, f=2)".encode())
+            for s in servers:
+                req(s.uri, "POST", "/recalculate-caches")
+            for s in servers:
+                st, body = req(s.uri, "POST", "/index/i/query", b"TopN(f, n=2)")
+                assert body["results"][0] == [
+                    {"id": 1, "count": 4},
+                    {"id": 2, "count": 2},
+                ], s.uri
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_replication(self, tmp_path):
+        servers = boot_static_cluster(tmp_path, n=2, replicas=2)
+        try:
+            s0 = servers[0]
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            cols = [s * SHARD_WIDTH + 3 for s in range(4)]
+            for c in cols:
+                req(s0.uri, "POST", "/index/i/query", f"Set({c}, f=9)".encode())
+            # with replicas=2 and 2 nodes, both hold every fragment
+            for s in servers:
+                v = s.holder.view("i", "f", "standard")
+                assert set(v.fragments) == set(range(4)), s.uri
+                st, body = req(s.uri, "POST", "/index/i/query", b"Row(f=9)")
+                assert body["results"][0]["columns"] == cols
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_failover_to_replica(self, tmp_path):
+        servers = boot_static_cluster(tmp_path, n=2, replicas=2)
+        try:
+            s0, s1 = servers
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            cols = [s * SHARD_WIDTH + 3 for s in range(4)]
+            for c in cols:
+                req(s0.uri, "POST", "/index/i/query", f"Set({c}, f=9)".encode())
+            # kill node 1; node 0 must still answer everything from replicas
+            s1.close()
+            st, body = req(s0.uri, "POST", "/index/i/query", b"Count(Row(f=9))")
+            assert st == 200 and body["results"][0] == 4
+        finally:
+            for s in servers:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+
+
+class TestJoinProtocol:
+    def test_join_and_resize(self, tmp_path):
+        ports = free_ports(2)
+        cfg0 = Config(
+            data_dir=str(tmp_path / "n0"),
+            bind=f"127.0.0.1:{ports[0]}",
+            device_policy="never",
+            metric="none",
+            cluster=ClusterConfig(disabled=False, coordinator=True),
+        )
+        s0 = Server(cfg0)
+        s0.open()
+        try:
+            # seed data on the single-node cluster
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            cols = [s * SHARD_WIDTH + 7 for s in range(8)]
+            for c in cols:
+                req(s0.uri, "POST", "/index/i/query", f"Set({c}, f=1)".encode())
+            assert set(s0.holder.view("i", "f", "standard").fragments) == set(range(8))
+
+            # second node joins → triggers a resize moving fragments
+            cfg1 = Config(
+                data_dir=str(tmp_path / "n1"),
+                bind=f"127.0.0.1:{ports[1]}",
+                device_policy="never",
+                metric="none",
+                cluster=ClusterConfig(
+                    disabled=False,
+                    coordinator=False,
+                    coordinator_host=s0.uri,
+                ),
+            )
+            s1 = Server(cfg1)
+            s1.open()  # blocks until joined (resize complete)
+            try:
+                assert s1.cluster.state == "NORMAL"
+                assert len(s0.cluster.nodes) == 2
+                # node 1 received the fragments it now owns
+                owned1 = {
+                    s
+                    for s in range(8)
+                    if any(
+                        n.id == s1.cluster.node_id
+                        for n in s1.cluster.shard_nodes("i", s)
+                    )
+                }
+                v1 = s1.holder.view("i", "f", "standard")
+                assert owned1, "expected node 1 to own some shards"
+                assert owned1 <= set(v1.fragments)
+                # node 0 dropped what it no longer owns
+                v0 = s0.holder.view("i", "f", "standard")
+                for shard in v0.fragments:
+                    assert s0.cluster.owns_shard("i", shard)
+                # full query still correct from both nodes
+                for s in (s0, s1):
+                    st, body = req(s.uri, "POST", "/index/i/query", b"Row(f=1)")
+                    assert body["results"][0]["columns"] == cols, s.uri
+            finally:
+                s1.close()
+        finally:
+            s0.close()
+
+
+class TestAntiEntropy:
+    def test_sync_converges_replicas(self, tmp_path):
+        servers = boot_static_cluster(tmp_path, n=2, replicas=2)
+        try:
+            s0, s1 = servers
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            req(s0.uri, "POST", "/index/i/query", b"Set(1, f=1)Set(2, f=1)")
+            # diverge: write directly into node1's holder, bypassing routing
+            s1.holder.field("i", "f").set_bit(1, 99)
+            rows0 = s0.holder.field("i", "f").row(1).columns().tolist()
+            rows1 = s1.holder.field("i", "f").row(1).columns().tolist()
+            assert rows0 != rows1  # replicas diverged
+            # anti-entropy sweep from node 0 converges both (2 replicas →
+            # majority threshold 1 → union semantics, as in the reference)
+            s0.cluster.sync_holder()
+            assert s0.holder.field("i", "f").row(1).columns().tolist() == [1, 2, 99]
+            assert s1.holder.field("i", "f").row(1).columns().tolist() == [1, 2, 99]
+            st, b0 = req(s0.uri, "POST", "/index/i/query", b"Row(f=1)")
+            assert b0["results"][0]["columns"] == [1, 2, 99]
+        finally:
+            for s in servers:
+                s.close()
+
+
+class TestURI:
+    def test_parse(self):
+        u = URI.from_address("https://example.com:8080")
+        assert (u.scheme, u.host, u.port) == ("https", "example.com", 8080)
+        u = URI.from_address("localhost:10101")
+        assert (u.scheme, u.host, u.port) == ("http", "localhost", 10101)
+        u = URI.from_address("example.com")
+        assert (u.scheme, u.host, u.port) == ("http", "example.com", 10101)
+        u = URI.from_address(":10101")
+        assert (u.scheme, u.host, u.port) == ("http", "localhost", 10101)
+        with pytest.raises(ValueError):
+            URI.from_address("")
