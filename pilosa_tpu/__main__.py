@@ -1,0 +1,7 @@
+"""``python -m pilosa_tpu`` entry point."""
+
+import sys
+
+from pilosa_tpu.cli.main import main
+
+sys.exit(main())

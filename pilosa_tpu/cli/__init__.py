@@ -1,0 +1,5 @@
+"""Command line (L8)."""
+
+from pilosa_tpu.cli.main import main
+
+__all__ = ["main"]

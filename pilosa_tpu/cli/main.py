@@ -1,0 +1,290 @@
+"""Command-line interface (L8) — reference cmd/ + ctl/.
+
+Subcommands: server, import, export, check, inspect, config,
+generate-config. Config precedence: flags > env (PILOSA_TPU_*) > TOML
+file (reference cmd/root.go:90-146).
+
+Run as ``python -m pilosa_tpu <subcommand>``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import signal
+import sys
+import time
+import urllib.request
+from datetime import datetime
+
+from pilosa_tpu import SHARD_WIDTH, __version__
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="pilosa_tpu", description="TPU-native distributed bitmap index"
+    )
+    parser.add_argument("--version", action="version", version=__version__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("server", help="run a server node")
+    p.add_argument("-c", "--config", help="TOML config file")
+    p.add_argument("-d", "--data-dir", help="data directory")
+    p.add_argument("-b", "--bind", help="host:port to bind")
+    p.add_argument("--device-policy", choices=["never", "auto", "always"])
+    p.add_argument("--cluster-disabled", action="store_true", default=None)
+    p.add_argument("--coordinator", action="store_true", default=None)
+    p.add_argument("--coordinator-host")
+    p.add_argument("--replicas", type=int)
+    p.add_argument("--hosts", help="comma-separated static cluster hosts")
+    p.add_argument("--verbose", action="store_true", default=None)
+    p.set_defaults(fn=cmd_server)
+
+    p = sub.add_parser("import", help="bulk-import CSV bits or values")
+    p.add_argument("--host", default="http://localhost:10101")
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--field", required=True)
+    p.add_argument("--create", action="store_true", help="create schema if missing")
+    p.add_argument(
+        "--field-type", default="set", help="field type when creating (set/int/time)"
+    )
+    p.add_argument("--field-min", type=int, default=0)
+    p.add_argument("--field-max", type=int, default=0)
+    p.add_argument("--time-quantum", default="")
+    p.add_argument(
+        "--values",
+        action="store_true",
+        help="rows are col,value pairs for an int field",
+    )
+    p.add_argument("--batch-size", type=int, default=100000)
+    p.add_argument("files", nargs="+")
+    p.set_defaults(fn=cmd_import)
+
+    p = sub.add_parser("export", help="export a field as CSV")
+    p.add_argument("--host", default="http://localhost:10101")
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--field", required=True)
+    p.add_argument("-o", "--output", help="output file (default stdout)")
+    p.set_defaults(fn=cmd_export)
+
+    p = sub.add_parser("check", help="check integrity of fragment files")
+    p.add_argument("files", nargs="+")
+    p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser("inspect", help="dump container layout of a fragment file")
+    p.add_argument("files", nargs="+")
+    p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("config", help="print the effective configuration")
+    p.add_argument("-c", "--config", help="TOML config file")
+    p.set_defaults(fn=cmd_config)
+
+    p = sub.add_parser("generate-config", help="print the default configuration")
+    p.set_defaults(fn=cmd_generate_config)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+def _load_config(args):
+    from pilosa_tpu.server import Config
+
+    cfg = Config.from_toml(args.config) if getattr(args, "config", None) else Config()
+    cfg.apply_env()
+    return cfg
+
+
+def cmd_server(args) -> int:
+    from pilosa_tpu.server import Server
+
+    cfg = _load_config(args)
+    if args.data_dir:
+        cfg.data_dir = args.data_dir
+    if args.bind:
+        cfg.bind = args.bind
+    if args.device_policy:
+        cfg.device_policy = args.device_policy
+    if args.verbose is not None:
+        cfg.verbose = args.verbose
+    if args.cluster_disabled is not None:
+        cfg.cluster.disabled = args.cluster_disabled
+    if args.coordinator is not None:
+        cfg.cluster.coordinator = args.coordinator
+        cfg.cluster.disabled = False
+    if args.coordinator_host:
+        cfg.cluster.coordinator_host = args.coordinator_host
+        cfg.cluster.disabled = False
+    if args.replicas:
+        cfg.cluster.replicas = args.replicas
+    if args.hosts:
+        cfg.cluster.hosts = args.hosts.split(",")
+        cfg.cluster.disabled = False
+
+    server = Server(cfg)
+    server.open()
+    stop = []
+    signal.signal(signal.SIGINT, lambda *_: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        server.close()
+    return 0
+
+
+def _post(host, path, body, is_json=True) -> dict:
+    data = json.dumps(body).encode() if is_json else body
+    req = urllib.request.Request(host + path, data=data, method="POST")
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def cmd_import(args) -> int:
+    host = args.host if args.host.startswith("http") else f"http://{args.host}"
+    if args.create:
+        try:
+            _post(host, f"/index/{args.index}", {})
+        except urllib.error.HTTPError as e:
+            if e.code != 409:
+                raise
+        opts = {"type": args.field_type}
+        if args.field_type == "int":
+            opts.update(min=args.field_min, max=args.field_max)
+        if args.time_quantum:
+            opts["timeQuantum"] = args.time_quantum
+        try:
+            _post(host, f"/index/{args.index}/field/{args.field}", {"options": opts})
+        except urllib.error.HTTPError as e:
+            if e.code != 409:
+                raise
+
+    def flush(rows, cols, timestamps):
+        if not cols:
+            return
+        if args.values:
+            _post(
+                host,
+                f"/index/{args.index}/field/{args.field}/import-value",
+                {"columnIDs": cols, "values": rows},
+            )
+        else:
+            body = {"rowIDs": rows, "columnIDs": cols}
+            if any(t for t in timestamps):
+                body["timestamps"] = timestamps
+            _post(host, f"/index/{args.index}/field/{args.field}/import", body)
+
+    total = 0
+    for path in args.files:
+        f = sys.stdin if path == "-" else open(path)
+        rows, cols, timestamps = [], [], []
+        try:
+            for lineno, record in enumerate(csv.reader(f), 1):
+                if not record or not record[0].strip():
+                    continue
+                try:
+                    a = int(record[0])
+                    b = int(record[1])
+                except (ValueError, IndexError) as e:
+                    print(f"{path}:{lineno}: bad record {record!r}: {e}", file=sys.stderr)
+                    return 1
+                rows.append(a)
+                cols.append(b)
+                ts = 0
+                if len(record) > 2 and record[2].strip():
+                    ts = int(
+                        datetime.strptime(
+                            record[2].strip(), "%Y-%m-%dT%H:%M"
+                        ).timestamp()
+                    )
+                timestamps.append(ts)
+                if len(cols) >= args.batch_size:
+                    flush(rows, cols, timestamps)
+                    total += len(cols)
+                    rows, cols, timestamps = [], [], []
+        finally:
+            if f is not sys.stdin:
+                f.close()
+        flush(rows, cols, timestamps)
+        total += len(cols)
+    print(f"imported {total} records", file=sys.stderr)
+    return 0
+
+
+def cmd_export(args) -> int:
+    host = args.host if args.host.startswith("http") else f"http://{args.host}"
+    with urllib.request.urlopen(host + "/internal/shards/max", timeout=60) as resp:
+        max_shards = json.loads(resp.read()).get("standard", {})
+    max_shard = max_shards.get(args.index, 0)
+    out = open(args.output, "w") if args.output else sys.stdout
+    try:
+        for shard in range(max_shard + 1):
+            url = f"{host}/export?index={args.index}&field={args.field}&shard={shard}"
+            with urllib.request.urlopen(url, timeout=60) as resp:
+                out.write(resp.read().decode())
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    return 0
+
+
+def cmd_check(args) -> int:
+    """Verify fragment file integrity (reference ctl/check.go)."""
+    from pilosa_tpu.roaring import Bitmap
+
+    rc = 0
+    for path in args.files:
+        if path.endswith(".cache") or path.endswith(".snapshotting"):
+            continue
+        try:
+            with open(path, "rb") as f:
+                b = Bitmap.unmarshal_binary(f.read())
+            # container-level invariants
+            for key in b.sorted_keys():
+                c = b.containers[key]
+                p = c.positions()
+                if p.size != c.n:
+                    raise ValueError(
+                        f"container {key}: cardinality mismatch {p.size} != {c.n}"
+                    )
+                if p.size > 1 and not (p[:-1] < p[1:]).all():
+                    raise ValueError(f"container {key}: positions not sorted/unique")
+            print(f"{path}: ok (bits={b.count()}, containers={len(b.containers)}, ops={b.op_n})")
+        except Exception as e:
+            print(f"{path}: FAILED: {e}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def cmd_inspect(args) -> int:
+    """Dump container layout (reference ctl/inspect.go)."""
+    from pilosa_tpu.roaring import Bitmap
+
+    names = {1: "array", 2: "bitmap", 3: "run"}
+    for path in args.files:
+        with open(path, "rb") as f:
+            b = Bitmap.unmarshal_binary(f.read())
+        print(f"{path}: bits={b.count()} containers={len(b.containers)} opN={b.op_n}")
+        print(f"{'KEY':>12} {'TYPE':>8} {'N':>8} {'SIZE':>8}")
+        for key in b.sorted_keys():
+            c = b.containers[key]
+            print(f"{key:>12} {names.get(c.typ, '?'):>8} {c.n:>8} {c.size():>8}")
+    return 0
+
+
+def cmd_config(args) -> int:
+    cfg = _load_config(args)
+    print(cfg.to_toml(), end="")
+    return 0
+
+
+def cmd_generate_config(args) -> int:
+    from pilosa_tpu.server import Config
+
+    print(Config().to_toml(), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,9 @@ class Config:
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     anti_entropy_interval: float = 600.0  # reference server.go:238 (10m)
     metric: str = "expvar"  # expvar | none
+    # opt-in diagnostics phone-home endpoint (reference diagnostics.go);
+    # empty = disabled
+    diagnostics_host: str = ""
 
     @property
     def host(self) -> str:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Optional
 
 from pilosa_tpu.core import Holder
@@ -18,7 +19,9 @@ from pilosa_tpu.executor import DeviceStager, Executor
 from pilosa_tpu.server.api import API
 from pilosa_tpu.server.config import Config
 from pilosa_tpu.server.http_handler import Handler, make_http_server
+from pilosa_tpu import __version__
 from pilosa_tpu.utils.attrstore import new_attr_store
+from pilosa_tpu.utils.diagnostics import DiagnosticsCollector
 from pilosa_tpu.utils.logger import NOP_LOGGER, StandardLogger
 from pilosa_tpu.utils.stats import ExpvarStatsClient, NOP_STATS
 from pilosa_tpu.utils.translate import TranslateStore
@@ -59,6 +62,11 @@ class Server:
             stats=self.stats,
             long_query_time=self.config.cluster.long_query_time,
         )
+        self.diagnostics = DiagnosticsCollector(
+            host=getattr(self.config, "diagnostics_host", ""),
+            version=__version__,
+            logger=self.logger,
+        )
         self.httpd = None
         self._serve_thread: Optional[threading.Thread] = None
         self.node_id: str = ""
@@ -87,6 +95,61 @@ class Server:
             self.executor.cluster = self.cluster
             self.api.cluster = self.cluster
             self.cluster.attach_server(self)
+        self._start_background_loops()
+
+    def _start_background_loops(self) -> None:
+        """reference server.go: monitorAntiEntropy:400, monitorRuntime:683,
+        monitorDiagnostics:633."""
+
+        def anti_entropy_loop():
+            interval = self.config.anti_entropy_interval
+            if interval <= 0:
+                return
+            while not self._closed.wait(interval):
+                try:
+                    if self.cluster is not None:
+                        t0 = time.monotonic()
+                        self.cluster.sync_holder()
+                        self.stats.histogram(
+                            "antiEntropyDurationSeconds", time.monotonic() - t0
+                        )
+                except Exception as e:
+                    self.logger.printf("anti-entropy sync error: %s", e)
+
+        def runtime_monitor_loop():
+            import gc
+
+            while not self._closed.wait(10.0):
+                try:
+                    import resource
+
+                    usage = resource.getrusage(resource.RUSAGE_SELF)
+                    self.stats.gauge("maxRSSKB", usage.ru_maxrss)
+                    self.stats.gauge("threads", threading.active_count())
+                    counts = gc.get_count()
+                    self.stats.gauge("gcGen0", counts[0])
+                    self.stats.gauge("openFragments", self._count_fragments())
+                except Exception:
+                    pass
+
+        def diagnostics_loop():
+            if self.diagnostics.host == "":
+                return
+            while not self._closed.wait(3600.0):
+                self.diagnostics.enrich_with_os_info()
+                self.diagnostics.enrich_with_schema(self.holder)
+                self.diagnostics.flush()
+
+        for fn in (anti_entropy_loop, runtime_monitor_loop, diagnostics_loop):
+            threading.Thread(target=fn, daemon=True).start()
+
+    def _count_fragments(self) -> int:
+        n = 0
+        for idx in self.holder.indexes.values():
+            for f in idx.fields.values():
+                for v in f.views.values():
+                    n += len(v.fragments)
+        return n
 
     def _build_cluster(self):
         from pilosa_tpu.parallel.cluster import Cluster

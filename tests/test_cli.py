@@ -1,0 +1,129 @@
+"""CLI tests — each subcommand against live servers (mirrors reference
+ctl/*_test.go)."""
+
+import io
+import json
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.cli.main import main
+from pilosa_tpu.server import Config, Server
+
+
+@pytest.fixture()
+def server(tmp_path):
+    cfg = Config(
+        data_dir=str(tmp_path / "data"), bind="127.0.0.1:0", metric="none",
+        device_policy="never",
+    )
+    s = Server(cfg)
+    s.open()
+    yield s
+    s.close()
+
+
+def test_import_and_export(tmp_path, server, capsys):
+    csv_file = tmp_path / "data.csv"
+    csv_file.write_text("1,100\n1,200\n2,100\n")
+    rc = main(
+        [
+            "import",
+            "--host", server.uri,
+            "-i", "i", "-f", "f", "--create",
+            str(csv_file),
+        ]
+    )
+    assert rc == 0
+    body = json.dumps({}).encode()
+    r = urllib.request.Request(
+        server.uri + "/index/i/query", data=b"Row(f=1)", method="POST"
+    )
+    with urllib.request.urlopen(r) as resp:
+        out = json.loads(resp.read())
+    assert out["results"][0]["columns"] == [100, 200]
+
+    out_file = tmp_path / "out.csv"
+    rc = main(
+        ["export", "--host", server.uri, "-i", "i", "-f", "f", "-o", str(out_file)]
+    )
+    assert rc == 0
+    assert sorted(out_file.read_text().strip().splitlines()) == [
+        "1,100",
+        "1,200",
+        "2,100",
+    ]
+
+
+def test_import_values(tmp_path, server):
+    csv_file = tmp_path / "vals.csv"
+    csv_file.write_text("10,1\n20,2\n30,3\n")  # value,col pairs (row=value)
+    rc = main(
+        [
+            "import", "--host", server.uri, "-i", "i", "-f", "v",
+            "--create", "--field-type", "int", "--field-min", "0",
+            "--field-max", "100", "--values", str(csv_file),
+        ]
+    )
+    assert rc == 0
+    r = urllib.request.Request(
+        server.uri + "/index/i/query", data=b'Sum(field="v")', method="POST"
+    )
+    with urllib.request.urlopen(r) as resp:
+        out = json.loads(resp.read())
+    assert out["results"][0] == {"value": 60, "count": 3}
+
+
+def test_import_with_timestamp(tmp_path, server):
+    csv_file = tmp_path / "t.csv"
+    csv_file.write_text("1,100,2018-02-03T00:00\n")
+    rc = main(
+        [
+            "import", "--host", server.uri, "-i", "i", "-f", "t",
+            "--create", "--field-type", "time", "--time-quantum", "YMD",
+            str(csv_file),
+        ]
+    )
+    assert rc == 0
+    r = urllib.request.Request(
+        server.uri + "/index/i/query",
+        data=b"Range(t=1, 2018-01-01T00:00, 2019-01-01T00:00)",
+        method="POST",
+    )
+    with urllib.request.urlopen(r) as resp:
+        out = json.loads(resp.read())
+    assert out["results"][0]["columns"] == [100]
+
+
+def test_check_and_inspect(tmp_path, capsys):
+    from pilosa_tpu.core import Fragment
+
+    frag_path = tmp_path / "frag"
+    f = Fragment(str(frag_path), "i", "f", "standard", 0)
+    f.open()
+    f.set_bit(1, 5)
+    f.set_bit(1, 6)
+    f.close()
+    assert main(["check", str(frag_path)]) == 0
+    out = capsys.readouterr().out
+    assert "ok" in out and "bits=2" in out
+    assert main(["inspect", str(frag_path)]) == 0
+    out = capsys.readouterr().out
+    assert "array" in out
+
+    bad = tmp_path / "bad"
+    bad.write_bytes(b"\x00" * 32)
+    assert main(["check", str(bad)]) == 1
+
+
+def test_config_commands(tmp_path, capsys):
+    assert main(["generate-config"]) == 0
+    out = capsys.readouterr().out
+    assert "data-dir" in out and "[cluster]" in out
+    cfg_file = tmp_path / "c.toml"
+    cfg_file.write_text('bind = "1.2.3.4:5555"\n')
+    assert main(["config", "-c", str(cfg_file)]) == 0
+    out = capsys.readouterr().out
+    assert "1.2.3.4:5555" in out
