@@ -1,0 +1,117 @@
+// Native CPU bitmap kernels — the host-side hot loops behind the roaring
+// engine (see pilosa_tpu/native_bridge.py for the ctypes binding).
+//
+// The reference implements these as tight Go loops over containers
+// (reference roaring/roaring.go:1836-1949 intersectionCount*,
+// :3336-3374 popcount slices). Here they are C++ with 64-bit word
+// parallelism + __builtin_popcountll, exposed C-ABI so Python loads them
+// via ctypes with a numpy fallback when the library isn't built.
+//
+// Device-side equivalents live in pilosa_tpu/ops (XLA); these kernels
+// serve the CPU source of truth: mutation bookkeeping, the CPU execution
+// path, and the import/merge pipeline.
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// popcount over a packed word array
+uint64_t pt_popcount(const uint64_t* words, size_t n) {
+    uint64_t total = 0;
+    for (size_t i = 0; i < n; i++) {
+        total += static_cast<uint64_t>(__builtin_popcountll(words[i]));
+    }
+    return total;
+}
+
+// popcount(a & b) without materialising the intersection
+uint64_t pt_intersection_count(const uint64_t* a, const uint64_t* b, size_t n) {
+    uint64_t total = 0;
+    for (size_t i = 0; i < n; i++) {
+        total += static_cast<uint64_t>(__builtin_popcountll(a[i] & b[i]));
+    }
+    return total;
+}
+
+// elementwise boolean ops
+void pt_and(const uint64_t* a, const uint64_t* b, uint64_t* out, size_t n) {
+    for (size_t i = 0; i < n; i++) out[i] = a[i] & b[i];
+}
+void pt_or(const uint64_t* a, const uint64_t* b, uint64_t* out, size_t n) {
+    for (size_t i = 0; i < n; i++) out[i] = a[i] | b[i];
+}
+void pt_xor(const uint64_t* a, const uint64_t* b, uint64_t* out, size_t n) {
+    for (size_t i = 0; i < n; i++) out[i] = a[i] ^ b[i];
+}
+void pt_andnot(const uint64_t* a, const uint64_t* b, uint64_t* out, size_t n) {
+    for (size_t i = 0; i < n; i++) out[i] = a[i] & ~b[i];
+}
+
+// sorted-uint16 array intersection (array-array containers); returns the
+// output length. out must have room for min(na, nb) entries.
+size_t pt_intersect_sorted_u16(const uint16_t* a, size_t na, const uint16_t* b,
+                               size_t nb, uint16_t* out) {
+    size_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        uint16_t va = a[i], vb = b[j];
+        if (va < vb) {
+            i++;
+        } else if (va > vb) {
+            j++;
+        } else {
+            out[k++] = va;
+            i++;
+            j++;
+        }
+    }
+    return k;
+}
+
+// count-only sorted-array intersection
+size_t pt_intersection_count_sorted_u16(const uint16_t* a, size_t na,
+                                        const uint16_t* b, size_t nb) {
+    size_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        uint16_t va = a[i], vb = b[j];
+        if (va < vb) {
+            i++;
+        } else if (va > vb) {
+            j++;
+        } else {
+            k++;
+            i++;
+            j++;
+        }
+    }
+    return k;
+}
+
+// TopN scoring: popcount(src & row) for each row of a [rows x words]
+// matrix — the CPU mirror of ops.intersection_counts_matrix.
+void pt_intersection_counts_matrix(const uint64_t* src, const uint64_t* mat,
+                                   size_t rows, size_t words, int64_t* out) {
+    for (size_t r = 0; r < rows; r++) {
+        const uint64_t* row = mat + r * words;
+        uint64_t total = 0;
+        for (size_t i = 0; i < words; i++) {
+            total += static_cast<uint64_t>(__builtin_popcountll(src[i] & row[i]));
+        }
+        out[r] = static_cast<int64_t>(total);
+    }
+}
+
+// per-word popcount into an output array (container occupancy scans)
+void pt_popcount_per_block(const uint64_t* words, size_t n_blocks,
+                           size_t words_per_block, int64_t* out) {
+    for (size_t b = 0; b < n_blocks; b++) {
+        const uint64_t* block = words + b * words_per_block;
+        uint64_t total = 0;
+        for (size_t i = 0; i < words_per_block; i++) {
+            total += static_cast<uint64_t>(__builtin_popcountll(block[i]));
+        }
+        out[b] = static_cast<int64_t>(total);
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,170 @@
+"""ctypes binding for the native C++ bitmap kernels (native/).
+
+Auto-builds ``native/libpilosa_kernels.so`` with g++ on first import if
+missing, and degrades to numpy implementations when no compiler is
+available — the roaring engine works either way, the native path just
+removes temporaries and Python overhead from the hot loops.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libpilosa_kernels.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "bitmap_kernels.cpp")
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(
+            [
+                "g++",
+                "-O3",
+                "-march=native",
+                "-funroll-loops",
+                "-fPIC",
+                "-shared",
+                "-std=c++17",
+                "-o",
+                _SO_PATH,
+                src,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError:
+        return None
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.pt_popcount.restype = ctypes.c_uint64
+    lib.pt_popcount.argtypes = [u64p, ctypes.c_size_t]
+    lib.pt_intersection_count.restype = ctypes.c_uint64
+    lib.pt_intersection_count.argtypes = [u64p, u64p, ctypes.c_size_t]
+    for name in ("pt_and", "pt_or", "pt_xor", "pt_andnot"):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [u64p, u64p, u64p, ctypes.c_size_t]
+    lib.pt_intersect_sorted_u16.restype = ctypes.c_size_t
+    lib.pt_intersect_sorted_u16.argtypes = [
+        u16p, ctypes.c_size_t, u16p, ctypes.c_size_t, u16p,
+    ]
+    lib.pt_intersection_count_sorted_u16.restype = ctypes.c_size_t
+    lib.pt_intersection_count_sorted_u16.argtypes = [
+        u16p, ctypes.c_size_t, u16p, ctypes.c_size_t,
+    ]
+    lib.pt_intersection_counts_matrix.restype = None
+    lib.pt_intersection_counts_matrix.argtypes = [
+        u64p, u64p, ctypes.c_size_t, ctypes.c_size_t, i64p,
+    ]
+    lib.pt_popcount_per_block.restype = None
+    lib.pt_popcount_per_block.argtypes = [
+        u64p, ctypes.c_size_t, ctypes.c_size_t, i64p,
+    ]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _u64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def _u16p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def popcount(words: np.ndarray) -> int:
+    lib = _load()
+    if lib is None:
+        return int(np.bitwise_count(words).sum())
+    words = np.ascontiguousarray(words, dtype=np.uint64)
+    return int(lib.pt_popcount(_u64p(words), words.size))
+
+
+def intersection_count_words(a: np.ndarray, b: np.ndarray) -> int:
+    lib = _load()
+    if lib is None:
+        return int(np.bitwise_count(a & b).sum())
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    b = np.ascontiguousarray(b, dtype=np.uint64)
+    return int(lib.pt_intersection_count(_u64p(a), _u64p(b), a.size))
+
+
+def intersect_sorted_u16(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        return np.intersect1d(a, b, assume_unique=True)
+    a = np.ascontiguousarray(a, dtype=np.uint16)
+    b = np.ascontiguousarray(b, dtype=np.uint16)
+    out = np.empty(min(a.size, b.size), dtype=np.uint16)
+    n = lib.pt_intersect_sorted_u16(_u16p(a), a.size, _u16p(b), b.size, _u16p(out))
+    return out[:n]
+
+
+def intersection_count_sorted_u16(a: np.ndarray, b: np.ndarray) -> int:
+    lib = _load()
+    if lib is None:
+        return int(np.intersect1d(a, b, assume_unique=True).size)
+    a = np.ascontiguousarray(a, dtype=np.uint16)
+    b = np.ascontiguousarray(b, dtype=np.uint16)
+    return int(lib.pt_intersection_count_sorted_u16(_u16p(a), a.size, _u16p(b), b.size))
+
+
+def intersection_counts_matrix(src: np.ndarray, mat: np.ndarray) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        return np.bitwise_count(mat & src[None, :]).sum(axis=1).astype(np.int64)
+    src = np.ascontiguousarray(src, dtype=np.uint64)
+    mat = np.ascontiguousarray(mat, dtype=np.uint64)
+    out = np.empty(mat.shape[0], dtype=np.int64)
+    lib.pt_intersection_counts_matrix(
+        _u64p(src), _u64p(mat), mat.shape[0], mat.shape[1], _i64p(out)
+    )
+    return out
+
+
+def popcount_per_block(words: np.ndarray, words_per_block: int) -> np.ndarray:
+    lib = _load()
+    n_blocks = words.size // words_per_block
+    if lib is None:
+        return (
+            np.bitwise_count(words.reshape(n_blocks, words_per_block))
+            .sum(axis=1)
+            .astype(np.int64)
+        )
+    words = np.ascontiguousarray(words, dtype=np.uint64)
+    out = np.empty(n_blocks, dtype=np.int64)
+    lib.pt_popcount_per_block(_u64p(words), n_blocks, words_per_block, _i64p(out))
+    return out

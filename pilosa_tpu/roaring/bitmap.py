@@ -501,19 +501,25 @@ class Bitmap:
 
     def intersection_count(self, other: "Bitmap") -> int:
         """Popcount of the intersection without materialising it
-        (reference IntersectionCount:344)."""
+        (reference IntersectionCount:344). Container-pair dispatch:
+        array×array via sorted-merge, small-array×any via probes, dense
+        pairs via the native word kernel."""
+        from pilosa_tpu import native_bridge
+
         n = 0
         keys = self.containers.keys() & other.containers.keys()
         for key in keys:
             a, b = self.containers[key], other.containers[key]
-            if a.typ == CONTAINER_ARRAY and a.n <= 64:
+            if a.typ == CONTAINER_ARRAY and b.typ == CONTAINER_ARRAY:
+                n += native_bridge.intersection_count_sorted_u16(a.array, b.array)
+            elif a.typ == CONTAINER_ARRAY and a.n <= 64:
                 p = a.array
                 n += sum(1 for v in p if b.contains(int(v)))
             elif b.typ == CONTAINER_ARRAY and b.n <= 64:
                 p = b.array
                 n += sum(1 for v in p if a.contains(int(v)))
             else:
-                n += int(np.bitwise_count(a.words() & b.words()).sum())
+                n += native_bridge.intersection_count_words(a.words(), b.words())
         return n
 
     def any(self) -> bool:
@@ -739,8 +745,10 @@ def unmarshal_op(data: bytes) -> tuple[int, int]:
 
 def _intersect_containers(a: Container, b: Container) -> Container:
     if a.typ == CONTAINER_ARRAY and b.typ == CONTAINER_ARRAY:
+        from pilosa_tpu import native_bridge
+
         return Container.from_array(
-            np.intersect1d(a.array, b.array, assume_unique=True)
+            native_bridge.intersect_sorted_u16(a.array, b.array)
         )
     if a.typ == CONTAINER_ARRAY:
         keep = np.fromiter(
