@@ -80,6 +80,35 @@ def main():
     tpu_qps = N_QUERIES / tpu_elapsed
     p50 = sorted(lat)[len(lat) // 2] * 1000
 
+    # ---- Pallas-tiled variant (TPU only): keep whichever is faster ----
+    pallas_qps = 0.0
+    if jax.devices()[0].platform not in ("cpu",):
+        try:
+            from pilosa_tpu.ops.pallas_kernels import (
+                intersection_counts_matrix_pallas,
+                pad_for_pallas,
+            )
+
+            padded, true_r = pad_for_pallas(mat32)
+            dev_pmat = jax.device_put(padded)
+
+            @jax.jit
+            def topn_step_pallas(src, pmat):
+                scores = intersection_counts_matrix_pallas(src, pmat)
+                counts, ids = jax.lax.top_k(scores[:true_r], TOPK)
+                return ids, counts
+
+            ids, _ = topn_step_pallas(jax.device_put(srcs32[0]), dev_pmat)
+            ids.block_until_ready()
+            t0 = time.perf_counter()
+            for q in range(N_QUERIES):
+                ids, _ = topn_step_pallas(jax.device_put(srcs32[q]), dev_pmat)
+                ids.block_until_ready()
+            pallas_qps = N_QUERIES / (time.perf_counter() - t0)
+        except Exception:
+            pallas_qps = 0.0
+    best_qps = max(tpu_qps, pallas_qps)
+
     # ---- CPU baseline: roaring per-candidate intersection counts ----
     # A TopN query walks every candidate row computing
     # src.intersection_count(row) (the reference's fragment.top hot loop).
@@ -103,11 +132,13 @@ def main():
     print(
         json.dumps(
             {
-                "metric": f"TopN queries/sec ({R} rows x 1M cols, {int(DENSITY*100)}% density, single chip)",
-                "value": round(tpu_qps, 2),
+                "metric": f"TopN queries/sec ({R} rows x 1M cols, ~2% density, single chip)",
+                "value": round(best_qps, 2),
                 "unit": "queries/s",
-                "vs_baseline": round(tpu_qps / cpu_qps, 2),
+                "vs_baseline": round(best_qps / cpu_qps, 2),
                 "p50_ms": round(p50, 3),
+                "xla_qps": round(tpu_qps, 2),
+                "pallas_qps": round(pallas_qps, 2),
                 "baseline_cpu_qps": round(cpu_qps, 3),
                 "platform": jax.devices()[0].platform,
             }
