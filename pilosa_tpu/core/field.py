@@ -176,10 +176,18 @@ class Field:
 
     def _load_meta(self) -> None:
         try:
-            with open(self._meta_path()) as f:
-                self.options = FieldOptions.from_dict(json.load(f))
+            with open(self._meta_path(), "rb") as f:
+                raw = f.read()
         except FileNotFoundError:
             self.save_meta()
+            return
+        try:
+            self.options = FieldOptions.from_dict(json.loads(raw))
+        except (ValueError, UnicodeDecodeError):
+            # reference data dir: .meta is a protobuf FieldOptions
+            from pilosa_tpu.utils.protometa import decode_field_options
+
+            self.options = FieldOptions.from_dict(decode_field_options(raw))
 
     # -- accessors --
 

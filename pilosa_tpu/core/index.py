@@ -59,10 +59,18 @@ class Index:
 
     def _load_meta(self) -> None:
         try:
-            with open(self._meta_path()) as f:
-                self.keys = json.load(f).get("keys", False)
+            with open(self._meta_path(), "rb") as f:
+                raw = f.read()
         except FileNotFoundError:
             self.save_meta()
+            return
+        try:
+            self.keys = json.loads(raw).get("keys", False)
+        except (ValueError, UnicodeDecodeError):
+            # reference data dir: .meta is a protobuf IndexMeta
+            from pilosa_tpu.utils.protometa import decode_index_meta
+
+            self.keys = decode_index_meta(raw)["keys"]
 
     # -- fields --
 

@@ -141,6 +141,26 @@ class InternalClient:
             query={"index": index, "field": field, "view": view, "shard": shard},
         )
 
+    # -- attr diff sync (reference ColumnAttrDiff/RowAttrDiff:732,776) --
+
+    def column_attr_diff(self, uri: str, index: str, blocks: list) -> dict:
+        resp = self._request(
+            "POST",
+            uri,
+            f"/internal/index/{index}/attr/diff",
+            body=json.dumps({"blocks": blocks}).encode(),
+        )
+        return resp.get("attrs", {})
+
+    def row_attr_diff(self, uri: str, index: str, field: str, blocks: list) -> dict:
+        resp = self._request(
+            "POST",
+            uri,
+            f"/internal/index/{index}/field/{field}/attr/diff",
+            body=json.dumps({"blocks": blocks}).encode(),
+        )
+        return resp.get("attrs", {})
+
     # -- control messages (reference SendMessage, http/client.go:822) --
 
     def send_message(self, uri: str, msg: dict) -> None:

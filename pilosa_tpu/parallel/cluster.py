@@ -604,6 +604,36 @@ class Cluster:
         if self.replica_n < 2 or self.server is None:
             return
         holder = self.server.holder
+        # attribute-store diff sync first (reference holder.go:654-740)
+        for iname, idx in holder.indexes.items():
+            for node in self._other_nodes():
+                try:
+                    if idx.column_attrs is not None:
+                        blocks = [
+                            [bid, digest.hex()]
+                            for bid, digest in idx.column_attrs.blocks()
+                        ]
+                        attrs = self.client.column_attr_diff(node.uri, iname, blocks)
+                        if attrs:
+                            idx.column_attrs.set_bulk_attrs(
+                                {int(k): v for k, v in attrs.items()}
+                            )
+                    for fname, fld in idx.fields.items():
+                        if fld.row_attr_store is None:
+                            continue
+                        blocks = [
+                            [bid, digest.hex()]
+                            for bid, digest in fld.row_attr_store.blocks()
+                        ]
+                        attrs = self.client.row_attr_diff(
+                            node.uri, iname, fname, blocks
+                        )
+                        if attrs:
+                            fld.row_attr_store.set_bulk_attrs(
+                                {int(k): v for k, v in attrs.items()}
+                            )
+                except ClientError:
+                    continue
         for iname, idx in holder.indexes.items():
             for fname, fld in idx.fields.items():
                 for vname, view in fld.views.items():

@@ -390,6 +390,38 @@ class API:
             raise APIError("cluster not configured")
         self.cluster.resize_abort()
 
+    def column_attr_diff(self, index: str, blocks: list) -> dict:
+        """Return column attrs for blocks that differ from the caller's
+        checksums (reference api.go attr-diff path / holder.go:654-740)."""
+        idx = self.holder.index(index)
+        if idx is None:
+            raise NotFoundError(f"index not found: {index}")
+        store = idx.column_attrs
+        if store is None:
+            return {}
+        theirs = [(b[0], bytes.fromhex(b[1])) for b in blocks]
+        mine = store.blocks()
+        their_map = dict(theirs)
+        out = {}
+        for bid, digest in mine:
+            if their_map.get(bid) != digest:
+                out.update(store.block_data(bid))
+        return {str(k): v for k, v in out.items()}
+
+    def row_attr_diff(self, index: str, field: str, blocks: list) -> dict:
+        f = self.holder.field(index, field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        store = f.row_attr_store
+        if store is None:
+            return {}
+        theirs = dict((b[0], bytes.fromhex(b[1])) for b in blocks)
+        out = {}
+        for bid, digest in store.blocks():
+            if theirs.get(bid) != digest:
+                out.update(store.block_data(bid))
+        return {str(k): v for k, v in out.items()}
+
     def get_translate_data(self, offset: int) -> bytes:
         ts = self.executor.translate_store
         if ts is None:

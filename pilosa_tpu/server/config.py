@@ -42,6 +42,9 @@ class Config:
     # opt-in diagnostics phone-home endpoint (reference diagnostics.go);
     # empty = disabled
     diagnostics_host: str = ""
+    # translate-store primary to replicate the key WAL from (reference
+    # TranslateFile primary/replica streaming, translate.go:259-310)
+    translate_primary_url: str = ""
 
     @property
     def host(self) -> str:

@@ -105,9 +105,14 @@ class Handler:
             Route("GET", r"/internal/shards/max", lambda req: {"standard": a.max_shards()}),
             Route("GET", r"/internal/translate/data", self.get_translate_data),
             Route(
-                "GET",
+                "POST",
                 r"/internal/index/(?P<index>[^/]+)/attr/diff",
-                self.get_attr_diff_stub,
+                self.post_column_attr_diff,
+            ),
+            Route(
+                "POST",
+                r"/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/attr/diff",
+                self.post_row_attr_diff,
             ),
             Route("GET", r"/debug/vars", self.get_debug_vars),
         ]
@@ -279,8 +284,21 @@ class Handler:
         data = self.api.get_translate_data(int(q.get("offset", ["0"])[0]))
         return RawResponse(data, "application/octet-stream")
 
-    def get_attr_diff_stub(self, req) -> dict:
-        return {"attrs": {}}
+    def post_column_attr_diff(self, req) -> dict:
+        body = json.loads(req.body or b"{}")
+        return {
+            "attrs": self.api.column_attr_diff(
+                req.params["index"], body.get("blocks", [])
+            )
+        }
+
+    def post_row_attr_diff(self, req) -> dict:
+        body = json.loads(req.body or b"{}")
+        return {
+            "attrs": self.api.row_attr_diff(
+                req.params["index"], req.params["field"], body.get("blocks", [])
+            )
+        }
 
     def get_debug_vars(self, req) -> dict:
         if hasattr(self.stats, "snapshot"):

@@ -140,7 +140,29 @@ class Server:
                 self.diagnostics.enrich_with_schema(self.holder)
                 self.diagnostics.flush()
 
-        for fn in (anti_entropy_loop, runtime_monitor_loop, diagnostics_loop):
+        def translate_replication_loop():
+            primary = self.config.translate_primary_url
+            if not primary:
+                return
+            from pilosa_tpu.parallel.client import ClientError, InternalClient
+
+            client = InternalClient()
+            while not self._closed.wait(1.0):
+                try:
+                    data = client.translate_data(
+                        primary, self.translate_store.offset()
+                    )
+                    if data:
+                        self.translate_store.apply_log(data)
+                except ClientError:
+                    pass
+
+        for fn in (
+            anti_entropy_loop,
+            runtime_monitor_loop,
+            diagnostics_loop,
+            translate_replication_loop,
+        ):
             threading.Thread(target=fn, daemon=True).start()
 
     def _count_fragments(self) -> int:

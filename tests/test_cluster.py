@@ -300,3 +300,59 @@ class TestURI:
         assert (u.scheme, u.host, u.port) == ("http", "localhost", 10101)
         with pytest.raises(ValueError):
             URI.from_address("")
+
+
+class TestAttrSync:
+    def test_attr_diff_converges(self, tmp_path):
+        servers = boot_static_cluster(tmp_path, n=2, replicas=2)
+        try:
+            s0, s1 = servers
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            # attrs written on node 0 only (bypassing forward) to diverge
+            s0.holder.field("i", "f").row_attr_store.set_attrs(5, {"c": "x"})
+            s0.holder.index("i").column_attrs.set_attrs(9, {"n": "y"})
+            assert s1.holder.field("i", "f").row_attr_store.attrs(5) == {}
+            # sweep from node 1 pulls the remote diff
+            s1.cluster.sync_holder()
+            assert s1.holder.field("i", "f").row_attr_store.attrs(5) == {"c": "x"}
+            assert s1.holder.index("i").column_attrs.attrs(9) == {"n": "y"}
+        finally:
+            for s in servers:
+                s.close()
+
+
+class TestTranslateReplication:
+    def test_replica_pulls_key_log(self, tmp_path):
+        from pilosa_tpu.server import ClusterConfig, Config, Server
+
+        ports = free_ports(2)
+        s0 = Server(Config(
+            data_dir=str(tmp_path / "p"), bind=f"127.0.0.1:{ports[0]}",
+            metric="none", device_policy="never",
+        ))
+        s0.open()
+        try:
+            req(s0.uri, "POST", "/index/u", {"options": {"keys": True}})
+            req(s0.uri, "POST", "/index/u/field/l", {"options": {"keys": True}})
+            req(s0.uri, "POST", "/index/u/query", b'Set("alice", l="pizza")')
+            s1 = Server(Config(
+                data_dir=str(tmp_path / "r"), bind=f"127.0.0.1:{ports[1]}",
+                metric="none", device_policy="never",
+                translate_primary_url=s0.uri,
+            ))
+            s1.open()
+            try:
+                import time as _t
+
+                deadline = _t.monotonic() + 15
+                while _t.monotonic() < deadline:
+                    if s1.translate_store.translate_column_to_string("u", 1) == "alice":
+                        break
+                    _t.sleep(0.2)
+                assert s1.translate_store.translate_column_to_string("u", 1) == "alice"
+                assert s1.translate_store.translate_row_to_string("u", "l", 1) == "pizza"
+            finally:
+                s1.close()
+        finally:
+            s0.close()
