@@ -593,6 +593,156 @@ class Executor:
             )
         raise ValueError(f"invalid range operation: {cond.op}")
 
+    # -- shard-batched device path -------------------------------------------
+    # When this node executes many shards locally (single-node, or the
+    # remote leg of a distributed query), the whole shard set runs as ONE
+    # kernel dispatch over u32[S, W] stacks instead of S dispatches —
+    # the reference's per-shard goroutine fan-out vectorised away
+    # (SURVEY.md §2.2 'intra-node shard parallelism').
+
+    def _local_batchable(self, opt) -> bool:
+        return self.cluster is None or opt.remote
+
+    def _use_device_batched(self, index, c: Call, shards) -> bool:
+        if self.device_policy == "never" or len(shards) < 2:
+            return False
+        if self.device_policy == "always":
+            return True
+        total = 0
+        for shard in shards:
+            for frag in self._involved_fragments(index, c, shard):
+                total += len(frag.storage.containers)
+        return total >= AUTO_DEVICE_MIN_CONTAINERS
+
+    def _device_bitmap_stack(self, index, c: Call, shards):
+        """Lower a bitmap call subtree to u32[S, W] across shards."""
+        name = c.name
+        if name == "Row":
+            field_name = c.field_arg()
+            f = self.holder.field(index, field_name)
+            if f is None:
+                raise KeyError(f"field not found: {field_name}")
+            row_id, ok = c.uint_arg(field_name)
+            if not ok:
+                raise ValueError(f"Row() must specify {field_name}")
+            frags = tuple(
+                self.holder.fragment(index, field_name, VIEW_STANDARD, s)
+                for s in shards
+            )
+            return self.stager.row_stack(frags, row_id)
+        if name in ("Intersect", "Union", "Xor", "Difference"):
+            if not c.children:
+                if name in ("Intersect", "Difference"):
+                    raise ValueError(f"empty {name} query is currently not supported")
+                return np.zeros((len(shards), _W32), dtype=np.uint32)
+            acc = self._device_bitmap_stack(index, c.children[0], shards)
+            for child in c.children[1:]:
+                w = self._device_bitmap_stack(index, child, shards)
+                if name == "Intersect":
+                    acc = ops.and_(acc, w)
+                elif name == "Union":
+                    acc = ops.or_(acc, w)
+                elif name == "Xor":
+                    acc = ops.xor_(acc, w)
+                else:
+                    acc = ops.andnot(acc, w)
+            return acc
+        if name == "Range":
+            return self._device_range_stack(index, c, shards)
+        raise _NotDeviceable(name)
+
+    def _device_range_stack(self, index, c: Call, shards):
+        import jax
+
+        zeros = np.zeros((len(shards), _W32), dtype=np.uint32)
+        if not c.has_condition_arg():
+            field_name = c.field_arg()
+            f = self.holder.field(index, field_name)
+            if f is None:
+                raise KeyError(f"field not found: {field_name}")
+            row_id, ok = c.uint_arg(field_name)
+            start_str, ok1 = c.string_arg("_start")
+            end_str, ok2 = c.string_arg("_end")
+            if not (ok and ok1 and ok2):
+                raise _NotDeviceable("Range")
+            q = f.time_quantum()
+            if not q:
+                return zeros
+            start = datetime.strptime(start_str, TIME_FORMAT)
+            end = datetime.strptime(end_str, TIME_FORMAT)
+            acc = None
+            for view in views_by_time_range(VIEW_STANDARD, start, end, q):
+                frags = tuple(
+                    self.holder.fragment(index, field_name, view, s) for s in shards
+                )
+                if not any(frags):
+                    continue
+                w = self.stager.row_stack(frags, row_id)
+                acc = w if acc is None else ops.or_(acc, w)
+            return acc if acc is not None else zeros
+
+        ((field_name, cond),) = c.args.items()
+        f = self.holder.field(index, field_name)
+        if f is None:
+            raise KeyError(f"field not found: {field_name}")
+        bsig = f.bsi_group(field_name)
+        if bsig is None:
+            raise KeyError(f"bsiGroup not found: {field_name}")
+        depth = bsig.bit_depth()
+        frags = tuple(
+            self.holder.fragment(
+                index, field_name, VIEW_BSI_GROUP_PREFIX + field_name, s
+            )
+            for s in shards
+        )
+        if not any(frags):
+            return zeros
+        planes = self.stager.planes_stack(frags, depth)
+
+        if cond.op == NEQ and cond.value is None:
+            return planes[:, -1, :]
+        if cond.op == BETWEEN:
+            predicates = cond.int_slice_value()
+            base_min, base_max, out_of_range = bsig.base_value_between(*predicates)
+            if out_of_range:
+                return zeros
+            if predicates[0] <= bsig.min and predicates[1] >= bsig.max:
+                return planes[:, -1, :]
+            return jax.vmap(
+                lambda p: ops.bsi_range_between(
+                    p, np.uint32(base_min), np.uint32(base_max), bit_depth=depth
+                )
+            )(planes)
+        value = cond.value
+        if not isinstance(value, int) or isinstance(value, bool):
+            raise ValueError("Range(): conditions only support integer values")
+        base_value, out_of_range = bsig.base_value(cond.op, value)
+        if out_of_range and cond.op != NEQ:
+            return zeros
+        if (
+            (cond.op == "<" and value > bsig.max)
+            or (cond.op == "<=" and value >= bsig.max)
+            or (cond.op == ">" and value < bsig.min)
+            or (cond.op == ">=" and value <= bsig.min)
+        ):
+            return planes[:, -1, :]
+        if out_of_range and cond.op == NEQ:
+            return planes[:, -1, :]
+        pred = np.uint32(base_value)
+        if cond.op == "==":
+            kern = lambda p: ops.bsi_range_eq(p, pred, bit_depth=depth)
+        elif cond.op == "!=":
+            kern = lambda p: ops.bsi_range_neq(p, pred, bit_depth=depth)
+        elif cond.op in ("<", "<="):
+            kern = lambda p: ops.bsi_range_lt(
+                p, pred, bit_depth=depth, allow_equality=cond.op == "<="
+            )
+        else:
+            kern = lambda p: ops.bsi_range_gt(
+                p, pred, bit_depth=depth, allow_equality=cond.op == ">="
+            )
+        return jax.vmap(kern)(planes)
+
     # -- Count ---------------------------------------------------------------
 
     def _execute_count(self, index, c: Call, shards, opt) -> int:
@@ -601,6 +751,17 @@ class Executor:
         if len(c.children) > 1:
             raise ValueError("Count() only accepts a single bitmap input")
         child = c.children[0]
+
+        if (
+            self._local_batchable(opt)
+            and shards
+            and self._use_device_batched(index, child, shards)
+        ):
+            try:
+                words = self._device_bitmap_stack(index, child, shards)
+                return int(ops.count_bits(words))
+            except _NotDeviceable:
+                pass
 
         def map_fn(shard):
             if self._use_device(index, child, shard):
@@ -650,6 +811,45 @@ class Executor:
             raise ValueError("Sum(): field required")
         if len(c.children) > 1:
             raise ValueError("Sum() only accepts a single bitmap input")
+
+        # shard-batched fast path: one dispatch for all local shards
+        if self._local_batchable(opt) and shards and self._use_device_batched(index, c, shards):
+            field_name, _ = c.string_arg("field")
+            f = self.holder.field(index, field_name)
+            bsig = f.bsi_group(field_name) if f else None
+            if bsig is not None:
+                frags = tuple(
+                    self.holder.fragment(
+                        index, field_name, VIEW_BSI_GROUP_PREFIX + field_name, s
+                    )
+                    for s in shards
+                )
+                if any(frags):
+                    depth = bsig.bit_depth()
+                    try:
+                        if len(c.children) == 1:
+                            filt = self._device_bitmap_stack(
+                                index, c.children[0], shards
+                            )
+                            has_filter = True
+                        else:
+                            filt = np.zeros(
+                                (len(shards), _W32), dtype=np.uint32
+                            )
+                            has_filter = False
+                        planes = self.stager.planes_stack(frags, depth)
+                        counts = np.asarray(
+                            ops.bsi_plane_counts_batched(
+                                planes, filt, bit_depth=depth, has_filter=has_filter
+                            )
+                        )
+                        vsum = sum(int(counts[i]) << i for i in range(depth))
+                        vcount = int(counts[depth])
+                        if vcount == 0:
+                            return ValCount()
+                        return ValCount(vsum + vcount * bsig.min, vcount)
+                    except _NotDeviceable:
+                        pass
 
         def map_fn(shard):
             parts = self._bsi_shard_parts(index, c, shard)

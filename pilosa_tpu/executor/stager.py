@@ -100,6 +100,46 @@ class DeviceStager:
             v = self._put(key, self._to_device(words), words.nbytes)
         return v
 
+    # -- shard-batched staging (one array covering many fragments) ----------
+
+    def _stack_key(self, frags, kind: str, extra=()) -> tuple:
+        return (
+            tuple((id(f), f.generation) if f is not None else None for f in frags),
+            kind,
+        ) + tuple(extra)
+
+    def row_stack(self, frags, row_id: int):
+        """u32[S, W]: one row across S fragments (None → zeros)."""
+        import numpy as np
+        from pilosa_tpu import SHARD_WIDTH as SW
+
+        key = self._stack_key(frags, "row_stack", (row_id,))
+        v = self._get(key)
+        if v is None:
+            words = np.zeros((len(frags), SW // 64), dtype=np.uint64)
+            for i, f in enumerate(frags):
+                if f is not None:
+                    words[i] = f.row_words(row_id)
+            v = self._put(key, self._to_device(words), words.nbytes)
+        return v
+
+    def planes_stack(self, frags, bit_depth: int):
+        """u32[S, bit_depth+1, W] across S fragments (None → zeros)."""
+        import numpy as np
+        from pilosa_tpu import SHARD_WIDTH as SW
+
+        key = self._stack_key(frags, "planes_stack", (bit_depth,))
+        v = self._get(key)
+        if v is None:
+            words = np.zeros(
+                (len(frags), bit_depth + 1, SW // 64), dtype=np.uint64
+            )
+            for i, f in enumerate(frags):
+                if f is not None:
+                    words[i] = f.bsi_planes(bit_depth)
+            v = self._put(key, self._to_device(words), words.nbytes)
+        return v
+
     def clear(self) -> None:
         self._cache.clear()
         self._bytes = 0

@@ -203,3 +203,15 @@ def bsi_range_between(planes, pred_min, pred_max, *, bit_depth: int):
             k2 = jnp.bitwise_or(keep2, jnp.bitwise_and(b, jnp.bitwise_not(row)))
             keep2 = jnp.where(bit2, k2, keep2)
     return b
+
+
+@functools.partial(jax.jit, static_argnames=("bit_depth", "has_filter"))
+def bsi_plane_counts_batched(planes, filter_rows, *, bit_depth: int, has_filter: bool):
+    """Shard-batched Sum: planes u32[S, D+1, W], filter u32[S, W] →
+    i32[D+1] summed over shards in one dispatch."""
+    if has_filter:
+        block = jnp.bitwise_and(planes, filter_rows[:, None, :])
+    else:
+        block = planes
+    pc = jax.lax.population_count(block)
+    return jnp.sum(pc.astype(jnp.int32), axis=(0, 2))

@@ -293,3 +293,37 @@ class TestAutoPolicyEquivalence:
                     assert a.columns().tolist() == b.columns().tolist(), q
                 else:
                     assert a == b, q
+
+
+class TestBatchedShardPath:
+    def test_batched_count_and_sum_match_cpu(self, holder):
+        """Shard-batched device path (one dispatch over u32[S, W] stacks)
+        vs the CPU per-shard path on a many-shard workload."""
+        rng = np.random.default_rng(77)
+        idx = holder.create_index("i")
+        f = idx.create_field("f")
+        from pilosa_tpu.core.field import FieldOptions
+
+        v = idx.create_field("v", FieldOptions(type="int", min=-50, max=5000))
+        n_shards = 6
+        rows = rng.integers(0, 20, size=4000)
+        cols = rng.integers(0, n_shards * SHARD_WIDTH, size=4000)
+        f.import_bits(rows.tolist(), cols.tolist())
+        vcols = rng.choice(n_shards * SHARD_WIDTH, size=1500, replace=False)
+        vvals = rng.integers(-50, 5000, size=1500)
+        v.import_values(vcols.tolist(), vvals.tolist())
+
+        queries = [
+            "Count(Row(f=1))",
+            "Count(Intersect(Row(f=1), Row(f=2)))",
+            "Count(Union(Row(f=3), Xor(Row(f=4), Row(f=5)), Difference(Row(f=6), Row(f=7))))",
+            "Count(Range(v > 100))",
+            "Count(Range(v >< [0, 2500]))",
+            'Sum(field="v")',
+            'Sum(Row(f=1), field="v")',
+            'Sum(Range(v != null), field="v")',
+        ]
+        e_cpu = execu(holder, "never")
+        e_dev = execu(holder, "always")
+        for q in queries:
+            assert e_cpu.execute("i", q) == e_dev.execute("i", q), q

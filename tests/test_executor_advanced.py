@@ -1,0 +1,164 @@
+"""Advanced executor coverage: Tanimoto TopN, attribute filters, bulk
+attrs, multi-call queries, key translation edge cases (mirrors the long
+tail of reference executor_test.go)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SHARD_WIDTH
+from pilosa_tpu.core import FieldOptions, Holder
+from pilosa_tpu.executor import ExecOptions, Executor
+from pilosa_tpu.utils.attrstore import AttrStore
+from pilosa_tpu.utils.translate import TranslateStore
+
+
+@pytest.fixture()
+def holder():
+    h = Holder(new_attr_store=lambda path: AttrStore(None))
+    h.open()
+    return h
+
+
+def execu(holder, policy="never", translate=False):
+    return Executor(
+        holder,
+        device_policy=policy,
+        translate_store=TranslateStore() if translate else None,
+    )
+
+
+class TestTanimoto:
+    def setup_fp(self, h):
+        """Chemical-similarity style fingerprints (reference
+        docs/examples.md Tanimoto workload)."""
+        idx = h.create_index("mol")
+        f = idx.create_field("fp")
+        # molecule rows with fingerprint bits
+        fps = {
+            1: {1, 2, 3, 4, 5, 6},
+            2: {1, 2, 3, 4},
+            3: {1, 2, 9, 10},
+            4: {20, 21},
+        }
+        rows, cols = [], []
+        for row, bits in fps.items():
+            for b in bits:
+                rows.append(row)
+                cols.append(b)
+        f.import_bits(rows, cols)
+        return fps
+
+    @pytest.mark.parametrize("policy", ["never", "always"])
+    def test_tanimoto_threshold(self, holder, policy):
+        fps = self.setup_fp(holder)
+        e = execu(holder, policy)
+        # src = molecule 2's fingerprint {1,2,3,4}
+        (res,) = e.execute("mol", "TopN(fp, Row(fp=2), tanimotoThreshold=50)")
+        # tanimoto(row1) = ceil(4*100/(6+4-4)) = 67 > 50 ✓
+        # tanimoto(row2) = 100 > 50 ✓
+        # tanimoto(row3) = ceil(2*100/(4+4-2)) = 34 ≤ 50 ✗
+        ids = {p["id"] for p in res}
+        assert ids == {1, 2}
+
+    def test_tanimoto_invalid(self, holder):
+        self.setup_fp(holder)
+        e = execu(holder)
+        with pytest.raises(ValueError):
+            e.execute("mol", "TopN(fp, Row(fp=2), tanimotoThreshold=150)")
+
+
+class TestAttrFilters:
+    @pytest.mark.parametrize("policy", ["never", "always"])
+    def test_topn_attr_filter(self, holder, policy):
+        idx = holder.create_index("i")
+        f = idx.create_field("f")
+        for col in range(5):
+            f.set_bit(1, col)
+        for col in range(3):
+            f.set_bit(2, col)
+        for col in range(2):
+            f.set_bit(3, col)
+        f.row_attr_store.set_attrs(1, {"category": "a"})
+        f.row_attr_store.set_attrs(2, {"category": "b"})
+        f.row_attr_store.set_attrs(3, {"category": "a"})
+        f.view("standard").fragments[0].cache.recalculate()
+        e = execu(holder, policy)
+        (res,) = e.execute("i", 'TopN(f, n=5, attrName="category", attrValues=["a"])')
+        assert res == [{"id": 1, "count": 5}, {"id": 3, "count": 2}]
+
+    def test_row_attrs_on_row_query(self, holder):
+        idx = holder.create_index("i")
+        f = idx.create_field("f")
+        e = execu(holder)
+        e.execute("i", 'Set(1, f=10)SetRowAttrs(f, 10, foo="bar", n=5)')
+        (row,) = e.execute("i", "Row(f=10)")
+        assert row.attrs == {"foo": "bar", "n": 5}
+        # attr deletion via null
+        e.execute("i", "SetRowAttrs(f, 10, foo=null)")
+        (row,) = e.execute("i", "Row(f=10)")
+        assert row.attrs == {"n": 5}
+
+    def test_column_attrs(self, holder):
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        e = execu(holder)
+        e.execute("i", 'SetColumnAttrs(7, name="acme", active=true)')
+        assert idx.column_attrs.attrs(7) == {"name": "acme", "active": True}
+
+
+class TestKeyTranslation:
+    def test_string_col_requires_keys(self, holder):
+        holder.create_index("i").create_field("f")
+        e = execu(holder, translate=True)
+        with pytest.raises(ValueError):
+            e.execute("i", 'Set("alice", f=1)')
+
+    def test_keys_workflow(self, holder):
+        idx = holder.create_index("u", keys=True)
+        idx.create_field("l", FieldOptions(keys=True))
+        e = execu(holder, translate=True)
+        e.execute("u", 'Set("alice", l="pizza")')
+        e.execute("u", 'Set("bob", l="pizza")')
+        e.execute("u", 'Set("alice", l="sushi")')
+        (row,) = e.execute("u", 'Row(l="pizza")')
+        assert row.keys == ["alice", "bob"]
+        (cnt,) = e.execute("u", 'Count(Row(l="sushi"))')
+        assert cnt == 1
+
+
+class TestMiscCalls:
+    def test_multi_call_query(self, holder):
+        holder.create_index("i").create_field("f")
+        e = execu(holder)
+        results = e.execute("i", "Set(1, f=1)Set(2, f=1)Count(Row(f=1))Clear(1, f=1)Count(Row(f=1))")
+        assert results == [True, True, 2, True, 1]
+
+    def test_max_writes_per_request(self, holder):
+        holder.create_index("i").create_field("f")
+        e = execu(holder)
+        e.max_writes_per_request = 2
+        with pytest.raises(ValueError):
+            e.execute("i", "Set(1, f=1)Set(2, f=1)Set(3, f=1)")
+
+    def test_count_requires_single_child(self, holder):
+        holder.create_index("i").create_field("f")
+        e = execu(holder)
+        with pytest.raises(ValueError):
+            e.execute("i", "Count()")
+        with pytest.raises(ValueError):
+            e.execute("i", "Count(Row(f=1), Row(f=2))")
+
+    def test_setvalue_multiple_fields(self, holder):
+        idx = holder.create_index("i")
+        idx.create_field("a", FieldOptions(type="int", min=0, max=100))
+        idx.create_field("b", FieldOptions(type="int", min=0, max=100))
+        e = execu(holder)
+        e.execute("i", "SetValue(col=1, a=10, b=20)")
+        assert idx.field("a").value(1) == (10, True)
+        assert idx.field("b").value(1) == (20, True)
+
+    def test_unknown_call(self, holder):
+        holder.create_index("i").create_field("f")
+        e = execu(holder)
+        with pytest.raises(ValueError):
+            e.execute("i", "Frobnicate(f=1)")
