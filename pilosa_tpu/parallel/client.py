@@ -90,6 +90,26 @@ class InternalClient:
             body=json.dumps(body).encode(),
         )
 
+    def import_bits_local(self, uri, index, field, row_ids, column_ids, timestamps=None):
+        body = {"rowIDs": list(row_ids), "columnIDs": list(column_ids), "local": True}
+        if timestamps is not None:
+            body["timestamps"] = list(timestamps)
+        self._request(
+            "POST",
+            uri,
+            f"/index/{index}/field/{field}/import",
+            body=json.dumps(body).encode(),
+        )
+
+    def import_values_local(self, uri, index, field, column_ids, values):
+        body = {"columnIDs": list(column_ids), "values": list(values), "local": True}
+        self._request(
+            "POST",
+            uri,
+            f"/index/{index}/field/{field}/import-value",
+            body=json.dumps(body).encode(),
+        )
+
     # -- fragment sync (reference FragmentBlocks/BlockData:637,682) --
 
     def fragment_blocks(self, uri: str, index: str, field: str, shard: int) -> list[dict]:

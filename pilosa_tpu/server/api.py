@@ -215,15 +215,42 @@ class API:
             if ts is None:
                 raise APIError("translate store not configured")
             row_ids = ts.translate_rows_to_ids(index, field, row_keys)
-        parsed_ts = None
-        if timestamps and any(t for t in timestamps):
-            from datetime import datetime
-
-            parsed_ts = [
-                datetime.fromtimestamp(t) if isinstance(t, (int, float)) and t else None
-                for t in timestamps
-            ]
+        # Route bit groups to their shard owners (the reference's client
+        # groups by owner before POSTing, http/client.go:276,922; routing
+        # server-side keeps single-endpoint imports correct in a cluster).
+        if self.cluster is not None and len(self.cluster.nodes) > 1:
+            self._route_import(
+                index, field, row_ids, column_ids, timestamps, local_only=False
+            )
+            return
+        parsed_ts = _parse_timestamps(timestamps)
         f.import_bits(row_ids, column_ids, parsed_ts)
+
+    def import_bits_local(self, index, field, row_ids, column_ids, timestamps=None):
+        """Internal: import bits into this node only (owner-side leg)."""
+        f = self.holder.field(index, field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        f.import_bits(row_ids, column_ids, _parse_timestamps(timestamps))
+
+    def _route_import(self, index, field, row_ids, column_ids, timestamps, local_only):
+        from pilosa_tpu import SHARD_WIDTH as SW
+
+        groups: dict[int, list[int]] = {}
+        for i, col in enumerate(column_ids):
+            groups.setdefault(col // SW, []).append(i)
+        ts = timestamps or [0] * len(column_ids)
+        for shard, idxs in sorted(groups.items()):
+            rows = [row_ids[i] for i in idxs]
+            cols = [column_ids[i] for i in idxs]
+            tss = [ts[i] for i in idxs] if timestamps else None
+            for node in self.cluster.shard_nodes(index, shard):
+                if node.id == self.cluster.node_id:
+                    self.import_bits_local(index, field, rows, cols, tss)
+                else:
+                    self.cluster.client.import_bits_local(
+                        node.uri, index, field, rows, cols, tss
+                    )
 
     def import_values(
         self,
@@ -242,6 +269,29 @@ class API:
             if ts is None:
                 raise APIError("translate store not configured")
             column_ids = ts.translate_columns_to_ids(index, column_keys)
+        if self.cluster is not None and len(self.cluster.nodes) > 1:
+            from pilosa_tpu import SHARD_WIDTH as SW
+
+            groups: dict[int, list[int]] = {}
+            for i, col in enumerate(column_ids):
+                groups.setdefault(col // SW, []).append(i)
+            for shard, idxs in sorted(groups.items()):
+                cols = [column_ids[i] for i in idxs]
+                vals = [values[i] for i in idxs]
+                for node in self.cluster.shard_nodes(index, shard):
+                    if node.id == self.cluster.node_id:
+                        f.import_values(cols, vals)
+                    else:
+                        self.cluster.client.import_values_local(
+                            node.uri, index, field, cols, vals
+                        )
+            return
+        f.import_values(column_ids, values)
+
+    def import_values_local(self, index, field, column_ids, values):
+        f = self.holder.field(index, field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
         f.import_values(column_ids, values)
 
     # -- export (reference api.ExportCSV:328) --
@@ -428,3 +478,14 @@ class API:
             raise APIError("translate store not configured")
         data, _ = ts.read_from(offset)
         return data
+
+
+def _parse_timestamps(timestamps):
+    if not timestamps or not any(t for t in timestamps):
+        return None
+    from datetime import datetime
+
+    return [
+        datetime.fromtimestamp(t) if isinstance(t, (int, float)) and t else None
+        for t in timestamps
+    ]

@@ -176,6 +176,15 @@ class Handler:
 
     def post_import(self, req) -> dict:
         body = json.loads(req.body or b"{}")
+        if body.get("local"):
+            self.api.import_bits_local(
+                req.params["index"],
+                req.params["field"],
+                body.get("rowIDs", []),
+                body.get("columnIDs", []),
+                timestamps=body.get("timestamps"),
+            )
+            return {}
         self.api.import_bits(
             req.params["index"],
             req.params["field"],
@@ -189,6 +198,14 @@ class Handler:
 
     def post_import_value(self, req) -> dict:
         body = json.loads(req.body or b"{}")
+        if body.get("local"):
+            self.api.import_values_local(
+                req.params["index"],
+                req.params["field"],
+                body.get("columnIDs", []),
+                body.get("values", []),
+            )
+            return {}
         self.api.import_values(
             req.params["index"],
             req.params["field"],

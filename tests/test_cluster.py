@@ -356,3 +356,50 @@ class TestTranslateReplication:
                 s1.close()
         finally:
             s0.close()
+
+
+class TestClusterImport:
+    def test_import_routes_to_shard_owners(self, tmp_path):
+        servers = boot_static_cluster(tmp_path, n=3)
+        try:
+            s0 = servers[0]
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            cols = [s * SHARD_WIDTH + 1 for s in range(6)]
+            st, _ = req(
+                s0.uri, "POST", "/index/i/field/f/import",
+                {"rowIDs": [1] * 6, "columnIDs": cols},
+            )
+            assert st == 200
+            # bits landed on the owning nodes only
+            for s in servers:
+                v = s.holder.view("i", "f", "standard")
+                frags = set(v.fragments) if v else set()
+                for shard in frags:
+                    assert s.cluster.owns_shard("i", shard), (s.uri, shard)
+            st, body = req(s0.uri, "POST", "/index/i/query", b"Row(f=1)")
+            assert body["results"][0]["columns"] == cols
+            st, body = req(servers[2].uri, "POST", "/index/i/query", b"Count(Row(f=1))")
+            assert body["results"][0] == 6
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_import_values_routes(self, tmp_path):
+        servers = boot_static_cluster(tmp_path, n=2)
+        try:
+            s0 = servers[0]
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/v",
+                {"options": {"type": "int", "min": 0, "max": 100}})
+            cols = [s * SHARD_WIDTH for s in range(4)]
+            st, _ = req(
+                s0.uri, "POST", "/index/i/field/v/import-value",
+                {"columnIDs": cols, "values": [10, 20, 30, 40]},
+            )
+            assert st == 200
+            st, body = req(servers[1].uri, "POST", "/index/i/query", b'Sum(field="v")')
+            assert body["results"][0] == {"value": 100, "count": 4}
+        finally:
+            for s in servers:
+                s.close()
