@@ -20,11 +20,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-TILE_R = 8
-TILE_W = 2048  # uint32 words per tile (8 KB rows; lane dim multiple of 128)
+TILE_R = 512  # rank-1 i32 outputs tile at T(512) in XLA layout on TPU
+TILE_W = 1024  # uint32 words per tile (keeps a 2 MB mat block in VMEM)
 
 
 def _scores_kernel(src_ref, mat_ref, out_ref):
+    # out is (1, R) so it carries the fixed (8, 128) rank-2 layout —
+    # rank-1 outputs get size-dependent XLA tilings (T(512)/T(1024)/…)
+    # that a fixed Mosaic block size can't match. The (1, TILE_R) block
+    # is revisited across the word grid for accumulation.
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -35,7 +39,7 @@ def _scores_kernel(src_ref, mat_ref, out_ref):
     partial = jnp.sum(
         jax.lax.population_count(block).astype(jnp.int32), axis=1
     )
-    out_ref[:] += partial
+    out_ref[:] += partial[None, :]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -48,9 +52,9 @@ def intersection_counts_matrix_pallas(src, mat, *, interpret: bool = False):
     """
     r, w = mat.shape
     grid = (r // TILE_R, w // TILE_W)
-    return pl.pallas_call(
+    out = pl.pallas_call(
         _scores_kernel,
-        out_shape=jax.ShapeDtypeStruct((r,), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((1, r), jnp.int32),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, TILE_W), lambda i, j: (0, j), memory_space=pltpu.VMEM),
@@ -58,9 +62,12 @@ def intersection_counts_matrix_pallas(src, mat, *, interpret: bool = False):
                 (TILE_R, TILE_W), lambda i, j: (i, j), memory_space=pltpu.VMEM
             ),
         ],
-        out_specs=pl.BlockSpec((TILE_R,), lambda i, j: (i,), memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec(
+            (1, TILE_R), lambda i, j: (0, i), memory_space=pltpu.VMEM
+        ),
         interpret=interpret,
     )(src.reshape(1, w), mat)
+    return out[0]
 
 
 def pad_for_pallas(mat):

@@ -165,20 +165,25 @@ def test_bsi_between(bsi_data, lo, hi):
 def test_pallas_scores_matches_xla():
     """Pallas TopN scoring kernel (interpret mode on CPU) vs the XLA path."""
     from pilosa_tpu.ops.pallas_kernels import (
+        TILE_W,
         intersection_counts_matrix_pallas,
         pad_for_pallas,
     )
 
     rng = np.random.default_rng(21)
-    R, Wp = 16, 2048  # one row tile × one word tile
+    R, Wp = 16, TILE_W
     mat = rng.integers(0, 2**32, size=(R, Wp), dtype=np.uint32)
     src = rng.integers(0, 2**32, size=(Wp,), dtype=np.uint32)
-    got = np.asarray(intersection_counts_matrix_pallas(src, mat, interpret=True))
+    padded, r = pad_for_pallas(mat)
+    psrc = np.pad(src, (0, padded.shape[1] - Wp))
+    got = np.asarray(intersection_counts_matrix_pallas(psrc, padded, interpret=True))[:r]
     want = np.bitwise_count(mat & src[None, :]).sum(axis=1)
     assert np.array_equal(got, want)
-    # padding path
-    mat2 = rng.integers(0, 2**32, size=(13, Wp), dtype=np.uint32)
+    # non-tile-aligned words exercise the padding path on both axes
+    mat2 = rng.integers(0, 2**32, size=(13, Wp + 7), dtype=np.uint32)
+    src2 = rng.integers(0, 2**32, size=(Wp + 7,), dtype=np.uint32)
     padded, r = pad_for_pallas(mat2)
-    got = np.asarray(intersection_counts_matrix_pallas(src, padded, interpret=True))[:r]
-    want = np.bitwise_count(mat2 & src[None, :]).sum(axis=1)
+    psrc = np.pad(src2, (0, padded.shape[1] - src2.shape[0]))
+    got = np.asarray(intersection_counts_matrix_pallas(psrc, padded, interpret=True))[:r]
+    want = np.bitwise_count(mat2 & src2[None, :]).sum(axis=1)
     assert np.array_equal(got, want)
