@@ -4,17 +4,27 @@ from pilosa_tpu.core.fragment import Fragment, TopOptions, pos
 from pilosa_tpu.core.field import BSIGroup, Field, FieldOptions
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core.index import Index
+from pilosa_tpu.core.iterator import (
+    BufIterator,
+    LimitIterator,
+    RoaringIterator,
+    SliceIterator,
+)
 from pilosa_tpu.core.row import Row, union_rows
 from pilosa_tpu.core.view import VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD, View
 
 __all__ = [
     "BSIGroup",
+    "BufIterator",
     "Field",
     "FieldOptions",
     "Fragment",
     "Holder",
     "Index",
+    "LimitIterator",
+    "RoaringIterator",
     "Row",
+    "SliceIterator",
     "TopOptions",
     "VIEW_BSI_GROUP_PREFIX",
     "VIEW_STANDARD",

@@ -1,5 +1,6 @@
 """CPU roaring-bitmap engine + reference file-format compatibility (L0)."""
 
+from .btree import BTreeContainers
 from .bitmap import (
     ARRAY_MAX_SIZE,
     BITMAP_N,
@@ -8,6 +9,8 @@ from .bitmap import (
     CONTAINER_RUN,
     Bitmap,
     Container,
+    get_default_container_store,
+    set_default_container_store,
     highbits,
     lowbits,
     marshal_op,
@@ -19,6 +22,9 @@ from .bitmap import (
 __all__ = [
     "ARRAY_MAX_SIZE",
     "BITMAP_N",
+    "BTreeContainers",
+    "get_default_container_store",
+    "set_default_container_store",
     "CONTAINER_ARRAY",
     "CONTAINER_BITMAP",
     "CONTAINER_RUN",

@@ -292,17 +292,36 @@ def positions_to_words(pos: np.ndarray) -> np.ndarray:
 # -- bitmap ------------------------------------------------------------------
 
 
+# Swappable container-store seam (the reference flips SliceContainers →
+# enterprise B+tree by reassigning roaring.NewFileBitmap under the
+# `enterprise` build tag, enterprise/enterprise.go:30-32). The default
+# dict store wins for typical container counts; swap in
+# pilosa_tpu.roaring.btree.BTreeContainers for ordered-scan-heavy
+# bitmaps with millions of containers.
+_default_container_store = dict
+
+
+def set_default_container_store(factory) -> None:
+    global _default_container_store
+    _default_container_store = factory
+
+
+def get_default_container_store():
+    return _default_container_store
+
+
 class Bitmap:
     """64-bit roaring bitmap (reference roaring.Bitmap).
 
-    Containers live in a plain dict keyed by the high 48 bits; iteration
-    is over sorted keys (the reference's SliceContainers invariant).
+    Containers live in a mapping keyed by the high 48 bits (dict by
+    default — the reference's SliceContainers analog; see
+    set_default_container_store for the B+tree alternative).
     """
 
     __slots__ = ("containers", "op_writer", "op_n")
 
     def __init__(self, *bits: int) -> None:
-        self.containers: dict[int, Container] = {}
+        self.containers = _default_container_store()
         self.op_writer = None  # file-like; when set, add/remove append ops
         self.op_n = 0
         for b in bits:

@@ -38,7 +38,8 @@ class Config:
     # cluster
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     anti_entropy_interval: float = 600.0  # reference server.go:238 (10m)
-    metric: str = "expvar"  # expvar | none
+    metric: str = "expvar"  # expvar | statsd | none
+    metric_host: str = "127.0.0.1:8125"  # statsd UDP address
     # opt-in diagnostics phone-home endpoint (reference diagnostics.go);
     # empty = disabled
     diagnostics_host: str = ""

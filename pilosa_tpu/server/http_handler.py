@@ -51,6 +51,13 @@ class Handler:
         a = api
         self.routes = [
             # public (reference handler.go:188-231)
+            Route(
+                "GET",
+                r"/",
+                lambda req: {
+                    "message": "pilosa_tpu is running; see /schema, /status"
+                },
+            ),
             Route("POST", r"/index/(?P<index>[^/]+)/query", self.post_query),
             Route("GET", r"/schema", lambda req: {"indexes": a.schema()}),
             Route("GET", r"/status", lambda req: a.status()),

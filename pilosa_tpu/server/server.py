@@ -23,7 +23,8 @@ from pilosa_tpu import __version__
 from pilosa_tpu.utils.attrstore import new_attr_store
 from pilosa_tpu.utils.diagnostics import DiagnosticsCollector
 from pilosa_tpu.utils.logger import NOP_LOGGER, StandardLogger
-from pilosa_tpu.utils.stats import ExpvarStatsClient, NOP_STATS
+from pilosa_tpu.utils.gcnotify import GCNotifier
+from pilosa_tpu.utils.stats import ExpvarStatsClient, NOP_STATS, StatsDClient
 from pilosa_tpu.utils.translate import TranslateStore
 
 
@@ -36,9 +37,18 @@ class Server:
             if self.config.log_path != "nop"
             else NOP_LOGGER
         )
-        self.stats = (
-            ExpvarStatsClient() if self.config.metric == "expvar" else NOP_STATS
-        )
+        # reference server/server.go:353-364 (expvar/statsd/none selection;
+        # unknown names error there too)
+        if self.config.metric == "expvar":
+            self.stats = ExpvarStatsClient()
+        elif self.config.metric == "statsd":
+            self.stats = StatsDClient(host=self.config.metric_host)
+        elif self.config.metric in ("none", "nop", ""):
+            self.stats = NOP_STATS
+        else:
+            raise ValueError(f"invalid metric service: {self.config.metric!r}")
+        # only hook gc.callbacks when someone consumes the counter
+        self.gc_notifier = GCNotifier() if self.stats is not NOP_STATS else None
         self.holder = Holder(
             data_dir,
             new_attr_store=new_attr_store,
@@ -128,6 +138,12 @@ class Server:
                     self.stats.gauge("threads", threading.active_count())
                     counts = gc.get_count()
                     self.stats.gauge("gcGen0", counts[0])
+                    cycles = (
+                        self.gc_notifier.poll() if self.gc_notifier else 0
+                    )
+                    if cycles:
+                        # reference server.go:702-704 via gcnotify
+                        self.stats.count("garbage_collection", cycles)
                     self.stats.gauge("openFragments", self._count_fragments())
                 except Exception:
                     pass
@@ -226,6 +242,9 @@ class Server:
 
     def close(self) -> None:
         self._closed.set()
+        if self.gc_notifier is not None:
+            self.gc_notifier.close()
+        self.stats.close()
         if self.httpd is not None:
             self.httpd.shutdown()
             self.httpd.server_close()

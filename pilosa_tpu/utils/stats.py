@@ -31,6 +31,9 @@ class NopStatsClient:
     def timing(self, name: str, value: float, rate: float = 1.0) -> None:
         pass
 
+    def close(self) -> None:
+        pass
+
 
 NOP_STATS = NopStatsClient()
 
@@ -84,6 +87,9 @@ class ExpvarStatsClient:
         with self._mu:
             return dict(self._root)
 
+    def close(self) -> None:
+        pass
+
 
 class MultiStatsClient:
     def __init__(self, *clients) -> None:
@@ -114,3 +120,86 @@ class MultiStatsClient:
     def timing(self, name, value, rate=1.0):
         for c in self.clients:
             c.timing(name, value, rate)
+
+    def close(self) -> None:
+        for c in self.clients:
+            c.close()
+
+
+class StatsDClient:
+    """DataDog-flavored StatsD over UDP (reference statsd/statsd.go:40-128).
+
+    Wire format per datagram: ``pilosa.<name>:<value>|<type>[|@<rate>][|#t1,t2]``
+    with types c (count), g (gauge), h (histogram), s (set), ms (timing).
+    Sampling is client-side: a metric with rate r is sent with
+    probability r and annotated ``|@r`` so the aggregator rescales.
+    Fire-and-forget — send errors are swallowed (UDP semantics).
+    """
+
+    prefix = "pilosa."
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1:8125",
+        tags: Optional[list[str]] = None,
+        _sock=None,
+    ) -> None:
+        import socket
+
+        h, sep, p = host.rpartition(":")
+        if not sep:  # bare hostname → default statsd port
+            h, p = host, "8125"
+        try:
+            port = int(p)
+        except ValueError:
+            raise ValueError(f"invalid statsd host (metric_host): {host!r}")
+        self._addr = (h or "127.0.0.1", port)
+        self._tags = tags or []
+        self._sock = _sock or socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def tags(self) -> list[str]:
+        return self._tags
+
+    def with_tags(self, *tags: str) -> "StatsDClient":
+        c = StatsDClient.__new__(StatsDClient)
+        c._addr = self._addr
+        c._tags = sorted(set(self._tags) | set(tags))
+        c._sock = self._sock
+        return c
+
+    def _send(self, name: str, value, type_: str, rate: float) -> None:
+        if rate < 1.0:
+            import random
+
+            if random.random() >= rate:
+                return
+        msg = f"{self.prefix}{name}:{value}|{type_}"
+        if rate < 1.0:
+            msg += f"|@{rate}"
+        if self._tags:
+            msg += "|#" + ",".join(self._tags)
+        try:
+            self._sock.sendto(msg.encode(), self._addr)
+        except OSError:
+            pass
+
+    def count(self, name: str, value: int = 1, rate: float = 1.0) -> None:
+        self._send(name, value, "c", rate)
+
+    def gauge(self, name: str, value: float, rate: float = 1.0) -> None:
+        self._send(name, value, "g", rate)
+
+    def histogram(self, name: str, value: float, rate: float = 1.0) -> None:
+        self._send(name, value, "h", rate)
+
+    def set(self, name: str, value: str, rate: float = 1.0) -> None:
+        self._send(name, value, "s", rate)
+
+    def timing(self, name: str, value: float, rate: float = 1.0) -> None:
+        self._send(name, value, "ms", rate)

@@ -1,0 +1,224 @@
+"""Auxiliary components: StatsD client, gcnotify, iterators, B+tree
+container store (reference statsd/, gcnotify/, iterator.go,
+enterprise/b)."""
+
+import gc
+import random
+import socket
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import (
+    BufIterator,
+    LimitIterator,
+    RoaringIterator,
+    SliceIterator,
+)
+from pilosa_tpu import SHARD_WIDTH
+from pilosa_tpu.roaring import (
+    Bitmap,
+    BTreeContainers,
+    get_default_container_store,
+    set_default_container_store,
+)
+from pilosa_tpu.utils.gcnotify import GCNotifier
+from pilosa_tpu.utils.stats import StatsDClient
+
+
+# -- StatsD ----------------------------------------------------------------
+
+
+@pytest.fixture
+def udp_server():
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(2.0)
+    yield sock
+    sock.close()
+
+
+def _recv(sock) -> str:
+    return sock.recvfrom(4096)[0].decode()
+
+
+def test_statsd_wire_format(udp_server):
+    port = udp_server.getsockname()[1]
+    c = StatsDClient(host=f"127.0.0.1:{port}")
+    c.count("setBit", 3)
+    assert _recv(udp_server) == "pilosa.setBit:3|c"
+    c.gauge("goroutines", 12.0)
+    assert _recv(udp_server) == "pilosa.goroutines:12.0|g"
+    c.timing("query", 1.5)
+    assert _recv(udp_server) == "pilosa.query:1.5|ms"
+    c.set("user", "a")
+    assert _recv(udp_server) == "pilosa.user:a|s"
+    c.histogram("h", 2.0)
+    assert _recv(udp_server) == "pilosa.h:2.0|h"
+    c.close()
+
+
+def test_statsd_tags_propagate(udp_server):
+    port = udp_server.getsockname()[1]
+    c = StatsDClient(host=f"127.0.0.1:{port}")
+    tagged = c.with_tags("index:i", "field:f")
+    assert tagged.tags() == ["field:f", "index:i"]
+    tagged.count("importBit", 1)
+    assert _recv(udp_server) == "pilosa.importBit:1|c|#field:f,index:i"
+    # parent unaffected
+    assert c.tags() == []
+    c.close()
+
+
+def test_statsd_sampling(udp_server, monkeypatch):
+    port = udp_server.getsockname()[1]
+    c = StatsDClient(host=f"127.0.0.1:{port}")
+    monkeypatch.setattr(random, "random", lambda: 0.99)
+    c.count("dropped", 1, rate=0.5)  # 0.99 >= 0.5 → dropped
+    monkeypatch.setattr(random, "random", lambda: 0.01)
+    c.count("kept", 1, rate=0.5)
+    assert _recv(udp_server) == "pilosa.kept:1|c|@0.5"
+    c.close()
+
+
+def test_statsd_bare_hostname_defaults_port():
+    c = StatsDClient(host="localhost")
+    assert c._addr == ("localhost", 8125)
+    c.close()
+
+
+# -- gcnotify --------------------------------------------------------------
+
+
+def test_gcnotifier_counts_cycles():
+    n = GCNotifier()
+    try:
+        gc.collect()
+        gc.collect()
+        assert n.poll() >= 2
+        assert n.poll() == 0  # poll resets
+    finally:
+        n.close()
+    gc.collect()
+    assert n.poll() == 0  # closed → no longer counting
+
+
+# -- iterators (reference iterator.go) -------------------------------------
+
+
+PAIRS = [(0, 1), (0, 5), (2, 0), (2, 9), (7, 3)]
+
+
+def _slice_iter():
+    return SliceIterator([p[0] for p in PAIRS], [p[1] for p in PAIRS])
+
+
+def test_slice_iterator():
+    assert list(_slice_iter()) == PAIRS
+    it = _slice_iter()
+    it.seek(2, 1)
+    assert it.next_pair() == (2, 9, False)
+
+
+def test_limit_iterator():
+    assert list(LimitIterator(_slice_iter(), 3)) == PAIRS[:3]
+    assert list(LimitIterator(_slice_iter(), 99)) == PAIRS
+
+
+def test_buf_iterator_unread_and_peek():
+    it = BufIterator(_slice_iter())
+    assert it.peek() == (0, 1, False)
+    assert it.next_pair() == (0, 1, False)  # peek did not consume
+    it.unread()
+    assert it.next_pair() == (0, 1, False)  # unread re-returns
+    assert it.next_pair() == (0, 5, False)
+    it.unread()
+    with pytest.raises(RuntimeError):
+        it.unread()  # single-slot buffer
+
+
+def test_roaring_iterator():
+    b = Bitmap()
+    for r, c in PAIRS:
+        b.add(r * SHARD_WIDTH + c)
+    it = RoaringIterator(b)
+    assert list(it) == PAIRS
+    it.seek(2, 1)
+    assert it.next_pair() == (2, 9, False)
+    it.seek(99, 0)
+    assert it.next_pair() == (0, 0, True)
+
+
+# -- B+tree container store (reference enterprise/b) -----------------------
+
+
+def test_btree_containers_basics():
+    t = BTreeContainers()
+    keys = list(range(0, 1000, 3))
+    random.Random(5).shuffle(keys)
+    for k in keys:
+        t[k] = f"v{k}"
+    assert len(t) == len(keys)
+    assert list(t) == sorted(keys)  # in-order iteration
+    assert t[999 // 3 * 3] == f"v{999 // 3 * 3}"
+    assert t.get(1) is None
+    assert 6 in t and 7 not in t
+    del t[6]
+    assert 6 not in t and len(t) == len(keys) - 1
+    with pytest.raises(KeyError):
+        del t[6]
+    assert t.pop(9) == "v9"
+    assert t.pop(9, "dflt") == "dflt"
+    assert list(t.keys() & {0, 3, 6, 9, 1}) != []
+    t.clear()
+    assert len(t) == 0 and list(t) == []
+
+
+def test_btree_containers_overwrite():
+    t = BTreeContainers()
+    t[5] = "a"
+    t[5] = "b"
+    assert len(t) == 1 and t[5] == "b"
+
+
+def test_bitmap_algebra_with_btree_store():
+    """Same results dict-store vs btree-store across the full algebra."""
+    rng = np.random.default_rng(11)
+    vals_a = np.unique(rng.integers(0, 5_000_000, 4000).astype(np.uint64))
+    vals_b = np.unique(rng.integers(0, 5_000_000, 4000).astype(np.uint64))
+
+    da, db = Bitmap.from_sorted(vals_a), Bitmap.from_sorted(vals_b)
+    set_default_container_store(BTreeContainers)
+    try:
+        ba, bb = Bitmap.from_sorted(vals_a), Bitmap.from_sorted(vals_b)
+        assert isinstance(ba.containers, BTreeContainers)
+        for op in ("intersect", "union", "difference", "xor"):
+            want = getattr(da, op)(db).slice_all()
+            got = getattr(ba, op)(bb).slice_all()
+            np.testing.assert_array_equal(want, got)
+        assert da.intersection_count(db) == ba.intersection_count(bb)
+        assert da.count() == ba.count()
+        # point ops + serialization round-trip through the btree store
+        ba.add(10_000_000)
+        assert ba.contains(10_000_000)
+        ba.remove(10_000_000)
+        assert not ba.contains(10_000_000)
+        data = ba.to_bytes()
+    finally:
+        set_default_container_store(dict)
+    rt = Bitmap.unmarshal_binary(data)
+    np.testing.assert_array_equal(rt.slice_all(), ba.slice_all())
+    assert get_default_container_store() is dict
+
+
+def test_btree_store_survives_many_containers():
+    set_default_container_store(BTreeContainers)
+    try:
+        b = Bitmap()
+        # >64 containers forces splits (one container per 2^16 block)
+        positions = [i << 16 for i in range(300)]
+        b.add(*positions)
+        assert b.count() == 300
+        assert [int(v) for v in b.slice_all()] == positions
+    finally:
+        set_default_container_store(dict)
