@@ -1,5 +1,6 @@
 """Query executor (L4): PQL call trees → shard kernels + map/reduce."""
 
+from pilosa_tpu.executor.batcher import BatchedScorer
 from pilosa_tpu.executor.executor import (
     ExecOptions,
     Executor,
@@ -8,4 +9,4 @@ from pilosa_tpu.executor.executor import (
 )
 from pilosa_tpu.executor.stager import DeviceStager
 
-__all__ = ["DeviceStager", "ExecOptions", "Executor", "ValCount", "pairs_add"]
+__all__ = ["BatchedScorer", "DeviceStager", "ExecOptions", "Executor", "ValCount", "pairs_add"]

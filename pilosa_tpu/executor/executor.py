@@ -32,6 +32,7 @@ from pilosa_tpu.core.cache import CACHE_TYPE_NONE, sort_pairs
 from pilosa_tpu.core.field import FIELD_TYPE_SET
 from pilosa_tpu.core.fragment import DEFAULT_MIN_THRESHOLD
 from pilosa_tpu.core.timequantum import TIME_FORMAT, views_by_time_range
+from pilosa_tpu.executor.batcher import BatchedScorer
 from pilosa_tpu.executor.stager import DeviceStager
 from pilosa_tpu.pql import BETWEEN, Call, Condition, NEQ, Query, parse
 from pilosa_tpu.roaring import Bitmap
@@ -103,6 +104,9 @@ class Executor:
         self.device_policy = device_policy
         self.translate_store = translate_store
         self.max_writes_per_request = max_writes_per_request
+        # coalesces concurrent TopN scoring against the same staged
+        # matrix into one batched kernel launch (see batcher.py)
+        self.scorer = BatchedScorer()
 
     # -- entry point (reference Execute, executor.go:83) ---------------------
 
@@ -1008,7 +1012,9 @@ class Executor:
         except _NotDeviceable:
             return frag.top(opt_)
         mat = self.stager.rows(frag, candidate_ids)
-        scores = np.asarray(ops.intersection_counts_matrix(src_words, mat))
+        scores = self.scorer.score(
+            (id(frag), frag.generation, candidate_ids), mat, src_words
+        )
         score_by_id = dict(zip(candidate_ids, (int(s) for s in scores)))
 
         # Replay fragment.top's walk with precomputed counts.

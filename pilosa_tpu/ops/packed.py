@@ -99,6 +99,21 @@ def intersection_counts_matrix(src, mat) -> jax.Array:
     return jnp.sum(pc.astype(jnp.int32), axis=-1)
 
 
+@jax.jit
+def intersection_counts_matrix_batch(srcs, mat) -> jax.Array:
+    """Batched TopN scoring: popcount(src_q & row_r) for every (q, r).
+
+    srcs: u32[Q, W]; mat: u32[R, W] -> i32[Q, R]. One logical pass over
+    the fragment matrix serves all Q query sources — the concurrent-
+    query analog of intersection_counts_matrix (a server batches
+    concurrent TopN sources the way a TPU inference server batches
+    requests). lax.map keeps the peak footprint at one (R, W) popcount
+    buffer instead of the (Q, R, W) a vmap would materialize; the
+    Pallas version (ops.pallas_kernels) tiles it properly on real TPU.
+    """
+    return jax.lax.map(lambda s: intersection_counts_matrix(s, mat), srcs)
+
+
 # -- fold a stack of rows with one op ---------------------------------------
 
 

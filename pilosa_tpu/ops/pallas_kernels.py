@@ -70,6 +70,56 @@ def intersection_counts_matrix_pallas(src, mat, *, interpret: bool = False):
     return out[0]
 
 
+def _batched_scores_kernel(q_static, srcs_ref, mat_ref, out_ref):
+    # Grid (R/TILE_R, W/TILE_W), j innermost: the (TILE_R, TILE_W) mat
+    # block is fetched from HBM once per (i, j) and reused for all Q
+    # sources — the whole point of batching. out is (Q, TILE_R), index
+    # (i, j) -> (0, i): constant across consecutive j steps, the safe
+    # Pallas revisit/accumulate pattern.
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    mat = mat_ref[:]  # (TILE_R, TILE_W)
+    acc = []
+    for q in range(q_static):  # static unroll; Q is bucketed small
+        block = jnp.bitwise_and(mat, srcs_ref[q, :][None, :])
+        acc.append(
+            jnp.sum(jax.lax.population_count(block).astype(jnp.int32), axis=1)
+        )
+    out_ref[:] += jnp.stack(acc, axis=0)  # (Q, TILE_R)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def intersection_counts_matrix_batch_pallas(srcs, mat, *, interpret: bool = False):
+    """Batched scoring: u32[Q, W], u32[R, W] -> i32[Q, R].
+
+    R must be a multiple of TILE_R and W of TILE_W (see pad_for_pallas).
+    Q is static per compilation — callers bucket Q (pad sources with
+    zeros; a zero source scores 0 everywhere) to bound recompiles.
+    """
+    q, w = srcs.shape
+    r, _ = mat.shape
+    grid = (r // TILE_R, w // TILE_W)
+    return pl.pallas_call(
+        functools.partial(_batched_scores_kernel, q),
+        out_shape=jax.ShapeDtypeStruct((q, r), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((q, TILE_W), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (TILE_R, TILE_W), lambda i, j: (i, j), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (q, TILE_R), lambda i, j: (0, i), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(srcs, mat)
+
+
 def pad_for_pallas(mat):
     """Pad rows to TILE_R and words to TILE_W multiples."""
     import numpy as np

@@ -1,0 +1,182 @@
+"""Batched TopN scoring: batch kernels (XLA + Pallas interpret) and the
+continuous micro-batching scorer."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import ops
+from pilosa_tpu.executor import BatchedScorer
+from pilosa_tpu.ops.pallas_kernels import (
+    TILE_R,
+    TILE_W,
+    intersection_counts_matrix_batch_pallas,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(3)
+    mat = rng.integers(0, 2**32, size=(TILE_R, TILE_W), dtype=np.uint32)
+    srcs = rng.integers(0, 2**32, size=(4, TILE_W), dtype=np.uint32)
+    return srcs, mat
+
+
+def test_batch_op_matches_single(data):
+    srcs, mat = data
+    batched = np.asarray(ops.intersection_counts_matrix_batch(srcs, mat))
+    for q in range(srcs.shape[0]):
+        single = np.asarray(ops.intersection_counts_matrix(srcs[q], mat))
+        np.testing.assert_array_equal(batched[q], single)
+
+
+def test_batch_pallas_matches_xla(data):
+    srcs, mat = data
+    got = np.asarray(
+        intersection_counts_matrix_batch_pallas(srcs, mat, interpret=True)
+    )
+    want = np.asarray(ops.intersection_counts_matrix_batch(srcs, mat))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_scorer_single_caller(data):
+    srcs, mat = data
+    s = BatchedScorer()
+    got = s.score(("k",), mat, srcs[0])
+    np.testing.assert_array_equal(
+        got, np.asarray(ops.intersection_counts_matrix(srcs[0], mat))
+    )
+    assert s.dispatches == 1 and s.batched_queries == 0  # no batching alone
+
+
+def test_scorer_concurrent_same_key(data):
+    """Deterministic coalescing: hold the fragment's dispatch lock while
+    all callers enqueue; on release the first dispatcher must drain the
+    whole queue into ONE batched launch."""
+    import time
+
+    srcs, mat = data
+    q = srcs.shape[0]
+    s = BatchedScorer()
+    key = ("frag0", 0, (1, 2))
+    gate = threading.Lock()
+    s._dispatch_locks[key[0]] = gate
+    gate.acquire()
+
+    results = [None] * q
+
+    def run(i):
+        results[i] = s.score(key, mat, srcs[i])
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(q)]
+    for t in threads:
+        t.start()
+    # wait until every caller is enqueued behind the held dispatch lock
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        with s._lock:
+            if len(s._pending.get(key, [])) == q:
+                break
+        time.sleep(0.001)
+    else:
+        gate.release()
+        pytest.fail("callers never enqueued")
+    gate.release()
+    for t in threads:
+        t.join()
+    for i in range(q):
+        np.testing.assert_array_equal(
+            results[i], np.asarray(ops.intersection_counts_matrix(srcs[i], mat))
+        )
+    assert s.dispatches == 1  # one coalesced launch for all callers
+    assert s.batched_queries == q
+
+
+def test_scorer_distinct_keys_not_mixed(data):
+    srcs, mat = data
+    mat2 = np.roll(mat, 1, axis=0)
+    s = BatchedScorer()
+    a = s.score(("a",), mat, srcs[0])
+    b = s.score(("b",), mat2, srcs[0])
+    np.testing.assert_array_equal(
+        a, np.asarray(ops.intersection_counts_matrix(srcs[0], mat))
+    )
+    np.testing.assert_array_equal(
+        b, np.asarray(ops.intersection_counts_matrix(srcs[0], mat2))
+    )
+
+
+def test_scorer_pads_to_pow2(data):
+    srcs, mat = data
+    s = BatchedScorer(max_batch=8)
+    # force the batched path with 3 sources via the internal fill
+    from pilosa_tpu.executor.batcher import _Slot
+
+    slots = [_Slot(srcs[i]) for i in range(3)]
+    s._fill(slots, mat)
+    for i in range(3):
+        np.testing.assert_array_equal(
+            slots[i].result,
+            np.asarray(ops.intersection_counts_matrix(srcs[i], mat)),
+        )
+
+
+def test_scorer_error_propagates_to_peers(data, monkeypatch):
+    """A failed batched launch must surface the real error to every
+    coalesced caller, not hand peers a None result."""
+    from pilosa_tpu.executor import batcher as batcher_mod
+    from pilosa_tpu.executor.batcher import _Slot
+
+    srcs, mat = data
+    s = BatchedScorer()
+    boom = RuntimeError("device exploded")
+
+    def raise_fn(*a, **k):
+        raise boom
+
+    monkeypatch.setattr(batcher_mod.ops, "intersection_counts_matrix_batch", raise_fn)
+    slots = [_Slot(srcs[0]), _Slot(srcs[1])]
+    with pytest.raises(RuntimeError, match="device exploded"):
+        s._fill(slots, mat)
+    for slot in slots:
+        assert slot.event.is_set()
+        with pytest.raises(RuntimeError, match="device exploded"):
+            slot.finish()
+
+
+def test_executor_concurrent_topn_batches(holder_with_data=None):
+    """Concurrent TopN queries through the executor produce identical
+    results to sequential execution and coalesce kernel launches."""
+    import tempfile
+
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import Executor
+
+    with tempfile.TemporaryDirectory() as d:
+        h = Holder(d)
+        h.open()
+        idx = h.create_index("bt")
+        f = idx.create_field("f")
+        rng = np.random.default_rng(9)
+        for row in range(8):
+            cols = rng.choice(5000, size=800, replace=False)
+            f.import_bits([row] * len(cols), cols.tolist())
+        ex = Executor(h, device_policy="always")
+        sequential = [
+            ex.execute("bt", f"TopN(f, Row(f={r}), n=4)") for r in range(4)
+        ]
+        results = [None] * 4
+        barrier = threading.Barrier(4)
+
+        def run(i):
+            barrier.wait()
+            results[i] = ex.execute("bt", f"TopN(f, Row(f={i}), n=4)")
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == sequential
+        h.close()
