@@ -1012,9 +1012,11 @@ class Executor:
         except _NotDeviceable:
             return frag.top(opt_)
         mat = self.stager.rows(frag, candidate_ids)
-        scores = self.scorer.score(
-            (id(frag), frag.generation, candidate_ids), mat, src_words
-        )
+        # key on the staged array identity (not frag.generation, which a
+        # concurrent import may bump between staging and here): same
+        # live array object ⇔ same snapshot, so coalesced peers can
+        # never mix matrices
+        scores = self.scorer.score((id(frag), id(mat)), mat, src_words)
         score_by_id = dict(zip(candidate_ids, (int(s) for s in scores)))
 
         # Replay fragment.top's walk with precomputed counts.

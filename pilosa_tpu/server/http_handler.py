@@ -19,6 +19,7 @@ from urllib.parse import parse_qs, urlparse
 from pilosa_tpu.core import Row
 from pilosa_tpu.executor import ValCount
 from pilosa_tpu.server.api import API, APIError
+from pilosa_tpu.utils import publicproto
 from pilosa_tpu.utils.stats import NOP_STATS
 
 
@@ -129,19 +130,34 @@ class Handler:
     def post_query(self, req) -> dict:
         index = req.params["index"]
         q = req.query
-        body = req.body.decode() if req.body else ""
-        shards = None
-        if "shards" in q:
-            shards = [int(s) for s in q["shards"][0].split(",") if s != ""]
+        # protobuf content negotiation (reference handlePostQuery:406 +
+        # internal/public.proto QueryRequest)
+        if req.is_proto:
+            pbreq = publicproto.decode_query_request(req.body or b"")
+            body = pbreq["query"]
+            shards = pbreq["shards"]
+            remote = pbreq["remote"]
+            exclude_row_attrs = pbreq["excludeRowAttrs"]
+            exclude_columns = pbreq["excludeColumns"]
+            column_attrs = pbreq["columnAttrs"]
+        else:
+            body = req.body.decode() if req.body else ""
+            shards = None
+            if "shards" in q:
+                shards = [int(s) for s in q["shards"][0].split(",") if s != ""]
+            remote = q.get("remote", ["false"])[0] == "true"
+            exclude_row_attrs = q.get("excludeRowAttrs", ["false"])[0] == "true"
+            exclude_columns = q.get("excludeColumns", ["false"])[0] == "true"
+            column_attrs = q.get("columnAttrs", ["false"])[0] == "true"
         t0 = time.monotonic()
         resp = self.api.query(
             index,
             body,
             shards=shards,
-            remote=q.get("remote", ["false"])[0] == "true",
-            exclude_row_attrs=q.get("excludeRowAttrs", ["false"])[0] == "true",
-            exclude_columns=q.get("excludeColumns", ["false"])[0] == "true",
-            column_attrs=q.get("columnAttrs", ["false"])[0] == "true",
+            remote=remote,
+            exclude_row_attrs=exclude_row_attrs,
+            exclude_columns=exclude_columns,
+            column_attrs=column_attrs,
         )
         dur = time.monotonic() - t0
         # slow-query logging (reference handler.go:257-261)
@@ -152,6 +168,13 @@ class Handler:
         out = {"results": [encode_result(r) for r in resp["results"]]}
         if "columnAttrs" in resp:
             out["columnAttrs"] = resp["columnAttrs"]
+        if req.accepts_proto:
+            return RawResponse(
+                publicproto.encode_query_response(
+                    out["results"], out.get("columnAttrs")
+                ),
+                publicproto.CONTENT_TYPE,
+            )
         return out
 
     def get_index(self, req) -> dict:
@@ -182,7 +205,16 @@ class Handler:
         return {}
 
     def post_import(self, req) -> dict:
-        body = json.loads(req.body or b"{}")
+        if req.is_proto:
+            body = publicproto.decode_import_request(req.body or b"")
+            # reference wire timestamps are unix-nanoseconds
+            # (Go time.Unix(0, ts)); the API layer expects seconds
+            if body.get("timestamps"):
+                body["timestamps"] = [
+                    t / 1e9 if t else None for t in body["timestamps"]
+                ]
+        else:
+            body = json.loads(req.body or b"{}")
         if body.get("local"):
             self.api.import_bits_local(
                 req.params["index"],
@@ -191,7 +223,7 @@ class Handler:
                 body.get("columnIDs", []),
                 timestamps=body.get("timestamps"),
             )
-            return {}
+            return self._import_ok(req)
         self.api.import_bits(
             req.params["index"],
             req.params["field"],
@@ -201,10 +233,19 @@ class Handler:
             row_keys=body.get("rowKeys"),
             column_keys=body.get("columnKeys"),
         )
+        return self._import_ok(req)
+
+    def _import_ok(self, req):
+        if req.accepts_proto or req.is_proto:
+            # empty ImportResponse message (reference handlePostImport)
+            return RawResponse(b"", publicproto.CONTENT_TYPE)
         return {}
 
     def post_import_value(self, req) -> dict:
-        body = json.loads(req.body or b"{}")
+        if req.is_proto:
+            body = publicproto.decode_import_value_request(req.body or b"")
+        else:
+            body = json.loads(req.body or b"{}")
         if body.get("local"):
             self.api.import_values_local(
                 req.params["index"],
@@ -212,7 +253,7 @@ class Handler:
                 body.get("columnIDs", []),
                 body.get("values", []),
             )
-            return {}
+            return self._import_ok(req)
         self.api.import_values(
             req.params["index"],
             req.params["field"],
@@ -220,7 +261,7 @@ class Handler:
             body.get("values", []),
             column_keys=body.get("columnKeys"),
         )
-        return {}
+        return self._import_ok(req)
 
     def get_views(self, req) -> dict:
         return {"views": self.api.views(req.params["index"], req.params["field"])}
@@ -331,22 +372,40 @@ class Handler:
 
     # -- dispatch --
 
-    def handle(self, method: str, path: str, query: dict, body: bytes):
+    def handle(
+        self,
+        method: str,
+        path: str,
+        query: dict,
+        body: bytes,
+        headers: Optional[dict] = None,
+    ):
         for route in self.routes:
             if route.method != method:
                 continue
             m = route.re.match(path)
             if m:
-                req = Request(m.groupdict(), query, body)
+                req = Request(m.groupdict(), query, body, headers)
                 return route.fn(req)
         raise APIError(f"no route for {method} {path}", status=404)
 
 
 class Request:
-    def __init__(self, params: dict, query: dict, body: bytes) -> None:
+    def __init__(
+        self, params: dict, query: dict, body: bytes, headers: Optional[dict] = None
+    ) -> None:
         self.params = params
         self.query = query
         self.body = body
+        self.headers = {k.lower(): v for k, v in (headers or {}).items()}
+
+    @property
+    def is_proto(self) -> bool:
+        return publicproto.CONTENT_TYPE in self.headers.get("content-type", "")
+
+    @property
+    def accepts_proto(self) -> bool:
+        return publicproto.CONTENT_TYPE in self.headers.get("accept", "")
 
 
 class RawResponse:
@@ -373,7 +432,11 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
                 body = self.rfile.read(length)
             try:
                 result = handler.handle(
-                    method, parsed.path, parse_qs(parsed.query), body
+                    method,
+                    parsed.path,
+                    parse_qs(parsed.query),
+                    body,
+                    headers=dict(self.headers),
                 )
                 if isinstance(result, RawResponse):
                     payload = result.data
@@ -383,18 +446,26 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
                     ctype = "application/json"
                 self.send_response(200)
             except APIError as e:
-                payload = json.dumps({"error": str(e)}).encode()
-                ctype = "application/json"
+                payload, ctype = self._error_payload(str(e))
                 self.send_response(e.status)
             except Exception as e:  # panic recovery (reference ServeHTTP:239-276)
                 traceback.print_exc()
-                payload = json.dumps({"error": f"internal error: {e}"}).encode()
-                ctype = "application/json"
+                payload, ctype = self._error_payload(f"internal error: {e}")
                 self.send_response(500)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)
+
+        def _error_payload(self, msg: str):
+            # protobuf clients get a QueryResponse{Err} they can
+            # unmarshal (reference http/error.go)
+            if publicproto.CONTENT_TYPE in (self.headers.get("Accept") or ""):
+                return (
+                    publicproto.encode_query_response([], err=msg),
+                    publicproto.CONTENT_TYPE,
+                )
+            return json.dumps({"error": msg}).encode(), "application/json"
 
         def do_GET(self):
             self._run("GET")

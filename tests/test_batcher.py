@@ -145,7 +145,7 @@ def test_scorer_error_propagates_to_peers(data, monkeypatch):
             slot.finish()
 
 
-def test_executor_concurrent_topn_batches(holder_with_data=None):
+def test_executor_concurrent_topn_batches():
     """Concurrent TopN queries through the executor produce identical
     results to sequential execution and coalesce kernel launches."""
     import tempfile

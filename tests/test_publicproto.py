@@ -1,0 +1,153 @@
+"""Public protobuf wire codec (reference internal/public.proto) and
+HTTP content negotiation."""
+
+import shutil
+import subprocess
+
+import pytest
+
+from pilosa_tpu.utils import publicproto as pp
+
+RESULTS = [
+    {"columns": [1, 2, 1048577], "attrs": {"a": 1, "b": "x", "c": True, "d": 1.5}},
+    [{"id": 5, "count": 9}, {"key": "k", "count": 2}],
+    {"value": -3, "count": 4},
+    12345,
+    True,
+    None,
+]
+
+
+def test_query_request_roundtrip():
+    data = pp.encode_query_request(
+        "TopN(f, n=5)", shards=[0, 3, 99], remote=True, exclude_columns=True
+    )
+    d = pp.decode_query_request(data)
+    assert d["query"] == "TopN(f, n=5)"
+    assert d["shards"] == [0, 3, 99]
+    assert d["remote"] and d["excludeColumns"]
+    assert not d["columnAttrs"] and not d["excludeRowAttrs"]
+
+
+def test_query_response_roundtrip():
+    data = pp.encode_query_response(RESULTS, [{"id": 8, "attrs": {"z": "w"}}])
+    d = pp.decode_query_response(data)
+    assert d["results"][0]["columns"] == [1, 2, 1048577]
+    assert d["results"][0]["attrs"] == {"a": 1, "b": "x", "c": True, "d": 1.5}
+    assert d["results"][1] == [{"id": 5, "count": 9}, {"key": "k", "count": 2}]
+    assert d["results"][2] == {"value": -3, "count": 4}
+    assert d["results"][3] == 12345
+    assert d["results"][4] is True
+    assert d["results"][5] is None
+    assert d["columnAttrs"] == [{"id": 8, "attrs": {"z": "w"}}]
+
+
+def test_import_request_roundtrip():
+    data = pp.encode_import_request(
+        "i", "f", 2, [1, 2], [3, 4], timestamps=[-1, 10**18], row_keys=["r"]
+    )
+    d = pp.decode_import_request(data)
+    assert d["index"] == "i" and d["field"] == "f" and d["shard"] == 2
+    assert d["rowIDs"] == [1, 2] and d["columnIDs"] == [3, 4]
+    assert d["timestamps"] == [-1, 10**18]
+    assert d["rowKeys"] == ["r"]
+
+
+def test_import_value_request_roundtrip():
+    data = pp.encode_import_value_request("i", "f", 0, [9], [-42])
+    d = pp.decode_import_value_request(data)
+    assert d["columnIDs"] == [9] and d["values"] == [-42]
+
+
+PROTO_SPEC = """
+syntax = "proto3";
+package check;
+message Row { repeated uint64 Columns = 1; repeated string Keys = 3; repeated Attr Attrs = 2; }
+message Pair { uint64 ID = 1; string Key = 3; uint64 Count = 2; }
+message ValCount { int64 Val = 1; int64 Count = 2; }
+message Attr { string Key = 1; uint64 Type = 2; string StringValue = 3; int64 IntValue = 4; bool BoolValue = 5; double FloatValue = 6; }
+message ColumnAttrSet { uint64 ID = 1; string Key = 3; repeated Attr Attrs = 2; }
+message QueryRequest { string Query = 1; repeated uint64 Shards = 2; bool ColumnAttrs = 3; bool Remote = 5; bool ExcludeRowAttrs = 6; bool ExcludeColumns = 7; }
+message QueryResponse { string Err = 1; repeated QueryResult Results = 2; repeated ColumnAttrSet ColumnAttrSets = 3; }
+message QueryResult { uint32 Type = 6; Row Row = 1; uint64 N = 2; repeated Pair Pairs = 3; ValCount ValCount = 5; bool Changed = 4; }
+message ImportRequest { string Index = 1; string Field = 2; uint64 Shard = 3; repeated uint64 RowIDs = 4; repeated uint64 ColumnIDs = 5; repeated string RowKeys = 7; repeated string ColumnKeys = 8; repeated int64 Timestamps = 6; }
+"""
+
+
+@pytest.fixture(scope="module")
+def canonical_pb(tmp_path_factory):
+    """protoc-generated canonical codec for the same message schema
+    (field numbers/types per reference internal/public.proto:5-82)."""
+    if shutil.which("protoc") is None:
+        pytest.skip("protoc unavailable")
+    pytest.importorskip("google.protobuf")
+    d = tmp_path_factory.mktemp("pb")
+    (d / "check.proto").write_text(PROTO_SPEC)
+    subprocess.run(
+        ["protoc", f"--python_out={d}", "check.proto"], cwd=d, check=True
+    )
+    import sys
+
+    sys.path.insert(0, str(d))
+    try:
+        import check_pb2
+    finally:
+        sys.path.pop(0)
+    return check_pb2
+
+
+def test_wire_compat_with_canonical_protobuf(canonical_pb):
+    pb = canonical_pb
+    # our encode → canonical decode
+    m = pb.QueryRequest()
+    m.ParseFromString(pp.encode_query_request("Count(Row(f=1))", shards=[7]))
+    assert m.Query == "Count(Row(f=1))" and list(m.Shards) == [7]
+
+    r = pb.QueryResponse()
+    r.ParseFromString(pp.encode_query_response(RESULTS))
+    assert [x.Type for x in r.Results] == [1, 2, 3, 4, 5, 0]
+    assert list(r.Results[0].Row.Columns) == [1, 2, 1048577]
+    assert r.Results[1].Pairs[0].ID == 5 and r.Results[1].Pairs[1].Key == "k"
+    assert r.Results[2].ValCount.Val == -3
+    assert r.Results[3].N == 12345 and r.Results[4].Changed
+
+    # canonical encode → our decode (unpacked or packed both fine)
+    m2 = pb.ImportRequest(
+        Index="i", Field="f", Shard=3, RowIDs=[1], ColumnIDs=[2], Timestamps=[-5]
+    )
+    d = pp.decode_import_request(m2.SerializeToString())
+    assert d["shard"] == 3 and d["timestamps"] == [-5]
+
+
+def test_handler_content_negotiation(tmp_path):
+    """POST protobuf QueryRequest + Accept protobuf → protobuf response."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.server.http_handler import Handler, RawResponse
+
+    h = Holder(str(tmp_path))
+    h.open()
+    api = API(h, Executor(h))
+    api.create_index("p")
+    api.create_field("p", "f", {"type": "set"})
+    handler = Handler(api)
+    hdrs = {"Content-Type": pp.CONTENT_TYPE, "Accept": pp.CONTENT_TYPE}
+    body = pp.encode_query_request("Set(1, f=1) Set(2, f=1) Row(f=1) Count(Row(f=1))")
+    out = handler.handle("POST", "/index/p/query", {}, body, headers=hdrs)
+    assert isinstance(out, RawResponse) and out.content_type == pp.CONTENT_TYPE
+    d = pp.decode_query_response(out.data)
+    assert d["results"][0] is True and d["results"][1] is True
+    assert d["results"][2]["columns"] == [1, 2]
+    assert d["results"][3] == 2
+
+    # protobuf import
+    imp = pp.encode_import_request("p", "f", 0, [4, 4], [10, 11])
+    handler.handle(
+        "POST", "/index/p/field/f/import", {}, imp, headers=hdrs
+    )
+    out = handler.handle(
+        "POST", "/index/p/query", {}, pp.encode_query_request("Row(f=4)"), headers=hdrs
+    )
+    assert pp.decode_query_response(out.data)["results"][0]["columns"] == [10, 11]
+    h.close()
