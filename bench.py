@@ -10,9 +10,12 @@ The source bitmap of TopN(Row(r)) is a row of the fragment, which the
 HBM stager keeps device-resident (executor/stager.py) — so the query
 step indexes the staged matrix rather than re-uploading the source from
 host each time, exactly as the server's executor does. QPS is measured
-with pipelined dispatch (async submit, sync at the end — server-style
-throughput); p50 latency is measured separately with a blocking
-round-trip per query.
+with pipelined dispatch and then a forced host-side fetch of every
+result (tunneled backends ack block_until_ready before remote
+completion, so only a fetch proves the query finished); p50 latency is
+a true dispatch+completion+fetch round-trip per query. The batched
+path mirrors the executor's continuous micro-batching
+(executor/batcher.py): PILOSA_BENCH_BATCH sources per kernel launch.
 
 Baseline: the same queries through this framework's CPU roaring path
 (the reference's algorithm shape — per-candidate container popcount
@@ -75,24 +78,32 @@ def main():
         counts, ids = jax.lax.top_k(scores, TOPK)
         return ids, counts
 
+    def force(out):
+        """True completion: fetch one element host-side. On tunneled
+        backends block_until_ready acks the dispatch without waiting
+        for remote completion, so a tiny fetch is the only honest
+        sync — everything below measures COMPLETED queries."""
+        return np.asarray(out[0].ravel()[:1])
+
     dev_mat = jax.device_put(mat32)
     # warmup / compile
-    ids, counts = topn_step(int(q_rows[0]), dev_mat)
-    ids.block_until_ready()
+    force(topn_step(int(q_rows[0]), dev_mat))
 
-    # Latency: blocking round-trip per query.
+    # Latency: true round-trip (dispatch + completion + fetch) per
+    # query; on a tunneled chip this has the tunnel RTT as a floor.
     lat = []
     for q in range(N_QUERIES):
         t0 = time.perf_counter()
-        ids, counts = topn_step(int(q_rows[q]), dev_mat)
-        ids.block_until_ready()
+        force(topn_step(int(q_rows[q]), dev_mat))
         lat.append(time.perf_counter() - t0)
     p50 = sorted(lat)[len(lat) // 2] * 1000
 
-    # Throughput: pipelined dispatch, sync once at the end.
+    # Throughput: pipelined dispatch, then force completion of every
+    # query's result.
     t_all = time.perf_counter()
     outs = [topn_step(int(q_rows[q]), dev_mat) for q in range(N_QUERIES)]
-    jax.block_until_ready(outs)
+    for o in outs:
+        force(o)
     tpu_qps = N_QUERIES / (time.perf_counter() - t_all)
 
     # ---- Pallas-tiled variant (TPU only): keep whichever is faster ----
@@ -123,13 +134,13 @@ def main():
                 counts, ids = jax.lax.top_k(scores[:true_r], TOPK)
                 return ids, counts
 
-            ids, _ = topn_step_pallas(int(q_rows[0]), dev_pmat)
-            ids.block_until_ready()
+            force(topn_step_pallas(int(q_rows[0]), dev_pmat))
             t0 = time.perf_counter()
             pouts = [
                 topn_step_pallas(int(q_rows[q]), dev_pmat) for q in range(N_QUERIES)
             ]
-            jax.block_until_ready(pouts)
+            for o in pouts:
+                force(o)
             pallas_qps = N_QUERIES / (time.perf_counter() - t0)
         except Exception as e:  # keep the JSON line clean; surface the cause
             print(f"pallas path failed: {type(e).__name__}: {e}", file=sys.stderr)
@@ -139,7 +150,7 @@ def main():
     # from HBM once per batch instead of once per query (executor's
     # BatchedScorer coalesces concurrent requests the same way).
     batched_qps = 0.0
-    BATCH = int(os.environ.get("PILOSA_BENCH_BATCH", 32))
+    BATCH = int(os.environ.get("PILOSA_BENCH_BATCH", 512))
     try:
         if dev_pmat is None:
             raise RuntimeError("staged matrix unavailable")
@@ -157,15 +168,15 @@ def main():
             counts, ids = jax.lax.top_k(scores[:, :true_r], TOPK)
             return ids, counts
 
-        n_batches = max(N_QUERIES // BATCH, 1)
+        n_batches = max(N_QUERIES // BATCH, 4)
         batch_ids = [
             jnp.asarray(rng.integers(0, R, size=BATCH)) for _ in range(n_batches)
         ]
-        ids, _ = topn_step_batch(batch_ids[0], dev_bmat)
-        ids.block_until_ready()
+        force(topn_step_batch(batch_ids[0], dev_bmat))
         t0 = time.perf_counter()
         bouts = [topn_step_batch(b, dev_bmat) for b in batch_ids]
-        jax.block_until_ready(bouts)
+        for o in bouts:
+            force(o)
         batched_qps = n_batches * BATCH / (time.perf_counter() - t0)
     except Exception as e:
         print(f"batched path failed: {type(e).__name__}: {e}", file=sys.stderr)

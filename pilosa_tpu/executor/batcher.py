@@ -69,9 +69,12 @@ class BatchedScorer:
     def score(self, key: tuple, mat, src) -> np.ndarray:
         """popcount(src & row) per matrix row → i32[R].
 
-        key identifies the staged matrix ``mat`` (fragment identity +
-        generation + row set); callers passing the same key MUST pass
-        the same matrix. key[0] is the fragment identity.
+        key MUST be derived from the live staged array's identity
+        (e.g. ``(id(frag), id(mat))`` — see executor._top_device), so
+        same key ⇔ same array object: keying on mutable metadata like
+        frag.generation reintroduces a race where coalesced peers hold
+        different matrices. key[0] is the fragment identity (dispatch
+        locks are per fragment).
         """
         slot = _Slot(src)
         with self._lock:

@@ -101,6 +101,10 @@ def intersection_counts_matrix_batch_pallas(srcs, mat, *, interpret: bool = Fals
     zeros; a zero source scores 0 everywhere) to bound recompiles.
     """
     q, w = srcs.shape
+    if q > 512:
+        # the kernel unrolls the Q loop; beyond ~512 Mosaic compile
+        # time explodes — chunk larger batches at the call site
+        raise ValueError(f"batch too large for kernel unroll: {q} > 512")
     r, _ = mat.shape
     grid = (r // TILE_R, w // TILE_W)
     return pl.pallas_call(

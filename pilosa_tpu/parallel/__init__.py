@@ -9,6 +9,7 @@ from pilosa_tpu.parallel.spmd import (
     put_sharded,
     row_algebra_spmd,
     shard_spec,
+    topn_batch_spmd,
     topn_spmd,
 )
 
@@ -21,5 +22,6 @@ __all__ = [
     "put_sharded",
     "row_algebra_spmd",
     "shard_spec",
+    "topn_batch_spmd",
     "topn_spmd",
 ]

@@ -115,6 +115,49 @@ def topn_spmd(mesh: Mesh, k: int):
     )
 
 
+def topn_batch_spmd(mesh: Mesh, k: int):
+    """Batched TopN candidate generation: Q concurrent query sources
+    scored against every shard in one program (the SPMD form of
+    executor/batcher.py's continuous micro-batching — the shard matrix
+    streams from HBM once per batch, per device).
+
+    srcs: u32[Q, W] (replicated); mat: u32[S, R, W] (shard-sharded)
+    -> (ids i32[Q, S*k], counts i32[Q, S*k]) replicated on every device.
+    """
+
+    def kernel(srcs, mat):
+        # per-device: srcs u32[Q, W], mat u32[s_local, R, W].
+        # lax.map over sources keeps the popcount intermediate at one
+        # [s_local, R, W] buffer instead of Q of them (same trade as
+        # ops.intersection_counts_matrix_batch).
+        def one(src):
+            return jnp.sum(
+                jax.lax.population_count(
+                    jnp.bitwise_and(mat, src[None, None, :])
+                ).astype(jnp.int32),
+                axis=-1,
+            )  # [s_local, R]
+
+        scores = jax.lax.map(one, srcs)  # [Q, s_local, R]
+        q = scores.shape[0]
+        counts, ids = jax.lax.top_k(scores, k)  # [Q, s_local, k]
+        counts = jax.lax.all_gather(
+            counts.reshape(q, -1), SHARD_AXIS, axis=1, tiled=True
+        )
+        ids = jax.lax.all_gather(ids.reshape(q, -1), SHARD_AXIS, axis=1, tiled=True)
+        return ids, counts
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(), P(SHARD_AXIS)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+
+
 def bsi_sum_spmd(mesh: Mesh, bit_depth: int):
     """Sum(field) over all shards: per-plane popcounts psum'd over ICI.
 

@@ -459,8 +459,13 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
 
         def _error_payload(self, msg: str):
             # protobuf clients get a QueryResponse{Err} they can
-            # unmarshal (reference http/error.go)
-            if publicproto.CONTENT_TYPE in (self.headers.get("Accept") or ""):
+            # unmarshal (reference http/error.go); a client that sent
+            # protobuf without an Accept header expects protobuf back,
+            # matching the success path's accepts_proto-or-is_proto
+            wants_proto = publicproto.CONTENT_TYPE in (
+                self.headers.get("Accept") or ""
+            ) or publicproto.CONTENT_TYPE in (self.headers.get("Content-Type") or "")
+            if wants_proto:
                 return (
                     publicproto.encode_query_response([], err=msg),
                     publicproto.CONTENT_TYPE,

@@ -71,6 +71,7 @@ message QueryRequest { string Query = 1; repeated uint64 Shards = 2; bool Column
 message QueryResponse { string Err = 1; repeated QueryResult Results = 2; repeated ColumnAttrSet ColumnAttrSets = 3; }
 message QueryResult { uint32 Type = 6; Row Row = 1; uint64 N = 2; repeated Pair Pairs = 3; ValCount ValCount = 5; bool Changed = 4; }
 message ImportRequest { string Index = 1; string Field = 2; uint64 Shard = 3; repeated uint64 RowIDs = 4; repeated uint64 ColumnIDs = 5; repeated string RowKeys = 7; repeated string ColumnKeys = 8; repeated int64 Timestamps = 6; }
+message ImportValueRequest { string Index = 1; string Field = 2; uint64 Shard = 3; repeated uint64 ColumnIDs = 5; repeated string ColumnKeys = 7; repeated int64 Values = 6; }
 """
 
 
@@ -117,6 +118,15 @@ def test_wire_compat_with_canonical_protobuf(canonical_pb):
     )
     d = pp.decode_import_request(m2.SerializeToString())
     assert d["shard"] == 3 and d["timestamps"] == [-5]
+
+    # ImportValueRequest both directions against the canonical codec
+    m3 = pb.ImportValueRequest()
+    m3.ParseFromString(pp.encode_import_value_request("i", "f", 2, [9, 10], [-42, 7]))
+    assert m3.Index == "i" and m3.Shard == 2
+    assert list(m3.ColumnIDs) == [9, 10] and list(m3.Values) == [-42, 7]
+    m4 = pb.ImportValueRequest(Index="x", Field="y", ColumnIDs=[1], Values=[5])
+    d = pp.decode_import_value_request(m4.SerializeToString())
+    assert d["columnIDs"] == [1] and d["values"] == [5]
 
 
 def test_handler_content_negotiation(tmp_path):

@@ -72,6 +72,27 @@ def test_topn_spmd(mesh):
             assert scores[ids[s * k + i]] == counts[s * k + i]
 
 
+def test_topn_batch_spmd(mesh):
+    from pilosa_tpu.parallel import topn_batch_spmd
+
+    rng = np.random.default_rng(7)
+    S, R, Q, k = 8, 16, 4, 3
+    srcs = rand_words(rng, Q, W)
+    mat = rand_words(rng, S, R, W)
+    fn = topn_batch_spmd(mesh, k)
+    ids, counts = fn(srcs, put_sharded(mesh, mat))
+    ids, counts = np.asarray(ids), np.asarray(counts)
+    assert ids.shape == (Q, S * k) and counts.shape == (Q, S * k)
+    for q in range(Q):
+        for s in range(S):
+            scores = np.bitwise_count(mat[s] & srcs[q][None, :]).sum(axis=1)
+            want = sorted(scores.tolist(), reverse=True)[:k]
+            got = sorted(counts[q, s * k : (s + 1) * k].tolist(), reverse=True)
+            assert got == want, (q, s)
+            for i in range(k):
+                assert scores[ids[q, s * k + i]] == counts[q, s * k + i]
+
+
 def test_bsi_sum_spmd(mesh):
     rng = np.random.default_rng(3)
     S, D = 8, 6
