@@ -1011,7 +1011,9 @@ class Executor:
             src_words = self._device_bitmap(index, c.children[0], shard)
         except _NotDeviceable:
             return frag.top(opt_)
-        mat = self.stager.rows(frag, candidate_ids)
+        # pow2-padded rows bound recompiles; trailing zero rows fall off
+        # the zip with candidate_ids below
+        mat = self.stager.rows(frag, candidate_ids, pad_pow2=True)
         # key on the staged array identity (not frag.generation, which a
         # concurrent import may bump between staging and here): same
         # live array object ⇔ same snapshot, so coalesced peers can

@@ -72,12 +72,28 @@ class DeviceStager:
             v = self._put(key, self._to_device(words), words.nbytes)
         return v
 
-    def rows(self, frag, row_ids: tuple[int, ...]):
-        """u32[K, W] stack of specific rows."""
-        key = self._key(frag, "rows", (row_ids,))
+    def rows(self, frag, row_ids: tuple[int, ...], pad_pow2: bool = False):
+        """u32[K, W] stack of specific rows.
+
+        pad_pow2=True pads the row count up to the next power of two
+        with zero rows (SURVEY.md §7 hard part 5: bucketed shapes keep
+        the XLA compile cache at log2 distinct row counts instead of
+        one entry per candidate-set size). Zero rows score 0 and
+        callers index results by the true row_ids, so padding is
+        invisible. Only valid for scoring-style consumers — boolean
+        folds over the stack would see the zero rows.
+        """
+        from pilosa_tpu.executor.batcher import _next_pow2
+
+        kind = "rows_p2" if pad_pow2 else "rows"
+        key = self._key(frag, kind, (row_ids,))
         v = self._get(key)
         if v is None:
             words = frag.packed_rows(list(row_ids))
+            if pad_pow2 and len(row_ids):
+                target = _next_pow2(words.shape[0])
+                if target > words.shape[0]:
+                    words = np.pad(words, ((0, target - words.shape[0]), (0, 0)))
             v = self._put(key, self._to_device(words), words.nbytes)
         return v
 

@@ -123,6 +123,9 @@ class Handler:
                 self.post_row_attr_diff,
             ),
             Route("GET", r"/debug/vars", self.get_debug_vars),
+            Route("GET", r"/debug/pprof", self.get_debug_pprof),
+            # only the thread-dump profile exists; unknown names 404
+            Route("GET", r"/debug/pprof/goroutine", self.get_debug_pprof),
         ]
 
     # -- route handlers --
@@ -369,6 +372,23 @@ class Handler:
         if hasattr(self.stats, "snapshot"):
             return self.stats.snapshot()
         return {}
+
+    def get_debug_pprof(self, req):
+        """Live thread stack dump — the CPython analog of the reference's
+        net/http/pprof mount (http/handler.go:195): profiling text an
+        operator can curl from a wedged server."""
+        import sys
+        import threading as _t
+
+        names = {t.ident: t.name for t in _t.enumerate()}
+        lines = []
+        for ident, frame in sys._current_frames().items():
+            lines.append(f"goroutine-analog {names.get(ident, '?')} [{ident}]:")
+            lines.extend(
+                line.rstrip() for line in traceback.format_stack(frame)
+            )
+            lines.append("")
+        return RawResponse("\n".join(lines).encode(), "text/plain; charset=utf-8")
 
     # -- dispatch --
 

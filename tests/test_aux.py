@@ -222,3 +222,41 @@ def test_btree_store_survives_many_containers():
         assert [int(v) for v in b.slice_all()] == positions
     finally:
         set_default_container_store(dict)
+
+
+# -- stager pow2 padding + pprof route --------------------------------------
+
+
+def test_stager_rows_pow2_padding(tmp_path):
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import DeviceStager
+
+    h = Holder(str(tmp_path))
+    h.open()
+    idx = h.create_index("sp")
+    f = idx.create_field("f")
+    f.import_bits([0, 1, 2, 3, 4], [1, 2, 3, 4, 5])
+    frag = h.fragment("sp", "f", "standard", 0)
+    st = DeviceStager()
+    mat = st.rows(frag, (0, 1, 2, 3, 4), pad_pow2=True)
+    assert mat.shape[0] == 8  # 5 rows → next pow2
+    assert np.asarray(mat)[5:].sum() == 0  # padding rows are zero
+    unpadded = st.rows(frag, (0, 1, 2, 3, 4))
+    assert unpadded.shape[0] == 5  # separate cache entries
+    np.testing.assert_array_equal(np.asarray(mat)[:5], np.asarray(unpadded))
+    h.close()
+
+
+def test_debug_pprof_route(tmp_path):
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.server.http_handler import Handler, RawResponse
+
+    h = Holder(str(tmp_path))
+    h.open()
+    handler = Handler(API(h, Executor(h)))
+    out = handler.handle("GET", "/debug/pprof", {}, b"")
+    assert isinstance(out, RawResponse)
+    assert b"goroutine-analog" in out.data and b"test_debug_pprof_route" in out.data
+    h.close()
