@@ -107,6 +107,7 @@ class Executor:
         # coalesces concurrent TopN scoring against the same staged
         # matrix into one batched kernel launch (see batcher.py)
         self.scorer = BatchedScorer()
+        self._read_pool = None  # lazy; see execute()
 
     # -- entry point (reference Execute, executor.go:83) ---------------------
 
@@ -135,9 +136,29 @@ class Executor:
         if self.translate_store is not None and not opt.remote:
             for call in query.calls:
                 self._translate_call(index_name, idx, call)
-        results = []
-        for call in query.calls:
-            results.append(self._execute_call(index_name, call, shards, opt))
+        if len(query.calls) > 1 and query.write_call_n() == 0:
+            # An all-read request has no cross-call ordering constraints
+            # (the reference runs calls serially, executor.go:126-145,
+            # but read results are order-independent); running them
+            # concurrently lets the BatchedScorer coalesce their TopN
+            # scoring into batched kernel launches — the intra-request
+            # form of continuous micro-batching.
+            if self._read_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._read_pool = ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="pql-read"
+                )
+            results = list(
+                self._read_pool.map(
+                    lambda call: self._execute_call(index_name, call, shards, opt),
+                    query.calls,
+                )
+            )
+        else:
+            results = []
+            for call in query.calls:
+                results.append(self._execute_call(index_name, call, shards, opt))
         if self.translate_store is not None and not opt.remote:
             results = [
                 self._translate_result(index_name, idx, call, r)

@@ -17,6 +17,7 @@ residency (SURVEY.md §7 hard part 2).
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Optional
 
@@ -26,12 +27,30 @@ import numpy as np
 from pilosa_tpu import SHARD_WIDTH
 
 
+class _InFlight:
+    __slots__ = ("event", "value", "error")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.value = None
+        self.error: Optional[BaseException] = None
+
+
 class DeviceStager:
+    """Thread-safe: concurrent executor threads (parallel multi-call
+    requests, ThreadingHTTPServer handlers) share one stager. A cold
+    key is staged ONCE — concurrent misses for the same key wait on the
+    first builder's in-flight entry and receive the same device array,
+    which also keeps BatchedScorer coalescing intact (its key is the
+    staged array's identity)."""
+
     def __init__(self, budget_bytes: int = 8 << 30, device=None) -> None:
         self.budget_bytes = budget_bytes
         self.device = device
         self._cache: OrderedDict[tuple, tuple[object, int]] = OrderedDict()
         self._bytes = 0
+        self._mu = threading.Lock()
+        self._inflight: dict[tuple, _InFlight] = {}
         self.hits = 0
         self.misses = 0
 
@@ -40,21 +59,45 @@ class DeviceStager:
     def _key(self, frag, kind: str, extra=()) -> tuple:
         return (id(frag), frag.generation, kind) + tuple(extra)
 
-    def _get(self, key):
-        ent = self._cache.get(key)
-        if ent is None:
-            return None
-        self._cache.move_to_end(key)
-        self.hits += 1
-        return ent[0]
-
-    def _put(self, key, value, nbytes: int):
-        self.misses += 1
-        self._cache[key] = (value, nbytes)
-        self._bytes += nbytes
-        while self._bytes > self.budget_bytes and len(self._cache) > 1:
-            _, (old, old_bytes) = self._cache.popitem(last=False)
-            self._bytes -= old_bytes
+    def _get_or_build(self, key, builder):
+        """builder() -> (value, nbytes); runs at most once per cold key."""
+        fl = None
+        with self._mu:
+            ent = self._cache.get(key)
+            if ent is not None:
+                self._cache.move_to_end(key)
+                self.hits += 1
+                return ent[0]
+            fl = self._inflight.get(key)
+            if fl is None:
+                fl = _InFlight()
+                self._inflight[key] = fl
+                building = True
+            else:
+                building = False
+        if not building:
+            fl.event.wait()
+            if fl.error is not None:
+                raise fl.error
+            return fl.value
+        try:
+            value, nbytes = builder()
+        except BaseException as e:
+            with self._mu:
+                self._inflight.pop(key, None)
+            fl.error = e
+            fl.event.set()
+            raise
+        with self._mu:
+            self.misses += 1
+            self._cache[key] = (value, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.budget_bytes and len(self._cache) > 1:
+                _, (_, old_bytes) = self._cache.popitem(last=False)
+                self._bytes -= old_bytes
+            self._inflight.pop(key, None)
+        fl.value = value
+        fl.event.set()
         return value
 
     def _to_device(self, words64: np.ndarray):
@@ -65,12 +108,12 @@ class DeviceStager:
 
     def row(self, frag, row_id: int):
         """u32[W] for one row."""
-        key = self._key(frag, "row", (row_id,))
-        v = self._get(key)
-        if v is None:
+
+        def build():
             words = frag.row_words(row_id)
-            v = self._put(key, self._to_device(words), words.nbytes)
-        return v
+            return self._to_device(words), words.nbytes
+
+        return self._get_or_build(self._key(frag, "row", (row_id,)), build)
 
     def rows(self, frag, row_ids: tuple[int, ...], pad_pow2: bool = False):
         """u32[K, W] stack of specific rows.
@@ -86,35 +129,35 @@ class DeviceStager:
         from pilosa_tpu.executor.batcher import _next_pow2
 
         kind = "rows_p2" if pad_pow2 else "rows"
-        key = self._key(frag, kind, (row_ids,))
-        v = self._get(key)
-        if v is None:
+
+        def build():
             words = frag.packed_rows(list(row_ids))
             if pad_pow2 and len(row_ids):
                 target = _next_pow2(words.shape[0])
                 if target > words.shape[0]:
                     words = np.pad(words, ((0, target - words.shape[0]), (0, 0)))
-            v = self._put(key, self._to_device(words), words.nbytes)
-        return v
+            return self._to_device(words), words.nbytes
+
+        return self._get_or_build(self._key(frag, kind, (row_ids,)), build)
 
     def matrix(self, frag):
         """(row_ids, u32[R, W]) for all non-empty rows."""
-        key = self._key(frag, "matrix")
-        v = self._get(key)
-        if v is None:
+
+        def build():
             ids, words = frag.row_matrix()
             dev = self._to_device(words) if len(ids) else None
-            v = self._put(key, (ids, dev), words.nbytes)
-        return v
+            return (ids, dev), words.nbytes
+
+        return self._get_or_build(self._key(frag, "matrix"), build)
 
     def planes(self, frag, bit_depth: int):
         """u32[bit_depth+1, W] BSI plane stack."""
-        key = self._key(frag, "planes", (bit_depth,))
-        v = self._get(key)
-        if v is None:
+
+        def build():
             words = frag.bsi_planes(bit_depth)
-            v = self._put(key, self._to_device(words), words.nbytes)
-        return v
+            return self._to_device(words), words.nbytes
+
+        return self._get_or_build(self._key(frag, "planes", (bit_depth,)), build)
 
     # -- shard-batched staging (one array covering many fragments) ----------
 
@@ -126,36 +169,35 @@ class DeviceStager:
 
     def row_stack(self, frags, row_id: int):
         """u32[S, W]: one row across S fragments (None → zeros)."""
-        import numpy as np
-        from pilosa_tpu import SHARD_WIDTH as SW
 
-        key = self._stack_key(frags, "row_stack", (row_id,))
-        v = self._get(key)
-        if v is None:
-            words = np.zeros((len(frags), SW // 64), dtype=np.uint64)
+        def build():
+            words = np.zeros((len(frags), SHARD_WIDTH // 64), dtype=np.uint64)
             for i, f in enumerate(frags):
                 if f is not None:
                     words[i] = f.row_words(row_id)
-            v = self._put(key, self._to_device(words), words.nbytes)
-        return v
+            return self._to_device(words), words.nbytes
+
+        return self._get_or_build(
+            self._stack_key(frags, "row_stack", (row_id,)), build
+        )
 
     def planes_stack(self, frags, bit_depth: int):
         """u32[S, bit_depth+1, W] across S fragments (None → zeros)."""
-        import numpy as np
-        from pilosa_tpu import SHARD_WIDTH as SW
 
-        key = self._stack_key(frags, "planes_stack", (bit_depth,))
-        v = self._get(key)
-        if v is None:
+        def build():
             words = np.zeros(
-                (len(frags), bit_depth + 1, SW // 64), dtype=np.uint64
+                (len(frags), bit_depth + 1, SHARD_WIDTH // 64), dtype=np.uint64
             )
             for i, f in enumerate(frags):
                 if f is not None:
                     words[i] = f.bsi_planes(bit_depth)
-            v = self._put(key, self._to_device(words), words.nbytes)
-        return v
+            return self._to_device(words), words.nbytes
+
+        return self._get_or_build(
+            self._stack_key(frags, "planes_stack", (bit_depth,)), build
+        )
 
     def clear(self) -> None:
-        self._cache.clear()
-        self._bytes = 0
+        with self._mu:
+            self._cache.clear()
+            self._bytes = 0

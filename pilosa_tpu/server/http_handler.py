@@ -123,8 +123,9 @@ class Handler:
                 self.post_row_attr_diff,
             ),
             Route("GET", r"/debug/vars", self.get_debug_vars),
-            Route("GET", r"/debug/pprof", self.get_debug_pprof),
-            # only the thread-dump profile exists; unknown names 404
+            # index (with and without trailing slash, as net/http/pprof
+            # serves it) plus the thread-dump profile; unknown names 404
+            Route("GET", r"/debug/pprof/?", self.get_debug_pprof),
             Route("GET", r"/debug/pprof/goroutine", self.get_debug_pprof),
         ]
 

@@ -145,6 +145,38 @@ def test_scorer_error_propagates_to_peers(data, monkeypatch):
             slot.finish()
 
 
+def test_multicall_request_runs_parallel_and_batches():
+    """A single PQL request with several read-only TopN calls executes
+    them concurrently, coalescing their scoring into batched launches;
+    results match per-call sequential execution, order preserved."""
+    import tempfile
+
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import Executor
+
+    with tempfile.TemporaryDirectory() as d:
+        h = Holder(d)
+        h.open()
+        idx = h.create_index("mc")
+        f = idx.create_field("f")
+        rng = np.random.default_rng(13)
+        for row in range(6):
+            cols = rng.choice(4000, size=600, replace=False)
+            f.import_bits([row] * len(cols), cols.tolist())
+        ex = Executor(h, device_policy="always")
+        multi = " ".join(f"TopN(f, Row(f={r}), n=3)" for r in range(6))
+        sequential = [
+            ex.execute("mc", f"TopN(f, Row(f={r}), n=3)")[0] for r in range(6)
+        ]
+        got = ex.execute("mc", multi)
+        assert got == sequential
+        # writes force the sequential path and still work
+        mixed = ex.execute("mc", "Set(9999, f=0) Row(f=0)")
+        assert mixed[0] is True
+        assert 9999 in [int(c) for c in mixed[1].columns()]
+        h.close()
+
+
 def test_executor_concurrent_topn_batches():
     """Concurrent TopN queries through the executor produce identical
     results to sequential execution and coalesce kernel launches."""
@@ -180,3 +212,39 @@ def test_executor_concurrent_topn_batches():
             t.join()
         assert results == sequential
         h.close()
+
+
+def test_stager_concurrent_cold_miss_stages_once(tmp_path):
+    """Concurrent misses on one cold key build once: every caller gets
+    the SAME device array (so scorer keys coalesce) and the byte budget
+    is charged exactly once."""
+    import threading
+
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import DeviceStager
+
+    h = Holder(str(tmp_path))
+    h.open()
+    idx = h.create_index("cc")
+    f = idx.create_field("f")
+    f.import_bits([0, 1, 2], [1, 2, 3])
+    frag = h.fragment("cc", "f", "standard", 0)
+    st = DeviceStager()
+    n = 8
+    out = [None] * n
+    barrier = threading.Barrier(n)
+
+    def run(i):
+        barrier.wait()
+        out[i] = st.rows(frag, (0, 1, 2), pad_pow2=True)
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert all(o is out[0] for o in out)  # one staged array shared
+    assert st.misses == 1
+    ent_bytes = sum(nb for _, nb in st._cache.values())
+    assert st._bytes == ent_bytes  # budget charged exactly once
+    h.close()
