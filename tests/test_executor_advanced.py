@@ -162,3 +162,77 @@ class TestMiscCalls:
         e = execu(holder)
         with pytest.raises(ValueError):
             e.execute("i", "Frobnicate(f=1)")
+
+
+class TestBSIFuzz:
+    """Randomized BSI property sweep (mirrors the reference's exhaustive
+    fragment BSI tests): negative mins, every comparison operator,
+    Between, Sum/Min/Max with and without filters — CPU path is the
+    oracle, device path must be bit-identical."""
+
+    def _setup(self, h, seed=31):
+        rng = np.random.default_rng(seed)
+        idx = h.create_index("bz")
+        f = idx.create_field(
+            "v", FieldOptions(type="int", min=-1000, max=1000)
+        )
+        g = idx.create_field("grp")
+        n = 3000
+        cols = np.arange(n)
+        vals = rng.integers(-1000, 1001, size=n)
+        f.import_values(cols.tolist(), vals.tolist())
+        g.import_bits(rng.integers(0, 4, size=n).tolist(), cols.tolist())
+        return cols, vals, rng
+
+    def test_bsi_fuzz_cpu_device_identity(self, holder):
+        cols, vals, rng = self._setup(holder)
+        cpu = execu(holder, "never")
+        dev = execu(holder, "always")
+        queries = []
+        for _ in range(20):
+            t = int(rng.integers(-1100, 1100))
+            lo = int(rng.integers(-1100, 0))
+            hi = int(rng.integers(0, 1100))
+            queries += [
+                f"Count(Range(v > {t}))",
+                f"Count(Range(v >= {t}))",
+                f"Count(Range(v < {t}))",
+                f"Count(Range(v <= {t}))",
+                f"Count(Range(v == {t}))",
+                f"Count(Range(v != {t}))",
+                f"Count(Range({lo} < v < {hi}))",
+            ]
+        queries += [
+            "Sum(field=v)",
+            "Min(field=v)",
+            "Max(field=v)",
+            "Sum(Row(grp=1), field=v)",
+            "Min(Row(grp=2), field=v)",
+            "Max(Row(grp=3), field=v)",
+        ]
+        for q in queries:
+            want = cpu.execute("bz", q)
+            got = dev.execute("bz", q)
+            if hasattr(want[0], "val"):
+                assert (want[0].val, want[0].count) == (got[0].val, got[0].count), q
+            else:
+                assert want == got, q
+
+    def test_bsi_oracle_against_numpy(self, holder):
+        """The CPU path itself against a straight numpy oracle."""
+        _, vals, rng = self._setup(holder, seed=32)
+        cpu = execu(holder, "never")
+        thresholds = [-1000, -1, 0, 1, 137, 999, 1000] + [
+            int(t) for t in rng.integers(-1000, 1001, size=5)
+        ]
+        for t in thresholds:
+            assert cpu.execute("bz", f"Count(Range(v > {t}))")[0] == int(
+                (vals > t).sum()
+            ), t
+            assert cpu.execute("bz", f"Count(Range(v == {t}))")[0] == int(
+                (vals == t).sum()
+            ), t
+        s = cpu.execute("bz", "Sum(field=v)")[0]
+        assert s.val == int(vals.sum()) and s.count == len(vals)
+        assert cpu.execute("bz", "Min(field=v)")[0].val == int(vals.min())
+        assert cpu.execute("bz", "Max(field=v)")[0].val == int(vals.max())

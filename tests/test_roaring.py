@@ -185,3 +185,90 @@ def test_parse_reference_fixture():
     assert int(a[0]) == 32966 and int(a[-1]) == 1048560182
     b2 = Bitmap.unmarshal_binary(b.to_bytes())
     assert a.tolist() == b2.slice_all().tolist()
+
+
+# -- exhaustive container-form pair matrix ----------------------------------
+# The reference exercises every {array,bitmap,run}x{array,bitmap,run}
+# operation pair (roaring_internal_test.go); here each form pair runs
+# through the full algebra against a Python-set oracle, in both operand
+# orders, plus count-only variants and serialization of each form.
+
+import itertools
+
+from pilosa_tpu.roaring import (
+    CONTAINER_ARRAY,
+    CONTAINER_BITMAP,
+    CONTAINER_RUN,
+    positions_to_words,
+)
+
+FORM_VALUES = {
+    "array": sorted(np.random.default_rng(21).choice(60000, 300, replace=False).tolist()),
+    "bitmap": sorted(np.random.default_rng(22).choice(65536, 9000, replace=False).tolist()),
+    "run": [v for s in (10, 30000, 61000) for v in range(s, s + 1500)],
+}
+
+
+def _bitmap_in_form(form, values):
+    """One-container bitmap whose container is forced into `form`."""
+    b = Bitmap()
+    low = np.array(values, dtype=np.uint16)
+    if form == "array":
+        # keep under ARRAY_MAX_SIZE so it stays array-form
+        low = low[:ARRAY_MAX_SIZE]
+        c = Container.from_array(low)
+        want_type = CONTAINER_ARRAY
+    elif form == "bitmap":
+        c = Container.from_words(positions_to_words(low), n=len(low))
+        want_type = CONTAINER_BITMAP
+    else:
+        c = Container.from_array(low)
+        c.optimize()
+        want_type = CONTAINER_RUN
+    b.containers[0] = c
+    return b, want_type
+
+
+@pytest.mark.parametrize(
+    "fa,fb", list(itertools.product(FORM_VALUES, FORM_VALUES))
+)
+def test_container_form_pair_algebra(fa, fb):
+    ba, ta = _bitmap_in_form(fa, FORM_VALUES[fa])
+    bb, tb = _bitmap_in_form(fb, FORM_VALUES[fb])
+    # the matrix only covers all 9 pairs if each side really holds its form
+    assert ba.containers[0].typ == ta, fa
+    assert bb.containers[0].typ == tb, fb
+    sa = set(int(v) for v in ba.slice_all())
+    sb = set(int(v) for v in bb.slice_all())
+    ops_oracle = {
+        "intersect": sa & sb,
+        "union": sa | sb,
+        "difference": sa - sb,
+        "xor": sa ^ sb,
+    }
+    for op, want in ops_oracle.items():
+        got = set(int(v) for v in getattr(ba, op)(bb).slice_all())
+        assert got == want, (fa, fb, op)
+    assert ba.intersection_count(bb) == len(sa & sb)
+    assert ba.count() == len(sa) and bb.count() == len(sb)
+
+
+@pytest.mark.parametrize("form", list(FORM_VALUES))
+def test_container_form_serialization(form):
+    b, _ = _bitmap_in_form(form, FORM_VALUES[form])
+    rt = Bitmap.unmarshal_binary(b.to_bytes())
+    np.testing.assert_array_equal(rt.slice_all(), b.slice_all())
+
+
+@pytest.mark.parametrize("form", list(FORM_VALUES))
+def test_container_form_point_ops(form):
+    b, _ = _bitmap_in_form(form, FORM_VALUES[form])
+    before = set(int(v) for v in b.slice_all())
+    probe = 40001
+    had = probe in before
+    assert b.contains(probe) == had
+    b.add(probe)
+    assert b.contains(probe)
+    b.remove(probe)
+    assert not b.contains(probe)
+    assert b.count() == len(before - {probe})
