@@ -178,15 +178,63 @@ def new_cache(cache_type: str, cache_size: int):
     raise ValueError(f"unknown cache type: {cache_type}")
 
 
+def encode_cache(ids: list[int]) -> bytes:
+    """The reference's .cache protobuf bytes
+    (internal/private.proto Cache{repeated uint64 IDs = 1}, packed)."""
+    from pilosa_tpu.utils.protometa import _write_tag, _write_varint
+
+    out = bytearray()
+    if ids:
+        buf = bytearray()
+        for v in ids:
+            _write_varint(buf, int(v))
+        _write_tag(out, 1, 2)
+        _write_varint(out, len(buf))
+        out += buf
+    return bytes(out)
+
+
 def write_cache(path: str, ids: list[int]) -> None:
-    """Persist cached row ids (reference .cache protobuf; we use JSON)."""
-    with open(path, "w") as f:
-        json.dump(ids, f)
+    with open(path, "wb") as f:
+        f.write(encode_cache(ids))
 
 
 def read_cache(path: str) -> Optional[list[int]]:
     try:
-        with open(path) as f:
-            return json.load(f)
+        with open(path, "rb") as f:
+            return decode_cache(f.read())
     except FileNotFoundError:
         return None
+
+
+def decode_cache(data: bytes) -> list[int]:
+    """Decode .cache bytes: reference protobuf, or the JSON this
+    framework wrote before adopting the reference format."""
+    from pilosa_tpu.utils.protometa import _read_varint
+
+    if not data:
+        return []
+    if data[:1] == b"[":  # legacy JSON
+        return json.loads(data.decode())
+    ids: list[int] = []
+    i = 0
+    while i < len(data):
+        key, i = _read_varint(data, i)
+        field_no, wire = key >> 3, key & 7
+        if wire == 2:
+            ln, i = _read_varint(data, i)
+            end = i + ln
+            if field_no == 1:
+                while i < end:
+                    v, i = _read_varint(data, i)
+                    ids.append(v)
+                if i != end:
+                    raise ValueError("cache file: packed ids overrun field")
+            i = end  # skip unknown length-delimited fields
+        elif wire == 0:
+            v, i = _read_varint(data, i)
+            if field_no == 1:
+                ids.append(v)
+        else:
+            raise ValueError(f"unsupported wire type in cache file: {wire}")
+    return ids

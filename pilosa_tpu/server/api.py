@@ -334,22 +334,63 @@ class API:
         return {"rows": rows.tolist(), "columns": cols.tolist()}
 
     def marshal_fragment(self, index: str, field: str, view: str, shard: int) -> bytes:
+        """Fragment backup archive: a tar with "data" (roaring bytes)
+        and "cache" (protobuf id list) entries, the reference's
+        WriteTo format (fragment.go:1511-1568)."""
+        import io
+        import tarfile
+
         self._validate("fragment_data")
         frag = self.holder.fragment(index, field, view, shard)
         if frag is None:
             raise NotFoundError("fragment not found")
-        return frag.storage.to_bytes()
+        from pilosa_tpu.core.cache import encode_cache
+
+        with frag.mu:  # consistent (data, cache) snapshot under writers
+            data = frag.storage.to_bytes()
+            cbuf = encode_cache(frag.cache.ids())
+        out = io.BytesIO()
+        with tarfile.open(fileobj=out, mode="w") as tw:
+            for name, blob in (("data", data), ("cache", cbuf)):
+                info = tarfile.TarInfo(name)
+                info.size = len(blob)
+                info.mode = 0o600
+                tw.addfile(info, io.BytesIO(blob))
+        return out.getvalue()
 
     def unmarshal_fragment(
         self, index: str, field: str, view: str, shard: int, data: bytes
     ) -> None:
+        """Restore a fragment from a tar archive (reference ReadFrom,
+        fragment.go:1570-1681) or from raw roaring bytes (this
+        framework's pre-tar wire format)."""
+        import io
+        import tarfile
+
         self._validate("fragment_data")
         f = self.holder.field(index, field)
         if f is None:
             raise NotFoundError(f"field not found: {field}")
         v = f.create_view_if_not_exists(view)
         frag = v.create_fragment_if_not_exists(shard)
+        from pilosa_tpu.core.cache import decode_cache
         from pilosa_tpu.roaring import Bitmap
+
+        cache_ids = None
+        try:
+            with tarfile.open(fileobj=io.BytesIO(data)) as tr:
+                members = {m.name: m for m in tr.getmembers()}
+                entry = members.get("data")
+                blob = tr.extractfile(entry) if entry is not None else None
+                if blob is None:
+                    raise APIError("fragment archive has no 'data' entry")
+                data = blob.read()
+                centry = members.get("cache")
+                cfile = tr.extractfile(centry) if centry is not None else None
+                if cfile is not None:
+                    cache_ids = decode_cache(cfile.read())
+        except tarfile.ReadError:
+            pass  # raw roaring bytes
 
         with frag.mu:
             op_writer = frag.storage.op_writer
@@ -359,6 +400,17 @@ class API:
             frag._row_cache.clear()
             frag.checksums.clear()
             frag._recompute_max_row_id()
+            frag.cache.clear()
+            if cache_ids is None:
+                # raw-bytes restore carries no cache entry: rebuild from
+                # the restored rows so TopN answers immediately
+                cache_ids = frag.row_ids()
+            for row_id in cache_ids:
+                # already under frag.mu — use the unlocked row read
+                frag.cache.bulk_add(
+                    row_id, frag._unprotected_row(row_id).count()
+                )
+            frag.cache.invalidate()
             frag.snapshot()
 
     # -- caches --

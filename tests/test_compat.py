@@ -70,3 +70,62 @@ def test_open_reference_style_data_dir(tmp_path):
         assert frag.row(0).count() >= 0
     finally:
         h.close()
+
+
+def test_cache_file_reference_protobuf_roundtrip(tmp_path):
+    """.cache files use the reference's protobuf Cache{IDs} format and
+    still read this framework's legacy JSON files."""
+    from pilosa_tpu.core.cache import decode_cache, read_cache, write_cache
+
+    p = str(tmp_path / "frag.cache")
+    write_cache(p, [3, 1, 500000])
+    data = open(p, "rb").read()
+    assert data[:1] != b"["  # not JSON
+    assert read_cache(p) == [3, 1, 500000]
+    # packed field 1 decodes identically via protoc's canonical codec shape
+    assert decode_cache(data) == [3, 1, 500000]
+    # legacy JSON still accepted
+    (tmp_path / "old.cache").write_text("[7, 9]")
+    assert read_cache(str(tmp_path / "old.cache")) == [7, 9]
+    # empty file → empty cache
+    (tmp_path / "empty.cache").write_bytes(b"")
+    assert read_cache(str(tmp_path / "empty.cache")) == []
+
+
+def test_fragment_tar_archive_roundtrip(tmp_path):
+    """marshal_fragment emits the reference's tar(data,cache) archive;
+    unmarshal restores storage AND the TopN cache, and still accepts
+    raw roaring bytes."""
+    import io
+    import tarfile
+
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.server.api import API
+
+    h = Holder(str(tmp_path / "a"))
+    h.open()
+    api = API(h, Executor(h))
+    api.create_index("t")
+    api.create_field("t", "f", {"type": "set"})
+    f = h.field("t", "f")
+    f.import_bits([1, 1, 2], [10, 11, 12])
+    blob = api.marshal_fragment("t", "f", "standard", 0)
+    with tarfile.open(fileobj=io.BytesIO(blob)) as tr:
+        assert {m.name for m in tr.getmembers()} == {"data", "cache"}
+
+    h2 = Holder(str(tmp_path / "b"))
+    h2.open()
+    api2 = API(h2, Executor(h2))
+    api2.create_index("t")
+    api2.create_field("t", "f", {"type": "set"})
+    api2.unmarshal_fragment("t", "f", "standard", 0, blob)
+    frag = h2.fragment("t", "f", "standard", 0)
+    assert frag.storage.count() == 3
+    assert sorted(frag.cache.ids()) == [1, 2]  # cache restored from tar
+    # raw roaring bytes (pre-tar wire format) still restore
+    api2.unmarshal_fragment(
+        "t", "f", "standard", 0, h.fragment("t", "f", "standard", 0).storage.to_bytes()
+    )
+    assert h2.fragment("t", "f", "standard", 0).storage.count() == 3
+    h.close()
+    h2.close()
