@@ -164,10 +164,13 @@ def cmd_import(args) -> int:
         if not cols:
             return
         if args.values:
+            # value-mode CSV is columnID,value (reference
+            # ctl/import.go:404-415), so the first CSV field — parsed
+            # into `rows` — is the column id and the second the value
             _post(
                 host,
                 f"/index/{args.index}/field/{args.field}/import-value",
-                {"columnIDs": cols, "values": rows},
+                {"columnIDs": rows, "values": cols},
             )
         else:
             body = {"rowIDs": rows, "columnIDs": cols}

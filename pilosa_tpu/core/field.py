@@ -328,54 +328,81 @@ class Field:
     ) -> None:
         """Group (row, col, ts) by (view, shard) then bulk-import each
         fragment."""
-        rows = list(row_ids)
-        cols = list(column_ids)
-        tss = list(timestamps) if timestamps is not None else [None] * len(rows)
-        if not (len(rows) == len(cols) == len(tss)):
+        import numpy as np
+
+        from pilosa_tpu.core.fragment import _sized
+
+        rows = np.asarray(_sized(row_ids), dtype=np.uint64)
+        cols = np.asarray(_sized(column_ids), dtype=np.uint64)
+        tss = list(timestamps) if timestamps is not None else None
+        if rows.size != cols.size or (tss is not None and len(tss) != rows.size):
             raise ValueError("row/col/timestamp length mismatch")
-        data: dict[tuple[str, int], tuple[list[int], list[int]]] = {}
+        if rows.size == 0:
+            # no views created on an empty import (reference Import
+            # groups first and only touches views with data)
+            return
         q = self.time_quantum()
-        for r, c, t in zip(rows, cols, tss):
-            shard = c // SHARD_WIDTH
-            views = [VIEW_STANDARD]
-            if t is not None:
-                if not q:
-                    raise ValueError("time quantum not set in field")
-                views += views_by_time(VIEW_STANDARD, t, q)
-            for vname in views:
-                key = (vname, shard)
-                bucket = data.get(key)
-                if bucket is None:
-                    bucket = ([], [])
-                    data[key] = bucket
-                bucket[0].append(r)
-                bucket[1].append(c)
-        for (vname, shard), (rs, cs) in sorted(data.items()):
+
+        def import_group(vname: str, rs, cs) -> None:
             view = self.create_view_if_not_exists(vname)
-            frag = view.create_fragment_if_not_exists(shard)
-            frag.bulk_import(rs, cs)
+            shards = cs // np.uint64(SHARD_WIDTH)
+            order = np.argsort(shards, kind="stable")
+            shards, rs, cs = shards[order], rs[order], cs[order]
+            uniq, starts = np.unique(shards, return_index=True)
+            bounds = np.append(starts, shards.size)
+            for k, shard in enumerate(uniq):
+                frag = view.create_fragment_if_not_exists(int(shard))
+                frag.bulk_import(rs[bounds[k] : bounds[k + 1]], cs[bounds[k] : bounds[k + 1]])
+
+        if tss is None or not any(t is not None for t in tss):
+            # fast path: vectorised single-view grouping by shard
+            import_group(VIEW_STANDARD, rows, cols)
+            return
+        # timestamped bits fan out to quantum views; group per view name
+        if not q:
+            raise ValueError("time quantum not set in field")
+        per_view: dict[str, list[int]] = {VIEW_STANDARD: list(range(rows.size))}
+        for i, t in enumerate(tss):
+            if t is None:
+                continue
+            for vname in views_by_time(VIEW_STANDARD, t, q):
+                per_view.setdefault(vname, []).append(i)
+        for vname in sorted(per_view):
+            sel = np.asarray(per_view[vname], dtype=np.int64)
+            import_group(vname, rows[sel], cols[sel])
 
     def import_values(
         self, column_ids: Iterable[int], values: Iterable[int]
     ) -> None:
+        import numpy as np
+
         bsig = self.bsi_group(self.name)
         if bsig is None:
             raise ValueError(f"bsiGroup not found: {self.name}")
-        cols = list(column_ids)
-        vals = list(values)
-        for v in vals:
-            if v < bsig.min or v > bsig.max:
-                raise ValueError(f"value {v} out of range [{bsig.min}, {bsig.max}]")
-        data: dict[int, tuple[list[int], list[int]]] = {}
-        for c, v in zip(cols, vals):
-            shard = c // SHARD_WIDTH
-            bucket = data.get(shard)
-            if bucket is None:
-                bucket = ([], [])
-                data[shard] = bucket
-            bucket[0].append(c)
-            bucket[1].append(v - bsig.min)
+        from pilosa_tpu.core.fragment import _sized
+
+        cols = np.asarray(_sized(column_ids), dtype=np.uint64)
+        vals = np.asarray(_sized(values), dtype=np.int64)
+        if cols.size != vals.size:
+            raise ValueError("column/value mismatch")
+        if cols.size == 0:
+            return  # no views created on an empty import
+        if int(vals.min()) < bsig.min or int(vals.max()) > bsig.max:
+            bad = vals[(vals < bsig.min) | (vals > bsig.max)][0]
+            raise ValueError(
+                f"value {int(bad)} out of range [{bsig.min}, {bsig.max}]"
+            )
+        offsets = (vals - bsig.min).astype(np.uint64)
+        shards = cols // np.uint64(SHARD_WIDTH)
+        order = np.argsort(shards, kind="stable")
+        shards, cols, offsets = shards[order], cols[order], offsets[order]
+        uniq, starts = np.unique(shards, return_index=True)
+        bounds = np.append(starts, shards.size)
         view = self.create_view_if_not_exists(self.bsi_view_name())
-        for shard, (cs, vs) in sorted(data.items()):
-            frag = view.create_fragment_if_not_exists(shard)
-            frag.import_value(cs, vs, bsig.bit_depth())
+        for k, shard in enumerate(uniq):
+            frag = view.create_fragment_if_not_exists(int(shard))
+            frag.import_value(
+                cols[bounds[k] : bounds[k + 1]],
+                offsets[bounds[k] : bounds[k + 1]],
+                bsig.bit_depth(),
+            )

@@ -41,6 +41,12 @@ def pos(row_id: int, column_id: int) -> int:
     return row_id * SHARD_WIDTH + (column_id % SHARD_WIDTH)
 
 
+def _sized(it):
+    """Materialize one-shot iterables so np.asarray sees a sequence
+    (the import signatures advertise Iterable)."""
+    return it if hasattr(it, "__len__") else list(it)
+
+
 class TopOptions:
     """reference topOptions (fragment.go:1046-1058)."""
 
@@ -492,8 +498,8 @@ class Fragment:
         magnitude faster in Python, and the post-import snapshot persists
         identically.
         """
-        rows = np.asarray(list(row_ids), dtype=np.uint64)
-        cols = np.asarray(list(column_ids), dtype=np.uint64)
+        rows = np.asarray(_sized(row_ids), dtype=np.uint64)
+        cols = np.asarray(_sized(column_ids), dtype=np.uint64)
         if rows.size != cols.size:
             raise ValueError("row/column id mismatch")
         if rows.size == 0:
@@ -511,7 +517,7 @@ class Fragment:
             self.generation += 1
             self._row_cache.clear()
             self.checksums.clear()
-            touched = sorted(set((int(r) for r in rows)))
+            touched = [int(r) for r in np.unique(rows)]
             for row_id in touched:
                 self.cache.bulk_add(row_id, self._unprotected_row(row_id).count())
                 if row_id > self.max_row_id:
@@ -522,20 +528,47 @@ class Fragment:
     def import_value(
         self, column_ids: Iterable[int], values: Iterable[int], bit_depth: int
     ) -> None:
-        """Bulk BSI import (reference importValue:1363-1397)."""
-        cols = list(column_ids)
-        vals = list(values)
-        if len(cols) != len(vals):
+        """Bulk BSI import (reference importValue:1363-1397), vectorised:
+        clear every imported column's bit planes in one difference, then
+        union in the set bits — identical to the reference's per-bit
+        add/remove loop, last write winning for duplicate columns."""
+        cols = np.asarray(_sized(column_ids), dtype=np.uint64)
+        vals = np.asarray(_sized(values), dtype=np.uint64)
+        if cols.size != vals.size:
             raise ValueError("column/value mismatch")
+        if cols.size == 0:
+            return
+        min_col = self.shard * SHARD_WIDTH
+        if int(cols.min()) < min_col or int(cols.max()) >= min_col + SHARD_WIDTH:
+            raise ValueError("column out of bounds")
         with self.mu:
-            for c, v in zip(cols, vals):
-                for i in range(bit_depth):
-                    p = self._check_pos(i, c)
-                    if (v >> i) & 1:
-                        self.storage.add_no_oplog(p)
-                    else:
-                        self.storage.remove_no_oplog(p)
-                self.storage.add_no_oplog(self._check_pos(bit_depth, c))
+            # last write wins for duplicate columns (the reference's
+            # sequential loop overwrites earlier values)
+            _, last_idx = np.unique(cols[::-1], return_index=True)
+            keep = cols.size - 1 - last_idx
+            cols_l = (cols[keep] % np.uint64(SHARD_WIDTH)).astype(np.uint64)
+            vals_k = vals[keep]
+            sw = np.uint64(SHARD_WIDTH)
+            clear_pos = []
+            set_pos = []
+            for i in range(bit_depth):
+                base = np.uint64(i) * sw
+                clear_pos.append(base + cols_l)
+                mask = (vals_k >> np.uint64(i)) & np.uint64(1) == 1
+                set_pos.append(base + cols_l[mask])
+            nn = np.uint64(bit_depth) * sw + cols_l  # not-null plane
+            set_pos.append(nn)
+            set_bm = Bitmap.from_sorted(np.unique(np.concatenate(set_pos)))
+            op_writer = self.storage.op_writer
+            if clear_pos:  # bit_depth == 0 (min == max) has no planes
+                clear_bm = Bitmap.from_sorted(
+                    np.unique(np.concatenate(clear_pos))
+                )
+                merged = self.storage.difference(clear_bm).union(set_bm)
+            else:
+                merged = self.storage.union(set_bm)
+            merged.op_writer = op_writer
+            self.storage = merged
             self.generation += 1
             self._row_cache.clear()
             self.checksums.clear()

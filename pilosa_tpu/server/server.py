@@ -85,6 +85,10 @@ class Server:
     # -- lifecycle (reference Server.Open:312) --
 
     def open(self) -> None:
+        self._set_file_limit()
+        self.logger.printf(
+            "pilosa_tpu %s starting, data=%s", __version__, self.holder.path
+        )
         self.holder.open()
         self.node_id = self.holder.load_node_id()
         # HTTP up first: join/resize messages must be receivable before
@@ -106,6 +110,22 @@ class Server:
             self.api.cluster = self.cluster
             self.cluster.attach_server(self)
         self._start_background_loops()
+
+    def _set_file_limit(self) -> None:
+        """Raise RLIMIT_NOFILE toward the reference's 262,144 target
+        (holder.setFileLimit, holder.go:40,470) — one mmapped file per
+        fragment adds up. Best-effort: capped at the hard limit."""
+        try:
+            import resource
+
+            target = 262_144
+            soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+            want = min(target, hard) if hard != resource.RLIM_INFINITY else target
+            if soft < want:
+                resource.setrlimit(resource.RLIMIT_NOFILE, (want, hard))
+                self.logger.printf("raised open-file limit to %d", want)
+        except (ImportError, ValueError, OSError) as e:
+            self.logger.printf("could not raise file limit: %s", e)
 
     def _start_background_loops(self) -> None:
         """reference server.go: monitorAntiEntropy:400, monitorRuntime:683,

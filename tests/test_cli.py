@@ -59,7 +59,9 @@ def test_import_and_export(tmp_path, server, capsys):
 
 def test_import_values(tmp_path, server):
     csv_file = tmp_path / "vals.csv"
-    csv_file.write_text("10,1\n20,2\n30,3\n")  # value,col pairs (row=value)
+    # columnID,value pairs — the reference's value-mode CSV order
+    # (ctl/import.go:404-415)
+    csv_file.write_text("10,1\n20,2\n30,3\n")
     rc = main(
         [
             "import", "--host", server.uri, "-i", "i", "-f", "v",
@@ -73,7 +75,14 @@ def test_import_values(tmp_path, server):
     )
     with urllib.request.urlopen(r) as resp:
         out = json.loads(resp.read())
-    assert out["results"][0] == {"value": 60, "count": 3}
+    assert out["results"][0] == {"value": 6, "count": 3}
+    # the value landed on the right column
+    r = urllib.request.Request(
+        server.uri + "/index/i/query", data=b"Range(v == 2)", method="POST"
+    )
+    with urllib.request.urlopen(r) as resp:
+        out = json.loads(resp.read())
+    assert out["results"][0]["columns"] == [20]
 
 
 def test_import_with_timestamp(tmp_path, server):
