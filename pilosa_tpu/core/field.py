@@ -12,8 +12,11 @@ import threading
 from datetime import datetime
 from typing import Iterable, Optional
 
+import numpy as np
+
 from pilosa_tpu import SHARD_WIDTH
 from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.core.fragment import _sized
 from pilosa_tpu.core.row import Row
 from pilosa_tpu.core.timequantum import views_by_time, views_by_time_range
 from pilosa_tpu.core.view import VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD, View
@@ -328,10 +331,6 @@ class Field:
     ) -> None:
         """Group (row, col, ts) by (view, shard) then bulk-import each
         fragment."""
-        import numpy as np
-
-        from pilosa_tpu.core.fragment import _sized
-
         rows = np.asarray(_sized(row_ids), dtype=np.uint64)
         cols = np.asarray(_sized(column_ids), dtype=np.uint64)
         tss = list(timestamps) if timestamps is not None else None
@@ -374,13 +373,9 @@ class Field:
     def import_values(
         self, column_ids: Iterable[int], values: Iterable[int]
     ) -> None:
-        import numpy as np
-
         bsig = self.bsi_group(self.name)
         if bsig is None:
             raise ValueError(f"bsiGroup not found: {self.name}")
-        from pilosa_tpu.core.fragment import _sized
-
         cols = np.asarray(_sized(column_ids), dtype=np.uint64)
         vals = np.asarray(_sized(values), dtype=np.int64)
         if cols.size != vals.size:
