@@ -126,17 +126,15 @@ class Container:
         """Packed uint64[1024] view of this container (copy for array/run)."""
         if self.typ == CONTAINER_BITMAP:
             return self.bitmap
-        w = np.zeros(BITMAP_N, dtype=np.uint64)
         if self.typ == CONTAINER_ARRAY:
-            if self.array.size:
-                a = self.array.astype(np.uint64)
-                np.bitwise_or.at(w, a >> _WORD_INDEX, _BIT << (a & _WORD_MASK))
-        else:  # run
-            if self.runs is not None and self.runs.size:
-                mask = np.zeros(1 << 16, dtype=bool)
-                for s, l in self.runs:
-                    mask[int(s) : int(l) + 1] = True
-                w = np.packbits(mask, bitorder="little").view(np.uint64).copy()
+            return positions_to_words(self.array)
+        # run form
+        w = np.zeros(BITMAP_N, dtype=np.uint64)
+        if self.runs is not None and self.runs.size:
+            mask = np.zeros(1 << 16, dtype=bool)
+            for s, l in self.runs:
+                mask[int(s) : int(l) + 1] = True
+            w = np.packbits(mask, bitorder="little").view(np.uint64).copy()
         return w
 
     def positions(self) -> np.ndarray:
@@ -282,10 +280,16 @@ def words_to_positions(words: np.ndarray) -> np.ndarray:
 
 
 def positions_to_words(pos: np.ndarray) -> np.ndarray:
+    """pos must be sorted (the array-container invariant). Grouped
+    bitwise_or.reduceat beats ufunc.at by ~10x — this is the staging
+    expansion's inner loop."""
     w = np.zeros(BITMAP_N, dtype=np.uint64)
     if pos.size:
         a = pos.astype(np.uint64)
-        np.bitwise_or.at(w, a >> _WORD_INDEX, _BIT << (a & _WORD_MASK))
+        wi = (a >> _WORD_INDEX).astype(np.int64)
+        vals = _BIT << (a & _WORD_MASK)
+        uniq, starts = np.unique(wi, return_index=True)
+        w[uniq] = np.bitwise_or.reduceat(vals, starts)
     return w
 
 
