@@ -1,0 +1,24 @@
+#!/bin/bash
+# Probe the tunneled TPU every ~4 min; when it answers, run the full bench
+# (which persists BENCH_last_good.json) and exit so the session is notified.
+# -k 5: the wedge being probed ignores SIGTERM; escalate to SIGKILL.
+cd /root/repo
+for i in $(seq 1 120); do
+  if PILOSA_BENCH_PROBE=1 timeout -k 5 70 python bench.py >/dev/null 2>&1; then
+    echo "TPU alive on attempt $i at $(date -u +%H:%M:%S)"
+    PILOSA_BENCH_ATTEMPTS=2 timeout -k 5 700 python bench.py > /root/repo/.tpu_bench_out.json 2>/root/repo/.tpu_bench_err.log
+    rc=$?
+    echo "bench rc=$rc"
+    cat /root/repo/.tpu_bench_out.json
+    # A stale replay or a zero result means the tunnel wedged again
+    # between probe and bench — keep watching instead of declaring done.
+    if [ $rc -eq 0 ] && ! grep -q '"stale": true' /root/repo/.tpu_bench_out.json \
+       && ! grep -q '"value": 0.0' /root/repo/.tpu_bench_out.json; then
+      exit 0
+    fi
+    echo "bench not fresh; continuing watch"
+  fi
+  sleep 240
+done
+echo "TPU never answered in ~8h"
+exit 1

@@ -224,34 +224,148 @@ def main():
     )
 
 
+def _probe_main():
+    """Tiny device liveness check run in a disposable child: init the
+    backend, round-trip one array. Exits 0 iff the device answered."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    d = jax.devices()[0]
+    x = jax.device_put(np.arange(8, dtype=np.uint32))
+    got = int(np.asarray(jax.numpy.sum(x)))
+    assert got == 28, got
+    print(f"probe ok: {d.platform}", file=sys.stderr)
+
+
+LAST_GOOD = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_last_good.json")
+
+
+def _extract_json_line(text):
+    """Last line of stdout that parses as a JSON object with 'metric'."""
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            return obj
+    return None
+
+
 def _guarded_main():
-    """Run the measurement in a child process with a watchdog.
+    """Run the measurement in a child process with a watchdog + retries.
 
     The tunneled TPU backend can wedge at client init (a hung PJRT
     make_c_api_client blocks SIGTERM-less in C code); without a guard
-    the whole bench run would hang and emit nothing. The child does the
-    real work; on timeout the parent still prints one valid JSON line
-    flagging the backend as unavailable.
+    the whole bench run would hang and emit nothing. Strategy:
+      1. Probe the device with a short-timeout child; retry with
+         backoff — a wedged tunnel sometimes recovers between attempts.
+      2. On a live device, run the real bench child (watchdog'd) and
+         persist its JSON line to BENCH_last_good.json.
+      3. If the device never answers (or the bench child dies), fall
+         back to the last persisted good result marked stale=true —
+         a flaky tunnel degrades to stale-but-real instead of 0.0.
     """
     import subprocess
+    import time as _time
 
-    try:
-        timeout_s = float(os.environ.get("PILOSA_BENCH_TIMEOUT", 540))
-    except ValueError:
-        timeout_s = 540.0
-    env = dict(os.environ, PILOSA_BENCH_CHILD="1")
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env,
-            timeout=timeout_s,
+    def _env_float(name, default):
+        try:
+            return float(os.environ.get(name, default))
+        except ValueError:
+            return float(default)
+
+    # Everything — probes, backoff, the bench child, and printing the
+    # JSON line — must finish inside this budget, because callers wrap
+    # the whole run in an outer `timeout` that would kill us mid-write.
+    budget_s = _env_float("PILOSA_BENCH_TIMEOUT", 520)
+    deadline = _time.monotonic() + budget_s
+    probe_timeout = _env_float("PILOSA_BENCH_PROBE_TIMEOUT", 75)
+    attempts = max(1, int(_env_float("PILOSA_BENCH_ATTEMPTS", 3)))
+    me = os.path.abspath(__file__)
+
+    def remaining(margin=10.0):
+        return deadline - _time.monotonic() - margin
+
+    def run_child(extra_env, child_timeout):
+        env = dict(os.environ, **extra_env)
+        try:
+            return subprocess.run(
+                [sys.executable, me],
+                env=env,
+                timeout=child_timeout,
+                stdout=subprocess.PIPE,
+                text=True,
+            )
+        except subprocess.TimeoutExpired:
+            return None
+
+    reason = "device probe never ran"
+    alive = False
+    for i in range(attempts):
+        t = min(probe_timeout, remaining())
+        if t <= 5:
+            reason = "budget exhausted before device answered"
+            break
+        proc = run_child({"PILOSA_BENCH_PROBE": "1"}, t)
+        if proc is not None and proc.returncode == 0:
+            alive = True
+            break
+        reason = (
+            f"device probe timed out after {t:.0f}s"
+            if proc is None
+            else f"device probe exited {proc.returncode}"
         )
-        if proc.returncode == 0:
-            return
-        reason = f"bench child exited {proc.returncode}"
-    except subprocess.TimeoutExpired:
-        reason = f"bench child timed out after {timeout_s:.0f}s (TPU backend wedged?)"
+        print(f"attempt {i + 1}/{attempts}: {reason}", file=sys.stderr)
+        if i + 1 < attempts and remaining() > 30:
+            _time.sleep(min(10 * (i + 1), 30))
+
+    if alive and remaining() <= 60:
+        alive = False
+        reason = "device alive but budget too small to run the bench"
+    if alive:
+        child_timeout = remaining()
+        proc = run_child({"PILOSA_BENCH_CHILD": "1"}, child_timeout)
+        if proc is None:
+            reason = f"bench child timed out after {child_timeout:.0f}s"
+        elif proc.returncode != 0:
+            reason = f"bench child exited {proc.returncode}"
+        else:
+            obj = _extract_json_line(proc.stdout)
+            if obj is None:
+                reason = "bench child produced no JSON line"
+            else:
+                if obj.get("platform") == "tpu":
+                    # Only a real-device result is worth replaying later;
+                    # a CPU smoke run must not masquerade as the TPU number.
+                    # Write-then-rename so a killed writer can't truncate
+                    # the previous good file.
+                    try:
+                        tmp = LAST_GOOD + ".tmp"
+                        with open(tmp, "w") as f:
+                            json.dump(obj, f)
+                            f.write("\n")
+                        os.replace(tmp, LAST_GOOD)
+                    except OSError as e:
+                        print(f"could not persist last-good: {e}", file=sys.stderr)
+                print(json.dumps(obj))
+                return
     print(reason, file=sys.stderr)
+
+    # Fallback: replay the last good measurement, marked stale.
+    try:
+        with open(LAST_GOOD) as f:
+            obj = json.load(f)
+        obj["stale"] = True
+        obj["error"] = f"replayed last good result; this run failed: {reason}"
+        print(json.dumps(obj))
+        return
+    except (OSError, ValueError):
+        pass
     print(
         json.dumps(
             {
@@ -266,7 +380,9 @@ def _guarded_main():
 
 
 if __name__ == "__main__":
-    if os.environ.get("PILOSA_BENCH_CHILD"):
+    if os.environ.get("PILOSA_BENCH_PROBE"):
+        _probe_main()
+    elif os.environ.get("PILOSA_BENCH_CHILD"):
         main()
     else:
         _guarded_main()
