@@ -309,7 +309,96 @@ def bench_cluster(tmp, scale):
             sv.close()
 
 
+def bench_spmd(tmp, scale):
+    """Mesh-server HTTP path: queries against a server with
+    mesh_devices=all (multi-shard Count/Sum/TopN lowered through the
+    shard_map collectives in parallel/spmd.py) must answer bit-identically
+    to a meshless CPU server over the same data."""
+    import http.client
+
+    import jax
+    import numpy as np
+
+    from pilosa_tpu import SHARD_WIDTH
+    from pilosa_tpu.server.config import Config
+    from pilosa_tpu.server.server import Server
+
+    if len(jax.devices()) < 2:
+        print(
+            json.dumps(
+                {
+                    "config": "spmd_mesh_http",
+                    "skipped": f"only {len(jax.devices())} device(s) visible",
+                }
+            )
+        )
+        return True
+
+    rng = np.random.default_rng(9)
+    sets = []
+    for shard in range(6):
+        base = shard * SHARD_WIDTH
+        for _ in range(400 * scale):
+            sets.append(
+                f"Set({base + int(rng.integers(0, SHARD_WIDTH))},"
+                f" f={int(rng.integers(0, 8))})"
+            )
+    queries = []
+    for r in range(8):
+        queries += [
+            f"Count(Row(f={r}))",
+            "TopN(f, n=4)",
+            f"TopN(f, Row(f={r}), n=4)",
+            f"Count(Intersect(Row(f={r}), Row(f={(r + 1) % 8})))",
+        ]
+
+    def run(name, mesh_devices, policy):
+        cfg = Config(
+            data_dir=os.path.join(tmp, name),
+            bind="127.0.0.1:0",
+            mesh_devices=mesh_devices,
+            device_policy=policy,
+            metric="none",
+            anti_entropy_interval=0,
+        )
+        sv = Server(cfg)
+        sv.open()
+        host, port = sv.address()
+
+        def req(body):
+            conn = http.client.HTTPConnection(host, port, timeout=60)
+            conn.request("POST", "/index/s/query", body)
+            resp = conn.getresponse()
+            out = resp.read()
+            conn.close()
+            return json.loads(out)
+
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=60)
+            conn.request("POST", "/index/s", b"")
+            conn.getresponse().read()
+            conn.request("POST", "/index/s/field/f", b"")
+            conn.getresponse().read()
+            conn.close()
+            for i in range(0, len(sets), 500):
+                req(" ".join(sets[i : i + 500]).encode())
+            results, qps, p50 = _run_queries(
+                lambda q: req(q.encode()), queries, warm=True
+            )
+            return results, qps, p50
+        finally:
+            sv.close()
+
+    want, cpu_qps, _ = run("spmd_cpu", 0, "never")
+    got, dev_qps, p50 = run("spmd_mesh", "all", "always")
+    ok = want == got
+    return _report("spmd_mesh_http", len(queries), dev_qps, cpu_qps, p50, ok)
+
+
 def main():
+    from pilosa_tpu.utils.jaxplatform import honor_platform_env
+
+    honor_platform_env()
     scale = int(os.environ.get("PILOSA_GAUNTLET_SCALE", 1))
     all_ok = True
     t0 = time.time()
@@ -320,6 +409,7 @@ def main():
             bench_ssb,
             bench_synthetic,
             bench_cluster,
+            bench_spmd,
         ):
             try:
                 all_ok &= bool(fn(tmp, scale))

@@ -22,6 +22,9 @@ from pilosa_tpu import SHARD_WIDTH, __version__
 
 
 def main(argv=None) -> int:
+    from pilosa_tpu.utils.jaxplatform import honor_platform_env
+
+    honor_platform_env()
     parser = argparse.ArgumentParser(
         prog="pilosa_tpu", description="TPU-native distributed bitmap index"
     )
@@ -33,6 +36,10 @@ def main(argv=None) -> int:
     p.add_argument("-d", "--data-dir", help="data directory")
     p.add_argument("-b", "--bind", help="host:port to bind")
     p.add_argument("--device-policy", choices=["never", "auto", "always"])
+    p.add_argument(
+        "--mesh-devices",
+        help="SPMD mesh size over the shard axis: a count or 'all' (default off)",
+    )
     p.add_argument("--cluster-disabled", action="store_true", default=None)
     p.add_argument("--coordinator", action="store_true", default=None)
     p.add_argument("--coordinator-host")
@@ -105,6 +112,8 @@ def cmd_server(args) -> int:
         cfg.bind = args.bind
     if args.device_policy:
         cfg.device_policy = args.device_policy
+    if args.mesh_devices:
+        cfg.mesh_devices = args.mesh_devices
     if args.verbose is not None:
         cfg.verbose = args.verbose
     if args.cluster_disabled is not None:

@@ -96,11 +96,22 @@ class Executor:
         device_policy: str = "auto",
         translate_store=None,
         max_writes_per_request: int = 5000,
+        mesh=None,
     ) -> None:
         self.holder = holder
         self.cluster = cluster  # None = single-node
         self.node = node
-        self.stager = stager or DeviceStager()
+        # A mesh turns the shard-batched device path SPMD: stacks stage
+        # split over the mesh's shard axis and Count/Sum/TopN terminals
+        # lower to shard_map kernels whose cross-shard reduces are ICI
+        # collectives (parallel/spmd.py) — the reference's per-node
+        # HTTP scatter-gather (executor.go:1444-1593) inside one program.
+        self.mesh = mesh
+        self.stager = stager or DeviceStager(mesh=mesh)
+        if mesh is not None and self.stager.mesh is not mesh:
+            # a shared stager staging on a different (or no) mesh would
+            # hand the SPMD kernels wrongly-placed arrays — fail loud
+            raise ValueError("executor mesh differs from the stager's mesh")
         self.device_policy = device_policy
         self.translate_store = translate_store
         self.max_writes_per_request = max_writes_per_request
@@ -108,6 +119,40 @@ class Executor:
         # matrix into one batched kernel launch (see batcher.py)
         self.scorer = BatchedScorer()
         self._read_pool = None  # lazy; see execute()
+        self._read_pool_mu = threading.Lock()
+        # compiled shard_map kernels keyed by (kind, static args) — the
+        # closures in spmd.py are rebuilt per call, so cache here to keep
+        # XLA's jit cache effective across queries
+        self._spmd_kernels: dict[tuple, Any] = {}
+        self._spmd_mu = threading.Lock()
+
+    def _spmd_kernel(self, kind: str, *statics):
+        key = (kind,) + statics
+        with self._spmd_mu:
+            fn = self._spmd_kernels.get(key)
+            if fn is None:
+                from pilosa_tpu.parallel import spmd
+
+                if kind == "count":
+                    fn = spmd.count_stack_spmd(self.mesh)
+                elif kind == "plane_counts":
+                    fn = spmd.bsi_sum_spmd(self.mesh, *statics)
+                elif kind == "topn_scores":
+                    fn = spmd.topn_scores_spmd(self.mesh)
+                else:
+                    raise ValueError(kind)
+                self._spmd_kernels[key] = fn
+            return fn
+
+    def _shard_plan(self, shards: list[int]) -> list[int]:
+        """Pad the shard list to a mesh-size multiple (padding shards
+        have no fragments and stage as zero words — identity for every
+        reduce). No-op without a mesh."""
+        if self.mesh is None:
+            return shards
+        from pilosa_tpu.parallel.spmd import ShardBatchPlan
+
+        return ShardBatchPlan(self.mesh, shards).padded
 
     # -- entry point (reference Execute, executor.go:83) ---------------------
 
@@ -143,14 +188,16 @@ class Executor:
             # concurrently lets the BatchedScorer coalesce their TopN
             # scoring into batched kernel launches — the intra-request
             # form of continuous micro-batching.
-            if self._read_pool is None:
-                from concurrent.futures import ThreadPoolExecutor
+            with self._read_pool_mu:
+                if self._read_pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
 
-                self._read_pool = ThreadPoolExecutor(
-                    max_workers=16, thread_name_prefix="pql-read"
-                )
+                    self._read_pool = ThreadPoolExecutor(
+                        max_workers=16, thread_name_prefix="pql-read"
+                    )
+                pool = self._read_pool  # local ref: close() may null the attr
             results = list(
-                self._read_pool.map(
+                pool.map(
                     lambda call: self._execute_call(index_name, call, shards, opt),
                     query.calls,
                 )
@@ -783,7 +830,10 @@ class Executor:
             and self._use_device_batched(index, child, shards)
         ):
             try:
-                words = self._device_bitmap_stack(index, child, shards)
+                batch = self._shard_plan(shards)
+                words = self._device_bitmap_stack(index, child, batch)
+                if self.mesh is not None:
+                    return int(self._spmd_kernel("count")(words))
                 return int(ops.count_bits(words))
             except _NotDeviceable:
                 pass
@@ -843,31 +893,42 @@ class Executor:
             f = self.holder.field(index, field_name)
             bsig = f.bsi_group(field_name) if f else None
             if bsig is not None:
+                batch = self._shard_plan(shards)
                 frags = tuple(
                     self.holder.fragment(
                         index, field_name, VIEW_BSI_GROUP_PREFIX + field_name, s
                     )
-                    for s in shards
+                    for s in batch
                 )
                 if any(frags):
                     depth = bsig.bit_depth()
                     try:
                         if len(c.children) == 1:
                             filt = self._device_bitmap_stack(
-                                index, c.children[0], shards
+                                index, c.children[0], batch
                             )
                             has_filter = True
                         else:
                             filt = np.zeros(
-                                (len(shards), _W32), dtype=np.uint32
+                                (len(batch), _W32), dtype=np.uint32
                             )
                             has_filter = False
                         planes = self.stager.planes_stack(frags, depth)
-                        counts = np.asarray(
-                            ops.bsi_plane_counts_batched(
-                                planes, filt, bit_depth=depth, has_filter=has_filter
+                        if self.mesh is not None:
+                            counts = np.asarray(
+                                self._spmd_kernel(
+                                    "plane_counts", depth, has_filter
+                                )(planes, filt)
                             )
-                        )
+                        else:
+                            counts = np.asarray(
+                                ops.bsi_plane_counts_batched(
+                                    planes,
+                                    filt,
+                                    bit_depth=depth,
+                                    has_filter=has_filter,
+                                )
+                            )
                         vsum = sum(int(counts[i]) << i for i in range(depth))
                         vcount = int(counts[depth])
                         if vcount == 0:
@@ -979,11 +1040,83 @@ class Executor:
         return _pairs_result(trimmed)
 
     def _execute_topn_shards(self, index, c: Call, shards, opt) -> list[tuple[int, int]]:
+        if (
+            self.mesh is not None
+            and self._local_batchable(opt)
+            and shards
+            and len(c.children) == 1
+            and self._use_device_batched(index, c, shards)
+        ):
+            try:
+                return sort_pairs(self._topn_shards_spmd(index, c, shards))
+            except _NotDeviceable:
+                pass
+
         def map_fn(shard):
             return self._execute_topn_shard(index, c, shard)
 
         result = self._map_reduce(index, shards, c, opt, map_fn, pairs_add, zero_factory=list)
         return sort_pairs(result or [])
+
+    def _topn_shards_spmd(self, index, c: Call, shards) -> list[tuple[int, int]]:
+        """All shards' TopN candidate scoring in ONE mesh program: the
+        per-shard candidate matrices stage sharded over the mesh, one
+        shard_map launch scores every candidate everywhere (all_gather
+        replaces the reference's HTTP Pairs exchange, executor.go:563-585),
+        and the host replays the ranked walk per shard for bit-identical
+        pruning."""
+        from pilosa_tpu.executor.batcher import _next_pow2
+
+        field, _ = c.string_arg("_field")
+        n, _ = c.uint_arg("n")
+        attr_name, _ = c.string_arg("attrName")
+        row_ids, _ = c.uint_slice_arg("ids")
+        min_threshold, _ = c.uint_arg("threshold")
+        attr_values = c.args.get("attrValues") or []
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+        if tanimoto > 100:
+            raise ValueError("Tanimoto Threshold is from 1 to 100 only")
+        if tanimoto > 0:
+            # tanimoto pruning needs each shard's CPU source count;
+            # the per-shard path already has those rows in hand
+            raise _NotDeviceable("TopN+tanimoto")
+        if min_threshold <= 0:
+            min_threshold = DEFAULT_MIN_THRESHOLD
+
+        batch = self._shard_plan(shards)
+        frags = tuple(
+            self.holder.fragment(index, field, VIEW_STANDARD, s) for s in batch
+        )
+        pairs_by_shard = [
+            f._top_bitmap_pairs(row_ids) if f is not None else [] for f in frags
+        ]
+        max_k = max((len(p) for p in pairs_by_shard), default=0)
+        if max_k == 0:
+            return []
+        k = _next_pow2(max_k)
+        ids_by_shard = tuple(tuple(p[0] for p in ps) for ps in pairs_by_shard)
+        srcs = self._device_bitmap_stack(index, c.children[0], batch)
+        mats = self.stager.rows_stack(frags, ids_by_shard, k)
+        scores = np.asarray(self._spmd_kernel("topn_scores")(srcs, mats))
+
+        out: list[tuple[int, int]] = []
+        for i, (frag, pairs) in enumerate(zip(frags, pairs_by_shard)):
+            if frag is None or not pairs:
+                continue
+            score_by_id = {
+                rid: int(scores[i, j]) for j, rid in enumerate(ids_by_shard[i])
+            }
+            opt_ = TopOptions(
+                n=int(n),
+                src=None,
+                row_ids=row_ids,
+                min_threshold=min_threshold,
+                filter_name=attr_name,
+                filter_values=attr_values,
+                tanimoto_threshold=0,
+            )
+            out = pairs_add(out, _ranked_walk(frag, opt_, pairs, score_by_id))
+        return out
 
     def _execute_topn_shard(self, index, c: Call, shard: int) -> list[tuple[int, int]]:
         field, _ = c.string_arg("_field")
@@ -1041,64 +1174,7 @@ class Executor:
         # never mix matrices
         scores = self.scorer.score((id(frag), id(mat)), mat, src_words)
         score_by_id = dict(zip(candidate_ids, (int(s) for s in scores)))
-
-        # Replay fragment.top's walk with precomputed counts.
-        import heapq
-        import math
-
-        n = 0 if opt_.row_ids else opt_.n
-        filters = set(opt_.filter_values) if (opt_.filter_name and opt_.filter_values) else None
-        tanimoto_threshold = 0
-        min_tanimoto = max_tanimoto = 0.0
-        src_count = 0
-        if opt_.tanimoto_threshold > 0:
-            tanimoto_threshold = opt_.tanimoto_threshold
-            src_count = opt_.src.count()
-            min_tanimoto = float(src_count * tanimoto_threshold) / 100
-            max_tanimoto = float(src_count * 100) / float(tanimoto_threshold)
-
-        results: list[tuple[int, int]] = []
-        for row_id, cnt in pairs:
-            if cnt <= 0:
-                continue
-            if tanimoto_threshold > 0:
-                if float(cnt) <= min_tanimoto or float(cnt) >= max_tanimoto:
-                    continue
-            elif cnt < opt_.min_threshold:
-                continue
-            if filters is not None:
-                attr = frag.row_attr_store.attrs(row_id) if frag.row_attr_store else None
-                if not attr:
-                    continue
-                value = attr.get(opt_.filter_name)
-                if value is None or value not in filters:
-                    continue
-            if n == 0 or len(results) < n:
-                count = score_by_id[row_id]
-                if count == 0:
-                    continue
-                if tanimoto_threshold > 0:
-                    t = math.ceil(float(count * 100) / float(cnt + src_count - count))
-                    if t <= float(tanimoto_threshold):
-                        continue
-                elif count < opt_.min_threshold:
-                    continue
-                heapq.heappush(results, (count, row_id))
-                continue
-            threshold = results[0][0]
-            if threshold < opt_.min_threshold or cnt < threshold:
-                break
-            count = score_by_id[row_id]
-            if count < threshold:
-                continue
-            heapq.heappush(results, (count, row_id))
-
-        out = []
-        while results:
-            count, row_id = heapq.heappop(results)
-            out.append((row_id, count))
-        out.reverse()
-        return out
+        return _ranked_walk(frag, opt_, pairs, score_by_id)
 
     # -- writes (reference executor.go:998-1258) -----------------------------
 
@@ -1185,6 +1261,76 @@ class Executor:
         idx.column_attrs.set_attrs(col_id, attrs)
         if self.cluster is not None and not opt.remote:
             self.cluster.forward_to_all(index, c, opt)
+
+    def close(self) -> None:
+        """Release the read pool (called from Server.close)."""
+        with self._read_pool_mu:
+            pool, self._read_pool = self._read_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+
+def _ranked_walk(frag, opt_: TopOptions, pairs, score_by_id) -> list[tuple[int, int]]:
+    """Replay fragment.top's ranked walk (reference fragment.go:870-1002)
+    with precomputed intersection counts — identical pruning, threshold,
+    tanimoto, and attr-filter behavior, so device scoring stays
+    bit-identical to the CPU path."""
+    import heapq
+    import math
+
+    n = 0 if opt_.row_ids else opt_.n
+    filters = set(opt_.filter_values) if (opt_.filter_name and opt_.filter_values) else None
+    tanimoto_threshold = 0
+    min_tanimoto = max_tanimoto = 0.0
+    src_count = 0
+    if opt_.tanimoto_threshold > 0:
+        tanimoto_threshold = opt_.tanimoto_threshold
+        src_count = opt_.src.count()
+        min_tanimoto = float(src_count * tanimoto_threshold) / 100
+        max_tanimoto = float(src_count * 100) / float(tanimoto_threshold)
+
+    results: list[tuple[int, int]] = []
+    for row_id, cnt in pairs:
+        if cnt <= 0:
+            continue
+        if tanimoto_threshold > 0:
+            if float(cnt) <= min_tanimoto or float(cnt) >= max_tanimoto:
+                continue
+        elif cnt < opt_.min_threshold:
+            continue
+        if filters is not None:
+            attr = frag.row_attr_store.attrs(row_id) if frag.row_attr_store else None
+            if not attr:
+                continue
+            value = attr.get(opt_.filter_name)
+            if value is None or value not in filters:
+                continue
+        if n == 0 or len(results) < n:
+            count = score_by_id[row_id]
+            if count == 0:
+                continue
+            if tanimoto_threshold > 0:
+                t = math.ceil(float(count * 100) / float(cnt + src_count - count))
+                if t <= float(tanimoto_threshold):
+                    continue
+            elif count < opt_.min_threshold:
+                continue
+            heapq.heappush(results, (count, row_id))
+            continue
+        threshold = results[0][0]
+        if threshold < opt_.min_threshold or cnt < threshold:
+            break
+        count = score_by_id[row_id]
+        if count < threshold:
+            continue
+        heapq.heappush(results, (count, row_id))
+
+    out = []
+    while results:
+        count, row_id = heapq.heappop(results)
+        out.append((row_id, count))
+    out.reverse()
+    return out
 
 
 def _row_from_device(words, shard: int) -> Row:

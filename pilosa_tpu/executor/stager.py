@@ -44,9 +44,14 @@ class DeviceStager:
     which also keeps BatchedScorer coalescing intact (its key is the
     staged array's identity)."""
 
-    def __init__(self, budget_bytes: int = 8 << 30, device=None) -> None:
+    def __init__(self, budget_bytes: int = 8 << 30, device=None, mesh=None) -> None:
         self.budget_bytes = budget_bytes
         self.device = device
+        # When a mesh is configured, shard-major stacks ([S, ...] arrays
+        # from *_stack) are placed split over the mesh's shard axis so
+        # the executor's SPMD kernels consume them in place — the HBM
+        # form of the reference's shards-spread-over-nodes layout.
+        self.mesh = mesh
         self._cache: OrderedDict[tuple, tuple[object, int]] = OrderedDict()
         self._bytes = 0
         self._mu = threading.Lock()
@@ -102,6 +107,22 @@ class DeviceStager:
 
     def _to_device(self, words64: np.ndarray):
         w32 = np.ascontiguousarray(words64).view("<u4")
+        return jax.device_put(w32, self.device)
+
+    def _to_device_sharded(self, words64: np.ndarray):
+        """Place a shard-major [S, ...] stack split over the mesh's
+        shard axis; falls back to single-device placement when no mesh
+        is configured (or S doesn't divide — callers pad via the
+        executor's shard plan, so that only happens off the SPMD path)."""
+        w32 = np.ascontiguousarray(words64).view("<u4")
+        if self.mesh is not None and w32.shape[0] % self.mesh.devices.size == 0:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from pilosa_tpu.parallel.spmd import SHARD_AXIS
+
+            return jax.device_put(
+                w32, NamedSharding(self.mesh, PartitionSpec(SHARD_AXIS))
+            )
         return jax.device_put(w32, self.device)
 
     # -- staging entry points --
@@ -175,10 +196,27 @@ class DeviceStager:
             for i, f in enumerate(frags):
                 if f is not None:
                     words[i] = f.row_words(row_id)
-            return self._to_device(words), words.nbytes
+            return self._to_device_sharded(words), words.nbytes
 
         return self._get_or_build(
             self._stack_key(frags, "row_stack", (row_id,)), build
+        )
+
+    def rows_stack(self, frags, row_ids_per_frag: tuple[tuple[int, ...], ...], k: int):
+        """u32[S, k, W]: per-shard candidate row matrices, row counts
+        padded to a common k (zero rows score 0 and callers index
+        results by each shard's true row_ids). The SPMD TopN scoring
+        operand."""
+
+        def build():
+            words = np.zeros((len(frags), k, SHARD_WIDTH // 64), dtype=np.uint64)
+            for i, (f, ids) in enumerate(zip(frags, row_ids_per_frag)):
+                if f is not None and ids:
+                    words[i, : len(ids)] = f.packed_rows(list(ids))
+            return self._to_device_sharded(words), words.nbytes
+
+        return self._get_or_build(
+            self._stack_key(frags, "rows_stack", (row_ids_per_frag, k)), build
         )
 
     def planes_stack(self, frags, bit_depth: int):
@@ -191,7 +229,7 @@ class DeviceStager:
             for i, f in enumerate(frags):
                 if f is not None:
                     words[i] = f.bsi_planes(bit_depth)
-            return self._to_device(words), words.nbytes
+            return self._to_device_sharded(words), words.nbytes
 
         return self._get_or_build(
             self._stack_key(frags, "planes_stack", (bit_depth,)), build
@@ -201,3 +239,7 @@ class DeviceStager:
         with self._mu:
             self._cache.clear()
             self._bytes = 0
+            # Drop in-flight trackers too: builders still publish their
+            # value to current waiters through the _InFlight object, but
+            # nothing stale survives here if one errors after clear().
+            self._inflight.clear()

@@ -11,7 +11,15 @@ from typing import Any, Optional
 
 
 class ClientError(Exception):
-    pass
+    """A failed node-to-node request.
+
+    ``transport`` is True when the node never answered (refused
+    connection, DNS, socket timeout) — liveness evidence — and False
+    for HTTP-level errors, where the node is provably alive."""
+
+    def __init__(self, msg: str, transport: bool = False) -> None:
+        super().__init__(msg)
+        self.transport = transport
 
 
 class InternalClient:
@@ -41,7 +49,7 @@ class InternalClient:
                 msg = str(e)
             raise ClientError(f"{method} {url}: {msg}") from e
         except (urllib.error.URLError, OSError) as e:
-            raise ClientError(f"{method} {url}: {e}") from e
+            raise ClientError(f"{method} {url}: {e}", transport=True) from e
         if raw:
             return data
         return json.loads(data or b"{}")

@@ -37,6 +37,12 @@ STATE_STARTING = "STARTING"
 STATE_NORMAL = "NORMAL"
 STATE_RESIZING = "RESIZING"
 
+# per-node liveness states (the reference's memberlist SWIM
+# alive/suspect/dead, gossip/gossip.go:431-494)
+NODE_READY = "READY"
+NODE_SUSPECT = "SUSPECT"
+NODE_DOWN = "DOWN"
+
 
 class ShardUnavailableError(Exception):
     """reference errShardUnavailable (executor.go:1699)."""
@@ -55,6 +61,8 @@ class Cluster:
         coordinator_uri: Optional[str] = None,
         topology_path: Optional[str] = None,
         logger=None,
+        probe_timeout: float = 2.0,
+        down_after: int = 3,
     ) -> None:
         self.node_id = node_id
         self.uri = uri
@@ -76,6 +84,11 @@ class Cluster:
         self._resize_job: Optional[dict] = None
         self._resize_abort = threading.Event()
         self._pool = ThreadPoolExecutor(max_workers=16)
+        # liveness probing (SWIM analog): consecutive probe failures per
+        # node; down_after failures → DOWN, any failure → SUSPECT
+        self.down_after = down_after
+        self._fail_counts: dict[str, int] = {}
+        self._probe_client = InternalClient(timeout=probe_timeout)
 
     # -- wiring --------------------------------------------------------------
 
@@ -164,12 +177,99 @@ class Cluster:
         if not self._joined.wait(timeout=60):
             raise TimeoutError("timed out joining cluster")
 
+    # -- liveness (reference memberlist SWIM probing + NodeStatus
+    #    push/pull, gossip/gossip.go:431-494, server.go:565-630) ------------
+
+    def probe_nodes(self) -> None:
+        """One liveness sweep: short-timeout /status probe of every peer.
+        A failure moves the node to SUSPECT; down_after consecutive
+        failures to DOWN (skipped by query planning but kept in the
+        topology — removal stays operator-initiated, reference
+        cluster.go:1629-1631). A successful probe restores READY.
+        Probes fan out through the pool so one sweep costs one probe
+        timeout, not O(dead peers) of them."""
+
+        def probe(node):
+            try:
+                self._probe_client.status(node.uri)
+                alive = True
+            except (ClientError, OSError):
+                alive = False
+            self._note_probe(node, alive)
+
+        futures = [self._pool.submit(probe, n) for n in self._other_nodes()]
+        for f in futures:
+            f.result()
+
+    def _note_probe(self, node: Node, alive: bool) -> None:
+        with self.mu:
+            # a concurrent ClusterStatus application rebuilds self.nodes
+            # from dicts — re-resolve by id so the result lands on the
+            # object the planner actually reads, not an orphaned ref
+            node = next((n for n in self.nodes if n.id == node.id), node)
+            if alive:
+                changed = node.state != NODE_READY
+                node.state = NODE_READY
+                self._fail_counts.pop(node.id, None)
+            else:
+                c = self._fail_counts.get(node.id, 0) + 1
+                self._fail_counts[node.id] = c
+                want = NODE_DOWN if c >= self.down_after else NODE_SUSPECT
+                changed = node.state != want
+                node.state = want
+        if changed:
+            if self.logger:
+                self.logger.printf("node %s -> %s", node.id, node.state)
+            # announce the state flip so every node's planner agrees;
+            # off-thread so a query-path caller never blocks on fan-out
+            if self.is_coordinator:
+                threading.Thread(target=self._broadcast_status, daemon=True).start()
+
+    def push_node_status(self) -> None:
+        """Periodic NodeStatus exchange: schema + maxShards to peers
+        (the reference's gossip push/pull payload, server.go:602-630) so
+        schema and shard-count drift heals without waiting for a write."""
+        if self.server is None:
+            return
+        holder = self.server.holder
+        self.send_async(
+            {
+                "type": "node-status",
+                "node_id": self.node_id,
+                "schema": holder.schema(),
+                "maxShards": {
+                    name: idx.max_shard() for name, idx in holder.indexes.items()
+                },
+            }
+        )
+
+    def _apply_node_status(self, msg: dict) -> None:
+        self._apply_remote_holder_state(msg)
+        # traffic from a node is liveness evidence
+        sender = next((n for n in self.nodes if n.id == msg.get("node_id")), None)
+        if sender is not None:
+            self._note_probe(sender, True)
+
+    def _apply_remote_holder_state(self, msg: dict) -> None:
+        """Merge a peer's schema + maxShards into the local holder (the
+        shared payload of ClusterStatus and NodeStatus messages)."""
+        if self.server is None:
+            return
+        if msg.get("schema"):
+            self.server.holder.apply_schema(msg["schema"])
+        for name, m in (msg.get("maxShards") or {}).items():
+            idx = self.server.holder.index(name)
+            if idx is not None:
+                idx.set_remote_max_shard(m)
+
     def receive_message(self, msg: dict) -> None:
         typ = msg.get("type")
         if typ == "node-join":
             self._handle_node_join(Node.from_dict(msg["node"]))
         elif typ == "cluster-status":
             self._apply_cluster_status(msg)
+        elif typ == "node-status":
+            self._apply_node_status(msg)
         elif typ == "resize-instruction":
             threading.Thread(
                 target=self._follow_resize_instruction, args=(msg,), daemon=True
@@ -209,13 +309,7 @@ class Cluster:
             self._sort_nodes()
             self.state = msg["state"]
             self._save_topology()
-        if self.server is not None and msg.get("schema"):
-            self.server.holder.apply_schema(msg["schema"])
-        if self.server is not None:
-            for name, m in (msg.get("maxShards") or {}).items():
-                idx = self.server.holder.index(name)
-                if idx is not None:
-                    idx.set_remote_max_shard(m)
+        self._apply_remote_holder_state(msg)
         if any(n.id == self.node_id for n in self.nodes) and self.state == STATE_NORMAL:
             self._joined.set()
 
@@ -226,10 +320,13 @@ class Cluster:
 
     def _status_message(self) -> dict:
         holder = self.server.holder if self.server else None
+        with self.mu:
+            node_dicts = [n.to_dict() for n in self.nodes]
+            state = self.state
         return {
             "type": "cluster-status",
-            "state": self.state,
-            "nodes": [n.to_dict() for n in self.nodes],
+            "state": state,
+            "nodes": node_dicts,
             "schema": holder.schema() if holder else [],
             # reference NodeStatus carries MaxShards in gossip push/pull
             # (server.go:602-630)
@@ -328,8 +425,13 @@ class Cluster:
                     v = fut.result()
                 except (ClientError, ConnectionError) as e:
                     # failover: ban the node, re-map its shards onto
-                    # replicas (reference mapReduce:1496-1509)
+                    # replicas (reference mapReduce:1496-1509). Only
+                    # transport-level failures feed the liveness tracker
+                    # — an HTTP error or slow query proves the node is
+                    # alive, just unable to serve this request.
                     banned_nodes.add(node.id)
+                    if getattr(e, "transport", isinstance(e, ConnectionError)):
+                        self._note_probe(node, False)
                     next_pending.extend(node_shards)
                     if self.logger:
                         self.logger.printf("node %s failed, re-mapping: %s", node.id, e)
@@ -340,14 +442,26 @@ class Cluster:
 
     def _shards_by_node(self, index, shards, banned: set[str]) -> list:
         """Assign each shard to its first live owner (reference
-        shardsByNode, executor.go:1444-1458)."""
+        shardsByNode, executor.go:1444-1458). Nodes marked DOWN by the
+        liveness prober are skipped up front — failover before the
+        query pays a timeout; SUSPECT nodes stay in rotation.
+
+        Raises ShardUnavailableError when ANY shard has no assignable
+        owner — a partially-assigned plan would silently return a wrong
+        aggregate as success."""
         by_id: dict[str, tuple[Node, list[int]]] = {}
         for shard in shards:
-            for node in self.shard_nodes(index, shard):
-                if node.id in banned:
-                    continue
-                by_id.setdefault(node.id, (node, []))[1].append(shard)
-                break
+            owners = self.shard_nodes(index, shard)
+            live = [n for n in owners if n.id not in banned and n.state != NODE_DOWN]
+            # all owners down → try them anyway rather than failing fast
+            # (the prober may be stale)
+            candidates = live or [n for n in owners if n.id not in banned]
+            if not candidates:
+                raise ShardUnavailableError(
+                    f"shard {index}/{shard} has no live owner"
+                )
+            node = candidates[0]
+            by_id.setdefault(node.id, (node, []))[1].append(shard)
         return list(by_id.values())
 
     def _map_local(self, shards, map_fn, reduce_fn, zero_factory=None):

@@ -158,16 +158,71 @@ def topn_batch_spmd(mesh: Mesh, k: int):
     )
 
 
-def bsi_sum_spmd(mesh: Mesh, bit_depth: int):
+def count_stack_spmd(mesh: Mesh):
+    """Global popcount of a shard-sharded word stack in one program.
+
+    words: u32[S, W] (leading dim split over the mesh) -> i32 global
+    count. This is the serving executor's batched Count terminal: the
+    bitmap subtree has already folded elementwise (sharding-preserving),
+    so the only collective is the final psum — the reference's
+    uint64-sum reduceFn (executor.go:966-996) riding ICI.
+    """
+
+    def kernel(block):  # u32[s_local, W]
+        local = jnp.sum(jax.lax.population_count(block).astype(jnp.int32))
+        return jax.lax.psum(local, SHARD_AXIS)
+
+    return jax.jit(
+        jax.shard_map(kernel, mesh=mesh, in_specs=(P(SHARD_AXIS),), out_specs=P())
+    )
+
+
+def topn_scores_spmd(mesh: Mesh):
+    """Per-shard TopN candidate scoring across the mesh in one program.
+
+    srcs: u32[S, W] (per-shard source bitmap), mats: u32[S, K, W]
+    (per-shard candidate rows) -> i32[S, K] scores replicated on every
+    device via all_gather. The host then replays the reference's ranked
+    walk per shard with these precomputed intersection counts — the
+    executor's _top_device batching, distributed: HTTP candidate
+    exchange (executor.go:563-585) becomes one ICI all_gather.
+    """
+
+    def kernel(srcs, mats):
+        # per-device: srcs u32[s_local, W], mats u32[s_local, K, W]
+        scores = jnp.sum(
+            jax.lax.population_count(
+                jnp.bitwise_and(mats, srcs[:, None, :])
+            ).astype(jnp.int32),
+            axis=-1,
+        )  # [s_local, K]
+        return jax.lax.all_gather(scores, SHARD_AXIS, axis=0, tiled=True)
+
+    return jax.jit(
+        jax.shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+def bsi_sum_spmd(mesh: Mesh, bit_depth: int, has_filter: bool = True):
     """Sum(field) over all shards: per-plane popcounts psum'd over ICI.
 
-    planes: u32[S, D+1, W], filter: u32[S, W], has_filter static.
-    Returns i32[D+1] global per-plane counts; host computes
-    Σ counts[i]<<i in exact Python ints.
+    planes: u32[S, D+1, W], filter: u32[S, W]. Returns i32[D+1] global
+    per-plane counts; host computes Σ counts[i]<<i in exact Python ints.
+    has_filter is static: an unfiltered Sum counts the planes directly
+    (the reference's fragment.sum with nil filter) rather than ANDing
+    with an all-ones mask.
     """
 
     def kernel(planes, filt):
-        block = jnp.bitwise_and(planes, filt[:, None, :])  # [s_local, D+1, W]
+        block = (
+            jnp.bitwise_and(planes, filt[:, None, :]) if has_filter else planes
+        )  # [s_local, D+1, W]
         local = jnp.sum(
             jax.lax.population_count(block).astype(jnp.int32), axis=(0, 2)
         )  # [D+1]

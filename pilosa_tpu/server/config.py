@@ -23,6 +23,14 @@ class ClusterConfig:
     replicas: int = 1
     hosts: list[str] = field(default_factory=list)
     long_query_time: float = 0.0
+    # liveness probing (reference gossip probe/suspicion tunables,
+    # gossip/gossip.go:431-494); 0 disables the probe loop
+    probe_interval: float = 2.0
+    probe_timeout: float = 2.0
+    down_after: int = 3  # consecutive probe failures → DOWN
+    # periodic NodeStatus (schema + maxShards) exchange (reference
+    # server.go:565-630); 0 disables
+    status_interval: float = 60.0
 
 
 @dataclass
@@ -35,6 +43,11 @@ class Config:
     # TPU execution
     device_policy: str = "auto"  # never | auto | always
     stager_budget_bytes: int = 8 << 30
+    # SPMD: number of local devices to mesh the shard axis over.
+    # 0/1 = single-device; >1 builds a jax.sharding.Mesh and the
+    # executor lowers multi-shard Count/Sum/TopN through ICI
+    # collectives (parallel/spmd.py); "all" = every visible device
+    mesh_devices: int | str = 0
     # cluster
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     anti_entropy_interval: float = 600.0  # reference server.go:238 (10m)
@@ -101,6 +114,9 @@ class Config:
             f'bind = "{self.bind}"',
             f"max-writes-per-request = {self.max_writes_per_request}",
             f'device-policy = "{self.device_policy}"',
+            f"mesh-devices = {self.mesh_devices!r}"
+            if isinstance(self.mesh_devices, str)
+            else f"mesh-devices = {self.mesh_devices}",
             f'metric = "{self.metric}"',
             f"anti-entropy-interval = {self.anti_entropy_interval}",
             "",
@@ -110,5 +126,9 @@ class Config:
             f"replicas = {self.cluster.replicas}",
             f"hosts = {self.cluster.hosts!r}",
             f"long-query-time = {self.cluster.long_query_time}",
+            f"probe-interval = {self.cluster.probe_interval}",
+            f"probe-timeout = {self.cluster.probe_timeout}",
+            f"down-after = {self.cluster.down_after}",
+            f"status-interval = {self.cluster.status_interval}",
         ]
         return "\n".join(lines) + "\n"

@@ -30,6 +30,11 @@ from pilosa_tpu.utils.translate import TranslateStore
 
 class Server:
     def __init__(self, config: Optional[Config] = None, cluster=None) -> None:
+        # entry point for every serving deployment: make JAX_PLATFORMS
+        # win over the image's sitecustomize backend pinning
+        from pilosa_tpu.utils.jaxplatform import honor_platform_env
+
+        honor_platform_env()
         self.config = config or Config()
         data_dir = os.path.expanduser(self.config.data_dir)
         self.logger = (
@@ -56,7 +61,10 @@ class Server:
         )
         self.translate_store = TranslateStore(os.path.join(data_dir, ".keys"))
         self.cluster = cluster
-        self.stager = DeviceStager(budget_bytes=self.config.stager_budget_bytes)
+        self.mesh = self._build_mesh()
+        self.stager = DeviceStager(
+            budget_bytes=self.config.stager_budget_bytes, mesh=self.mesh
+        )
         self.executor = Executor(
             self.holder,
             cluster=cluster,
@@ -64,6 +72,7 @@ class Server:
             device_policy=self.config.device_policy,
             translate_store=self.translate_store,
             max_writes_per_request=self.config.max_writes_per_request,
+            mesh=self.mesh,
         )
         self.api = API(self.holder, self.executor, cluster=cluster, server=self)
         self.handler = Handler(
@@ -81,6 +90,37 @@ class Server:
         self._serve_thread: Optional[threading.Thread] = None
         self.node_id: str = ""
         self._closed = threading.Event()
+
+    def _build_mesh(self):
+        """Resolve config.mesh_devices into a jax Mesh over the shard
+        axis (None = single-device execution). Accepts an int count or
+        "all"; more devices requested than visible is an error — a
+        silent clamp would hide a misconfigured slice."""
+        want = self.config.mesh_devices
+        if isinstance(want, str):
+            want = want.strip().lower()
+            if want in ("", "0", "none"):
+                return None
+            if want != "all":
+                want = int(want)
+        if want in (0, 1):
+            return None
+        if isinstance(want, int) and want < 0:
+            raise ValueError(f"mesh_devices must be >= 0, got {want}")
+        import jax
+
+        from pilosa_tpu.parallel.spmd import make_mesh
+
+        devices = jax.devices()
+        if want == "all":
+            want = len(devices)
+        if want > len(devices):
+            raise ValueError(
+                f"mesh_devices={want} but only {len(devices)} devices visible"
+            )
+        mesh = make_mesh(devices[:want])
+        self.logger.printf("SPMD mesh: %d devices over shard axis", want)
+        return mesh
 
     # -- lifecycle (reference Server.Open:312) --
 
@@ -193,11 +233,39 @@ class Server:
                 except ClientError:
                     pass
 
+        def liveness_loop():
+            # reference memberlist probing (gossip/gossip.go:431-494):
+            # mark unresponsive peers SUSPECT → DOWN so query planning
+            # fails over before paying a timeout
+            interval = self.config.cluster.probe_interval
+            if interval <= 0:
+                return
+            while not self._closed.wait(interval):
+                try:
+                    if self.cluster is not None and len(self.cluster.nodes) > 1:
+                        self.cluster.probe_nodes()
+                except Exception as e:
+                    self.logger.printf("liveness probe error: %s", e)
+
+        def node_status_loop():
+            # reference periodic NodeStatus push/pull (server.go:565-630)
+            interval = self.config.cluster.status_interval
+            if interval <= 0:
+                return
+            while not self._closed.wait(interval):
+                try:
+                    if self.cluster is not None and len(self.cluster.nodes) > 1:
+                        self.cluster.push_node_status()
+                except Exception as e:
+                    self.logger.printf("node-status push error: %s", e)
+
         for fn in (
             anti_entropy_loop,
             runtime_monitor_loop,
             diagnostics_loop,
             translate_replication_loop,
+            liveness_loop,
+            node_status_loop,
         ):
             threading.Thread(target=fn, daemon=True).start()
 
@@ -228,6 +296,8 @@ class Server:
                 coordinator=cc.coordinator,
                 topology_path=topology_path,
                 logger=self.logger,
+                probe_timeout=cc.probe_timeout,
+                down_after=cc.down_after,
             )
             cluster.set_nodes(
                 [Node(id=h if h.startswith("http") else f"http://{h}",
@@ -248,6 +318,8 @@ class Server:
             ),
             topology_path=topology_path,
             logger=self.logger,
+            probe_timeout=cc.probe_timeout,
+            down_after=cc.down_after,
         )
 
     def address(self) -> tuple[str, int]:
@@ -270,6 +342,7 @@ class Server:
             self.httpd.server_close()
         if self.cluster is not None:
             self.cluster.close()
+        self.executor.close()
         self.holder.close()
         self.translate_store.close()
 

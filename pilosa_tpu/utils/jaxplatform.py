@@ -1,0 +1,21 @@
+"""Honor the JAX_PLATFORMS env var at process entry points.
+
+The deployment image's sitecustomize force-selects the TPU backend via
+jax.config, which OVERRIDES the JAX_PLATFORMS env var. Entry points
+(server, CLI, benches) call this before first backend use so CPU-forced
+runs — tests, virtual-mesh servers, smoke drives — never depend on
+TPU-tunnel health. Deliberately NOT an import side effect of a library
+module: importers that pick a backend programmatically must not have it
+flipped under them.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_platform_env() -> None:
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
