@@ -18,6 +18,7 @@ from __future__ import annotations
 import hashlib
 import heapq
 import math
+import mmap
 import os
 import threading
 from typing import Iterable, Optional
@@ -110,11 +111,7 @@ class Fragment:
             if self._open:
                 return
             if self.path and os.path.exists(self.path):
-                with open(self.path, "rb") as f:
-                    data = f.read()
-                if data:
-                    self.storage = Bitmap.unmarshal_binary(data)
-                    self.op_n = self.storage.op_n
+                self._load_storage()
             if self.path and not os.path.exists(self.path):
                 # Initialise new files with an empty snapshot header so the
                 # trailing op log always follows a valid roaring prefix
@@ -129,6 +126,27 @@ class Fragment:
             self._open_cache()
             self._open = True
 
+    def ensure_open(self) -> "Fragment":
+        """Open on first touch (lazy holder trees open fragments in
+        O(touched), matching the reference's mmap-cheap startup)."""
+        if not self._open:
+            self.open()
+        return self
+
+    def _load_storage(self) -> None:
+        """Mmap the roaring file and parse lazily: headers become numpy
+        views over the map, payloads decode on demand, the op-log tail
+        replays into the overlay (reference openStorage,
+        fragment.go:167-224). The mmap stays alive for as long as the
+        storage references it (numpy buffer export); no explicit close."""
+        size = os.path.getsize(self.path)
+        if size == 0:
+            return
+        with open(self.path, "rb") as f:
+            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        self.storage = Bitmap.unmarshal_mmap(mm)
+        self.op_n = self.storage.op_n
+
     def close(self) -> None:
         with self.mu:
             if self._op_file:
@@ -139,24 +157,37 @@ class Fragment:
             self._open = False
 
     def _recompute_max_row_id(self) -> None:
-        keys = self.storage.sorted_keys()
-        self.max_row_id = (keys[-1] << 16) // SHARD_WIDTH if keys else 0
+        k = self.storage.max_key()
+        self.max_row_id = (k << 16) // SHARD_WIDTH if k is not None else 0
 
     def cache_path(self) -> Optional[str]:
         return self.path + ".cache" if self.path else None
 
     def _open_cache(self) -> None:
         """Restore cached row ids with a recount (reference openCache,
-        fragment.go:227-266)."""
+        fragment.go:227-266). The recount is a vectorised pass over the
+        container occupancy index — no row materialisation."""
         p = self.cache_path()
         if not p:
             return
         ids = cache_mod.read_cache(p)
         if not ids:
             return
-        for row_id in ids:
-            self.cache.bulk_add(row_id, self.row(row_id).count())
+        counts = self.row_counts_for(np.asarray(ids, dtype=np.uint64))
+        for row_id, cnt in zip(ids, counts):
+            self.cache.bulk_add(row_id, int(cnt))
         self.cache.invalidate()
+
+    def row_counts_for(self, row_ids: np.ndarray) -> np.ndarray:
+        """Per-row bit counts for many rows from container cardinalities
+        alone (each row spans SHARD_WIDTH/2^16 = 16 container keys) —
+        O(N + R log N), no payload decode."""
+        keys, ns = self.storage.keys_and_counts()
+        cs = np.concatenate(([0], np.cumsum(ns, dtype=np.int64)))
+        per_row = np.uint64(SHARD_WIDTH >> 16)
+        lo = np.searchsorted(keys, row_ids.astype(np.uint64) * per_row)
+        hi = np.searchsorted(keys, (row_ids.astype(np.uint64) + 1) * per_row)
+        return cs[hi] - cs[lo]
 
     def flush_cache(self) -> None:
         p = self.cache_path()
@@ -184,7 +215,8 @@ class Fragment:
     def row_ids(self) -> list[int]:
         """All rows with at least one bit (container key >> 4 = row id,
         since 2^20/2^16 = 16 containers per row)."""
-        return sorted({(k << 16) // SHARD_WIDTH for k in self.storage.containers})
+        keys, _ = self.storage.keys_and_counts()
+        return np.unique(keys >> np.uint64(4)).tolist()
 
     # -- bit ops -------------------------------------------------------------
 
@@ -509,11 +541,7 @@ class Fragment:
                 cols % np.uint64(SHARD_WIDTH)
             )
             positions = np.unique(positions)
-            add = Bitmap.from_sorted(positions)
-            op_writer = self.storage.op_writer
-            merged = self.storage.union(add)
-            merged.op_writer = op_writer
-            self.storage = merged
+            self.storage.merge_positions(add=positions)
             self.generation += 1
             self._row_cache.clear()
             self.checksums.clear()
@@ -558,17 +586,11 @@ class Fragment:
                 set_pos.append(base + cols_l[mask])
             nn = np.uint64(bit_depth) * sw + cols_l  # not-null plane
             set_pos.append(nn)
-            set_bm = Bitmap.from_sorted(np.unique(np.concatenate(set_pos)))
-            op_writer = self.storage.op_writer
-            if clear_pos:  # bit_depth == 0 (min == max) has no planes
-                clear_bm = Bitmap.from_sorted(
-                    np.unique(np.concatenate(clear_pos))
-                )
-                merged = self.storage.difference(clear_bm).union(set_bm)
-            else:
-                merged = self.storage.union(set_bm)
-            merged.op_writer = op_writer
-            self.storage = merged
+            set_all = np.unique(np.concatenate(set_pos))
+            clear_all = (
+                np.unique(np.concatenate(clear_pos)) if clear_pos else None
+            )  # bit_depth == 0 (min == max) has no planes
+            self.storage.merge_positions(add=set_all, remove=clear_all)
             self.generation += 1
             self._row_cache.clear()
             self.checksums.clear()
@@ -595,6 +617,12 @@ class Fragment:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
+            if self.storage.is_mmap_backed():
+                # Re-map the fresh snapshot so the overlay drains back
+                # into the frozen base (reference snapshot re-mmaps,
+                # fragment.go:1425-1468). The old map is freed when the
+                # last view into it is garbage-collected.
+                self._load_storage()
             self._op_file = open(self.path, "ab")
             self.storage.op_writer = self._op_file
             self.op_n = 0
@@ -613,7 +641,7 @@ class Fragment:
         """(block_id, checksum) for each 100-row block with any bits."""
         out: dict[int, "hashlib._Hash"] = {}
         order: list[int] = []
-        for key in self.storage.sorted_keys():
+        for key in self.storage._iter_keys_sorted():
             c = self.storage.containers[key]
             if not c.n:
                 continue

@@ -51,6 +51,11 @@ class View:
     # -- lifecycle --
 
     def open(self) -> None:
+        """Register on-disk fragments WITHOUT opening them: a holder
+        tree with thousands of fragments opens in O(touched) — each
+        fragment mmaps and parses on first access (reference keeps
+        startup cheap the same way via zero-copy mmap open,
+        fragment.go:167-224; we go one step lazier)."""
         if not self.path:
             return
         frag_dir = os.path.join(self.path, "fragments")
@@ -62,13 +67,11 @@ class View:
                 shard = int(name)
             except ValueError:
                 continue
-            frag = self._new_fragment(shard)
-            frag.open()
-            self.fragments[shard] = frag
+            self.fragments[shard] = self._new_fragment(shard)
 
     def close(self) -> None:
         for f in self.fragments.values():
-            f.close()
+            f.close()  # no-op for never-opened fragments
 
     def _fragment_path(self, shard: int) -> Optional[str]:
         if not self.path:
@@ -88,7 +91,8 @@ class View:
         )
 
     def fragment(self, shard: int) -> Optional[Fragment]:
-        return self.fragments.get(shard)
+        frag = self.fragments.get(shard)
+        return frag.ensure_open() if frag is not None else None
 
     def create_fragment_if_not_exists(self, shard: int) -> Fragment:
         with self.mu:
@@ -112,7 +116,7 @@ class View:
     def row(self, row_id: int) -> Row:
         out = Row()
         for shard in sorted(self.fragments):
-            out.merge(self.fragments[shard].row(row_id))
+            out.merge(self.fragments[shard].ensure_open().row(row_id))
         return out
 
     def set_bit(self, row_id: int, column_id: int) -> bool:
@@ -120,15 +124,13 @@ class View:
         return self.create_fragment_if_not_exists(shard).set_bit(row_id, column_id)
 
     def clear_bit(self, row_id: int, column_id: int) -> bool:
-        shard = column_id // SHARD_WIDTH
-        frag = self.fragments.get(shard)
+        frag = self.fragment(column_id // SHARD_WIDTH)
         if frag is None:
             return False
         return frag.clear_bit(row_id, column_id)
 
     def value(self, column_id: int, bit_depth: int) -> tuple[int, bool]:
-        shard = column_id // SHARD_WIDTH
-        frag = self.fragments.get(shard)
+        frag = self.fragment(column_id // SHARD_WIDTH)
         if frag is None:
             return 0, False
         return frag.value(column_id, bit_depth)

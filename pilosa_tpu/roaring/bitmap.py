@@ -356,14 +356,51 @@ class Bitmap:
     # -- bookkeeping --
 
     def _get_or_create(self, key: int) -> Container:
-        c = self.containers.get(key)
+        store = self.containers
+        mutate = getattr(store, "mutate", None)
+        c = mutate(key) if mutate is not None else store.get(key)
         if c is None:
             c = Container()
-            self.containers[key] = c
+            store[key] = c
         return c
 
     def sorted_keys(self) -> list[int]:
-        return sorted(self.containers)
+        return list(self._iter_keys_sorted())
+
+    def _iter_keys_sorted(self, lo: Optional[int] = None, hi: Optional[int] = None):
+        """Sorted key iteration over [lo, hi); O(log N + touched) on
+        range-indexed stores (mmapstore), O(N log N) on plain dicts."""
+        store = self.containers
+        f = getattr(store, "iter_keys", None)
+        if f is not None:
+            yield from f(lo, hi)
+            return
+        for k in sorted(store):
+            if lo is not None and k < lo:
+                continue
+            if hi is not None and k >= hi:
+                break
+            yield k
+
+    def max_key(self) -> Optional[int]:
+        """Largest container key, or None when empty."""
+        f = getattr(self.containers, "max_key", None)
+        if f is not None:
+            return f()
+        return max(self.containers) if self.containers else None
+
+    def keys_and_counts(self) -> tuple[np.ndarray, np.ndarray]:
+        """(sorted u64 container keys, u32 cardinalities) — the bulk
+        occupancy index used for cache recounts and sparse staging."""
+        f = getattr(self.containers, "keys_and_counts", None)
+        if f is not None:
+            return f()
+        keys = sorted(self.containers)
+        ks = np.fromiter(keys, dtype=np.uint64, count=len(keys))
+        ns = np.fromiter(
+            (self.containers[k].n for k in keys), dtype=np.uint32, count=len(keys)
+        )
+        return ks, ns
 
     # -- point ops --
 
@@ -371,12 +408,14 @@ class Bitmap:
         return self._get_or_create(highbits(v)).add(lowbits(v))
 
     def remove_no_oplog(self, v: int) -> bool:
-        c = self.containers.get(highbits(v))
+        store = self.containers
+        mutate = getattr(store, "mutate", None)
+        c = mutate(highbits(v)) if mutate is not None else store.get(highbits(v))
         if c is None:
             return False
         changed = c.remove(lowbits(v))
         if c.n == 0:
-            del self.containers[highbits(v)]
+            del store[highbits(v)]
         return changed
 
     def add(self, *values: int) -> bool:
@@ -404,6 +443,9 @@ class Bitmap:
     # -- counting --
 
     def count(self) -> int:
+        f = getattr(self.containers, "total_count", None)
+        if f is not None:
+            return f()
         return sum(c.n for c in self.containers.values())
 
     def count_range(self, start: int, end: int) -> int:
@@ -413,11 +455,7 @@ class Bitmap:
         n = 0
         hi0, lo0 = highbits(start), lowbits(start)
         hi1, lo1 = highbits(end), lowbits(end)
-        for key in self.sorted_keys():
-            if key < hi0:
-                continue
-            if key > hi1:
-                break
+        for key in self._iter_keys_sorted(hi0, hi1 + 1):
             c = self.containers[key]
             if hi0 == hi1:
                 if key == hi0:
@@ -454,10 +492,24 @@ class Bitmap:
         return np.concatenate(out)
 
     def slice_range(self, start: int, end: int) -> np.ndarray:
-        a = self.slice_all()
-        i = np.searchsorted(a, np.uint64(start), side="left")
-        j = np.searchsorted(a, np.uint64(end), side="left")
-        return a[i:j]
+        """Set positions in [start, end) — touches only in-range
+        containers (the anti-entropy block_data path on tall bitmaps)."""
+        if end <= start:
+            return np.empty(0, dtype=np.uint64)
+        hi0, hi1 = highbits(start), highbits(end - 1) + 1
+        out = []
+        for key in self._iter_keys_sorted(hi0, hi1):
+            c = self.containers[key]
+            if not c.n:
+                continue
+            p = (np.uint64(key << 16) + c.positions().astype(np.uint64))
+            if key == hi0 or key == hi1 - 1:
+                p = p[(p >= np.uint64(start)) & (p < np.uint64(end))]
+            if p.size:
+                out.append(p)
+        if not out:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(out)
 
     def for_each(self, fn: Callable[[int], None]) -> None:
         for v in self.slice_all():
@@ -568,11 +620,7 @@ class Bitmap:
             raise ValueError("offset/start/end must not contain low bits")
         off, hi0, hi1 = highbits(offset), highbits(start), highbits(end)
         out = Bitmap()
-        for key in self.sorted_keys():
-            if key < hi0:
-                continue
-            if key >= hi1:
-                break
+        for key in self._iter_keys_sorted(hi0, hi1):
             # NOTE: the reference shares the container; we share too (copy-on-
             # write discipline is the caller's job, as in the reference).
             out.containers[off + (key - hi0)] = self.containers[key]
@@ -598,9 +646,7 @@ class Bitmap:
         nwords = (end - start) // 64
         out = np.zeros(nwords, dtype=np.uint64)
         hi0, hi1 = highbits(start), highbits(end)
-        for key in self.sorted_keys():
-            if key < hi0 or key >= hi1:
-                continue
+        for key in self._iter_keys_sorted(hi0, hi1):
             c = self.containers[key]
             if c.n:
                 base = (key - hi0) * (BITMAP_N)
@@ -627,22 +673,38 @@ class Bitmap:
         for c in self.containers.values():
             c.optimize()
 
+    def _iter_serialized(self):
+        """(key, typ, n, payload-bytes) stream in key order. Mmap-backed
+        stores pass base payloads through as buffer slices (no decode)."""
+        f = getattr(self.containers, "iter_serialized", None)
+        if f is not None:
+            yield from f()
+            return
+        for k in sorted(self.containers):
+            c = self.containers[k]
+            if c.n > 0:
+                c.optimize()
+                yield k, c.typ, c.n, c.write_blob()
+
     def write_to(self, w) -> int:
         """Serialize in the reference's file format (roaring.go:543-613)."""
-        self.optimize()
-        live = [(k, c) for k in self.sorted_keys() if (c := self.containers[k]).n > 0]
-        count = len(live)
+        metas = []
+        blobs = []
+        for key, typ, cn, blob in self._iter_serialized():
+            metas.append((key, typ, cn))
+            blobs.append(blob)
+        count = len(metas)
         header = bytearray()
         header += struct.pack("<II", COOKIE, count)
-        for key, c in live:
-            header += struct.pack("<QHH", key, c.typ, c.n - 1)
+        for key, typ, cn in metas:
+            header += struct.pack("<QHH", key, typ, cn - 1)
         offset = HEADER_BASE_SIZE + count * (8 + 2 + 2 + 4)
-        for _, c in live:
+        for blob in blobs:
             header += struct.pack("<I", offset)
-            offset += c.size()
+            offset += len(blob)
         n = w.write(bytes(header))
-        for _, c in live:
-            n += w.write(c.write_blob())
+        for blob in blobs:
+            n += w.write(blob)
         return n
 
     def to_bytes(self) -> bytes:
@@ -659,6 +721,96 @@ class Bitmap:
         b = cls()
         b._unmarshal_into(data)
         return b
+
+    @classmethod
+    def unmarshal_mmap(cls, buf) -> "Bitmap":
+        """Lazy-parse the reference file format over a buffer (mmap):
+        the header becomes numpy views over the map, payloads decode on
+        demand, and the trailing op log replays into the mutation
+        overlay — the zero-copy open the reference does with
+        syscall.Mmap + UnmarshalBinary (reference fragment.go:167-224).
+        Resident memory is O(ops + touched containers)."""
+        from pilosa_tpu.roaring.mmapstore import MmapContainers
+
+        b = cls()
+        store, ops_offset = MmapContainers.parse(buf)
+        b.containers = store
+        mv = memoryview(buf)
+        off = ops_offset
+        total = len(buf)
+        while off < total:
+            op_typ, value = unmarshal_op(mv[off : off + OP_SIZE])
+            if op_typ == OP_ADD:
+                b.add_no_oplog(value)
+            else:
+                b.remove_no_oplog(value)
+            b.op_n += 1
+            off += OP_SIZE
+        return b
+
+    def is_mmap_backed(self) -> bool:
+        from pilosa_tpu.roaring.mmapstore import MmapContainers
+
+        return isinstance(self.containers, MmapContainers)
+
+    # -- bulk position merge (vectorised, O(touched containers)) -------------
+
+    def merge_positions(self, add=None, remove=None) -> None:
+        """Bulk add/remove sorted-unique u64 position arrays, applied
+        per container (removals before adds, so a position in both ends
+        set). Bypasses the op log — callers snapshot afterwards, like
+        the reference's bulkImport (fragment.go:1296-1397). Unlike a
+        whole-bitmap union/difference this touches only the containers
+        the positions land in, which is what keeps imports O(batch) on
+        mmap-backed tall fragments."""
+
+        def groups(vals):
+            if vals is None:
+                return {}
+            vals = np.asarray(vals, dtype=np.uint64)
+            if not vals.size:
+                return {}
+            keys = vals >> np.uint64(16)
+            idx = np.nonzero(np.diff(keys))[0] + 1
+            starts = np.concatenate(([0], idx))
+            ends = np.concatenate((idx, [vals.size]))
+            return {
+                int(keys[s]): (vals[s:e] & np.uint64(0xFFFF)).astype(np.uint16)
+                for s, e in zip(starts, ends)
+            }
+
+        adds = groups(add)
+        removes = groups(remove)
+        for key in sorted(adds.keys() | removes.keys()):
+            a = adds.get(key)
+            r = removes.get(key)
+            c = self.containers.get(key)
+            if c is None:
+                if a is None or not a.size:
+                    continue
+                if a.size > ARRAY_MAX_SIZE:
+                    self.containers[key] = Container.from_words(
+                        positions_to_words(a), n=int(a.size)
+                    )
+                else:
+                    self.containers[key] = Container.from_array(a)
+                continue
+            p = c.positions()
+            if r is not None and r.size and p.size:
+                i = np.searchsorted(r, p)
+                i_c = np.minimum(i, r.size - 1)
+                hit = (i < r.size) & (r[i_c] == p)
+                p = p[~hit]
+            if a is not None and a.size:
+                p = np.union1d(p, a)
+            if not p.size:
+                del self.containers[key]
+            elif p.size > ARRAY_MAX_SIZE:
+                self.containers[key] = Container.from_words(
+                    positions_to_words(p), n=int(p.size)
+                )
+            else:
+                self.containers[key] = Container.from_array(p)
 
     def _unmarshal_into(self, data: bytes) -> None:
         if len(data) < HEADER_BASE_SIZE:
