@@ -395,6 +395,41 @@ def bench_spmd(tmp, scale):
     return _report("spmd_mesh_http", len(queries), dev_qps, cpu_qps, p50, ok)
 
 
+def bench_tall_scaled(tmp, scale):
+    """Config 4's true shape (tall singleton rows + hot rows, mmap
+    store, block-sparse staging) at gauntlet scale: 4 shards x 200k
+    rows through the full bench_tall path, incl. its bit-identity
+    check. The full 1B-row run is bench.py's headline (.bench_cache)."""
+    import bench_tall
+
+    old_cache = bench_tall.CACHE_DIR
+    bench_tall.CACHE_DIR = os.path.join(tmp, "tallcfg")
+    old_env = {
+        k: os.environ.get(k)
+        for k in ("PILOSA_BENCH_TALL_SHARDS", "PILOSA_BENCH_TALL_ROWS_PER_SHARD")
+    }
+    os.environ["PILOSA_BENCH_TALL_SHARDS"] = "4"
+    os.environ["PILOSA_BENCH_TALL_ROWS_PER_SHARD"] = str(200_000 * scale)
+    try:
+        tall = bench_tall.run(deadline_s=180)
+    finally:
+        bench_tall.CACHE_DIR = old_cache
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    ok = bool(tall.get("bit_identical")) and not tall.get("error")
+    return _report(
+        "tall_scaled",
+        0,
+        tall.get("topn_qps") or 0.0,
+        tall.get("cpu_topn_qps") or 0.0,
+        tall.get("topn_p50_ms") or 0.0,
+        ok,
+    )
+
+
 def main():
     from pilosa_tpu.utils.jaxplatform import honor_platform_env
 
@@ -410,6 +445,7 @@ def main():
             bench_synthetic,
             bench_cluster,
             bench_spmd,
+            bench_tall_scaled,
         ):
             try:
                 all_ok &= bool(fn(tmp, scale))

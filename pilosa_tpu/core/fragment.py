@@ -178,16 +178,35 @@ class Fragment:
             self.cache.bulk_add(row_id, int(cnt))
         self.cache.invalidate()
 
+    def _row_key_spans(
+        self, row_ids: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """(keys, cumsum, lo, hi): each row's container-key range located
+        in ONE occupancy snapshot (row r spans keys [r*16, (r+1)*16));
+        callers must not mix arrays from separate snapshots — a mutation
+        between calls can change the index length."""
+        keys, cs = self.storage.occupancy()
+        first = row_ids.astype(np.uint64) * np.uint64(SHARD_WIDTH >> 16)
+        last = (row_ids.astype(np.uint64) + np.uint64(1)) * np.uint64(
+            SHARD_WIDTH >> 16
+        )
+        if keys.dtype != np.uint64:
+            # occupancy downcasts keys (with a 16-key margin) — clamp
+            # out-of-range rows to the dtype max; they bisect past every
+            # real key, so lo == hi and the row counts 0
+            cap = np.uint64(np.iinfo(keys.dtype).max)
+            first = np.minimum(first, cap)
+            last = np.minimum(last, cap)
+        first = first.astype(keys.dtype)
+        last = last.astype(keys.dtype)
+        return keys, cs, np.searchsorted(keys, first), np.searchsorted(keys, last)
+
     def row_counts_for(self, row_ids: np.ndarray) -> np.ndarray:
         """Per-row bit counts for many rows from container cardinalities
-        alone (each row spans SHARD_WIDTH/2^16 = 16 container keys) —
-        O(N + R log N), no payload decode."""
-        keys, ns = self.storage.keys_and_counts()
-        cs = np.concatenate(([0], np.cumsum(ns, dtype=np.int64)))
-        per_row = np.uint64(SHARD_WIDTH >> 16)
-        lo = np.searchsorted(keys, row_ids.astype(np.uint64) * per_row)
-        hi = np.searchsorted(keys, (row_ids.astype(np.uint64) + 1) * per_row)
-        return cs[hi] - cs[lo]
+        alone — O(R log N) over the cached occupancy index, no payload
+        decode."""
+        _, cs, lo, hi = self._row_key_spans(row_ids)
+        return cs[hi].astype(np.int64) - cs[lo].astype(np.int64)
 
     def flush_cache(self) -> None:
         p = self.cache_path()
@@ -510,14 +529,20 @@ class Fragment:
                 self.cache.invalidate()
                 return self.cache.top()
         pairs = []
+        missing = []
         for row_id in row_ids:
             n = self.cache.get(row_id)
             if n > 0:
                 pairs.append((row_id, n))
-                continue
-            row = self.row(row_id)
-            if row.count() > 0:
-                pairs.append((row_id, row.count()))
+            else:
+                missing.append(row_id)
+        if missing:
+            # vectorised recount from the occupancy index — same number
+            # as row(id).count() without materialising the rows
+            counts = self.row_counts_for(np.asarray(missing, dtype=np.uint64))
+            pairs += [
+                (r, int(cnt)) for r, cnt in zip(missing, counts) if cnt > 0
+            ]
         return cache_mod.sort_pairs(pairs)
 
     # -- bulk import (reference bulkImport:1296-1397) ------------------------
@@ -700,6 +725,43 @@ class Fragment:
         staging block for whole-fragment scans (TopN)."""
         ids = self.row_ids()
         return ids, self.packed_rows(ids)
+
+    def sparse_block_count(self, row_ids: list[int]) -> int:
+        """Number of nonempty container blocks across the given rows —
+        the sparse-staging cost estimate (dense cost is 16 per row)."""
+        _, _, lo, hi = self._row_key_spans(np.asarray(row_ids, dtype=np.uint64))
+        return int((hi - lo).sum())
+
+    def sparse_row_blocks(
+        self, row_ids: list[int]
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Block-sparse staging form of the given rows: only nonempty
+        2^16-bit container blocks, as (blocks u64[B, 1024],
+        block_row i32[B] — index into row_ids, block_slot i32[B] — the
+        block's position within its row). The container occupancy index
+        is the sparsity map (SURVEY.md §7 hard part 2)."""
+        from pilosa_tpu.roaring.bitmap import BITMAP_N
+
+        rids = np.asarray(row_ids, dtype=np.uint64)
+        per = 16
+        keys, _, lo, hi = self._row_key_spans(rids)
+        counts = (hi - lo).astype(np.int64)
+        B = int(counts.sum())
+        blocks = np.zeros((B, BITMAP_N), dtype=np.uint64)
+        block_row = np.repeat(np.arange(rids.size, dtype=np.int32), counts)
+        if B == 0:
+            return blocks, block_row, np.zeros(0, dtype=np.int32)
+        key_idx = np.concatenate(
+            [np.arange(l, h, dtype=np.int64) for l, h in zip(lo, hi) if h > l]
+        )
+        sel_keys = keys[key_idx]
+        block_slot = (sel_keys.astype(np.int64) % per).astype(np.int32)
+        store = self.storage.containers
+        for j, k in enumerate(sel_keys):
+            c = store.get(int(k))
+            if c is not None and c.n:
+                blocks[j] = c.words()
+        return blocks, block_row, block_slot
 
     def bsi_planes(self, bit_depth: int) -> np.ndarray:
         """uint64[bit_depth+1, 16384] plane stack (plane bit_depth = not-null)."""

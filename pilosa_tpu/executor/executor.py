@@ -118,6 +118,8 @@ class Executor:
         # coalesces concurrent TopN scoring against the same staged
         # matrix into one batched kernel launch (see batcher.py)
         self.scorer = BatchedScorer()
+        # fused count-of-tree programs keyed by query structure
+        self._tree_jits: dict[str, Any] = {}
         self._read_pool = None  # lazy; see execute()
         self._read_pool_mu = threading.Lock()
         # compiled shard_map kernels keyed by (kind, static args) — the
@@ -686,6 +688,52 @@ class Executor:
                 total += len(frag.storage.containers)
         return total >= AUTO_DEVICE_MIN_CONTAINERS
 
+    def _tree_leaves(self, index, c: Call, batch):
+        """Lower a bitmap call tree to (leaf device arrays, structure):
+        boolean nodes become structure tuples, anything else (Row /
+        Range / time-range) stages or evaluates to a leaf array."""
+        leaves: list = []
+
+        def build(call: Call):
+            if call.name in ("Intersect", "Union", "Xor", "Difference") and call.children:
+                return (call.name, tuple(build(ch) for ch in call.children))
+            arr = self._device_bitmap_stack(index, call, batch)
+            leaves.append(arr)
+            return ("leaf", len(leaves) - 1)
+
+        return leaves, build(c)
+
+    def _tree_count_jit(self, tree):
+        """Jitted popcount-of-tree, cached per tree structure (bounded
+        by distinct query shapes, like the reference's parsed-query
+        cache would be)."""
+        import jax
+
+        key = repr(tree)
+        fn = self._tree_jits.get(key)
+        if fn is None:
+
+            def eval_tree(t, leaves):
+                tag = t[0]
+                if tag == "leaf":
+                    return leaves[t[1]]
+                acc = eval_tree(t[1][0], leaves)
+                for sub in t[1][1:]:
+                    v = eval_tree(sub, leaves)
+                    if tag == "Intersect":
+                        acc = ops.and_(acc, v)
+                    elif tag == "Union":
+                        acc = ops.or_(acc, v)
+                    elif tag == "Xor":
+                        acc = ops.xor_(acc, v)
+                    else:
+                        acc = ops.andnot(acc, v)
+                return acc
+
+            fn = jax.jit(lambda *ls: ops.count_bits(eval_tree(tree, ls)))
+            self._tree_jits[key] = fn
+        return fn
+
     def _device_bitmap_stack(self, index, c: Call, shards):
         """Lower a bitmap call subtree to u32[S, W] across shards."""
         name = c.name
@@ -831,10 +879,16 @@ class Executor:
         ):
             try:
                 batch = self._shard_plan(shards)
-                words = self._device_bitmap_stack(index, child, batch)
                 if self.mesh is not None:
+                    words = self._device_bitmap_stack(index, child, batch)
                     return int(self._spmd_kernel("count")(words))
-                return int(ops.count_bits(words))
+                # One fused program per query-tree structure: boolean
+                # internal nodes trace into a single jit so the whole
+                # chain is one XLA fusion + one dispatch, instead of an
+                # eager op (= a host round-trip on tunneled chips) per
+                # tree node (SURVEY.md §7 step 4).
+                leaves, tree = self._tree_leaves(index, child, batch)
+                return int(self._tree_count_jit(tree)(*leaves))
             except _NotDeviceable:
                 pass
 
@@ -1041,14 +1095,15 @@ class Executor:
 
     def _execute_topn_shards(self, index, c: Call, shards, opt) -> list[tuple[int, int]]:
         if (
-            self.mesh is not None
-            and self._local_batchable(opt)
+            self._local_batchable(opt)
             and shards
             and len(c.children) == 1
             and self._use_device_batched(index, c, shards)
         ):
             try:
-                return sort_pairs(self._topn_shards_spmd(index, c, shards))
+                if self.mesh is not None:
+                    return sort_pairs(self._topn_shards_spmd(index, c, shards))
+                return sort_pairs(self._topn_shards_batched(index, c, shards))
             except _NotDeviceable:
                 pass
 
@@ -1057,6 +1112,55 @@ class Executor:
 
         result = self._map_reduce(index, shards, c, opt, map_fn, pairs_add, zero_factory=list)
         return sort_pairs(result or [])
+
+    def _topn_shards_batched(self, index, c: Call, shards) -> list[tuple[int, int]]:
+        """Single-device cross-shard TopN: every shard's candidate
+        scoring lands in ONE chunked kernel dispatch over the merged
+        block-sparse staging (sparse_intersection_counts_stacked) —
+        per-shard sequential launches cost a host round-trip each,
+        which at 64 shards dominates latency on tunneled chips. The
+        per-shard ranked walk replays on the host for bit-identical
+        pruning."""
+        field, _ = c.string_arg("_field")
+        n, _ = c.uint_arg("n")
+        attr_name, _ = c.string_arg("attrName")
+        row_ids, _ = c.uint_slice_arg("ids")
+        min_threshold, _ = c.uint_arg("threshold")
+        attr_values = c.args.get("attrValues") or []
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+        if tanimoto > 100:
+            raise ValueError("Tanimoto Threshold is from 1 to 100 only")
+        if tanimoto > 0:
+            # tanimoto pruning needs each shard's CPU source count
+            raise _NotDeviceable("TopN+tanimoto")
+        if min_threshold <= 0:
+            min_threshold = DEFAULT_MIN_THRESHOLD
+
+        frags = tuple(
+            self.holder.fragment(index, field, VIEW_STANDARD, s) for s in shards
+        )
+        pairs_by_shard = [
+            f._top_bitmap_pairs(row_ids) if f is not None else [] for f in frags
+        ]
+        if not any(pairs_by_shard):
+            return []
+        srcs = self._device_bitmap_stack(index, c.children[0], shards)
+        provider = _StackedLazyScores(self, frags, pairs_by_shard, srcs)
+        opt_ = TopOptions(
+            n=int(n),
+            src=None,
+            row_ids=row_ids,
+            min_threshold=min_threshold,
+            filter_name=attr_name,
+            filter_values=attr_values,
+            tanimoto_threshold=0,
+        )
+        out: list[tuple[int, int]] = []
+        for i, (frag, pairs) in enumerate(zip(frags, pairs_by_shard)):
+            if frag is None or not pairs:
+                continue
+            out = pairs_add(out, _ranked_walk(frag, opt_, pairs, provider.view(i)))
+        return out
 
     def _topn_shards_spmd(self, index, c: Call, shards) -> list[tuple[int, int]]:
         """All shards' TopN candidate scoring in ONE mesh program: the
@@ -1160,21 +1264,12 @@ class Executor:
         pairs = frag._top_bitmap_pairs(opt_.row_ids)
         if not pairs:
             return []
-        candidate_ids = tuple(p[0] for p in pairs)
         try:
             src_words = self._device_bitmap(index, c.children[0], shard)
         except _NotDeviceable:
             return frag.top(opt_)
-        # pow2-padded rows bound recompiles; trailing zero rows fall off
-        # the zip with candidate_ids below
-        mat = self.stager.rows(frag, candidate_ids, pad_pow2=True)
-        # key on the staged array identity (not frag.generation, which a
-        # concurrent import may bump between staging and here): same
-        # live array object ⇔ same snapshot, so coalesced peers can
-        # never mix matrices
-        scores = self.scorer.score((id(frag), id(mat)), mat, src_words)
-        score_by_id = dict(zip(candidate_ids, (int(s) for s in scores)))
-        return _ranked_walk(frag, opt_, pairs, score_by_id)
+        scores = _LazyScores(self, frag, pairs, src_words)
+        return _ranked_walk(frag, opt_, pairs, scores)
 
     # -- writes (reference executor.go:998-1258) -----------------------------
 
@@ -1268,6 +1363,131 @@ class Executor:
             pool, self._read_pool = self._read_pool, None
         if pool is not None:
             pool.shutdown(wait=False)
+
+
+class _StackedLazyScores:
+    """Cross-shard chunked lazy scoring: chunk k is scored for ALL
+    shards in one sparse_intersection_counts_stacked dispatch the first
+    time any shard's walk reads past chunk k-1. Chunk staging keys are
+    content-derived (the per-shard candidate id tuples), so repeated
+    queries reuse the HBM-resident blocks."""
+
+    CHUNK = 4096
+
+    def __init__(self, ex, frags, pairs_by_shard, srcs) -> None:
+        self._ex = ex
+        self._frags = frags
+        self._pairs = pairs_by_shard
+        self._srcs = srcs
+        self._scores: list[dict[int, int]] = [{} for _ in frags]
+        self._next = 0
+        self._chunks = max(
+            (len(p) + self.CHUNK - 1) // self.CHUNK for p in pairs_by_shard
+        )
+
+    def _score_next(self) -> None:
+        k = self._next
+        self._next += 1
+        lo, hi = k * self.CHUNK, (k + 1) * self.CHUNK
+        ids_by_shard = tuple(
+            tuple(p[0] for p in ps[lo:hi]) for ps in self._pairs
+        )
+        staged = self._ex.stager.sparse_rows_stacked(
+            self._frags, ids_by_shard, self.CHUNK
+        )
+        if staged is None:  # no shard contributed blocks — all score 0
+            for i, ids in enumerate(ids_by_shard):
+                self._scores[i].update((rid, 0) for rid in ids)
+            return
+        blocks, brow, bslot, bshard, num_rows = staged
+        scores = np.asarray(
+            ops.sparse_intersection_counts_stacked(
+                self._srcs, blocks, brow, bslot, bshard, num_rows
+            )
+        )
+        for i, ids in enumerate(ids_by_shard):
+            base = i * self.CHUNK
+            self._scores[i].update(
+                (rid, int(scores[base + j])) for j, rid in enumerate(ids)
+            )
+
+    def view(self, shard_index: int) -> "_ShardScoreView":
+        return _ShardScoreView(self, shard_index)
+
+
+class _ShardScoreView:
+    __slots__ = ("_p", "_i")
+
+    def __init__(self, provider: _StackedLazyScores, i: int) -> None:
+        self._p = provider
+        self._i = i
+
+    def __getitem__(self, row_id: int) -> int:
+        p = self._p
+        sc = p._scores[self._i]
+        while row_id not in sc and p._next < p._chunks:
+            p._score_next()
+        return sc[row_id]
+
+
+class _LazyScores:
+    """Chunked on-demand candidate scoring for the device TopN walk.
+
+    The walk consumes candidates in cached-count order and breaks as
+    soon as counts fall below the running threshold (reference
+    fragment.go:960-1002) — on skewed data it touches only the hot
+    head. Scoring every cache candidate eagerly therefore wastes both
+    HBM (50k candidates × 128 KB dense) and kernel time at the 1B-row
+    scale. This provider scores pow2-sized chunks of the candidate
+    list the first time the walk reads past them:
+
+      * chunk staging keys depend only on (fragment state, chunk ids),
+        so repeated queries hit the stager's HBM cache;
+      * each chunk independently picks block-sparse vs dense staging by
+        container occupancy (sparse wins below half-full);
+      * dense chunks still coalesce through the BatchedScorer.
+    """
+
+    CHUNK = 4096
+
+    def __init__(self, ex, frag, pairs, src_words) -> None:
+        self._ex = ex
+        self._frag = frag
+        self._pairs = pairs
+        self._src = src_words
+        self._scores: dict[int, int] = {}
+        self._next = 0
+
+    def _score_chunk(self) -> None:
+        # ids materialise per chunk, never as one huge tuple — on a 50k-
+        # candidate cache only the chunks the walk reaches pay anything
+        ids = tuple(
+            p[0] for p in self._pairs[self._next : self._next + self.CHUNK]
+        )
+        self._next += len(ids)
+        frag = self._frag
+        occupied = frag.sparse_block_count(list(ids))
+        if occupied * 2 < len(ids) * (SHARD_WIDTH >> 16):
+            blocks, brow, bslot, num_rows = self._ex.stager.sparse_rows(frag, ids)
+            scores = np.asarray(
+                ops.sparse_intersection_counts(
+                    self._src, blocks, brow, bslot, num_rows
+                )
+            )[: len(ids)]
+        else:
+            # pow2-padded rows bound recompiles; trailing zero rows fall
+            # off the zip below. Key on the staged array identity (not
+            # frag.generation, which a concurrent import may bump
+            # between staging and here): same live array object ⇔ same
+            # snapshot, so coalesced peers can never mix matrices.
+            mat = self._ex.stager.rows(frag, ids, pad_pow2=True)
+            scores = self._ex.scorer.score((id(frag), id(mat)), mat, self._src)
+        self._scores.update(zip(ids, (int(s) for s in scores)))
+
+    def __getitem__(self, row_id: int) -> int:
+        while row_id not in self._scores and self._next < len(self._pairs):
+            self._score_chunk()
+        return self._scores[row_id]
 
 
 def _ranked_walk(frag, opt_: TopOptions, pairs, score_by_id) -> list[tuple[int, int]]:

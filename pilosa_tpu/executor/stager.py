@@ -161,6 +161,36 @@ class DeviceStager:
 
         return self._get_or_build(self._key(frag, kind, (row_ids,)), build)
 
+    def sparse_rows(self, frag, row_ids: tuple[int, ...]):
+        """Block-sparse candidate staging for TopN scoring:
+        (blocks u32[B, 2048], block_row i32[B], block_slot i32[B],
+        num_rows) with B and the row count padded to powers of two
+        (zero blocks aimed at row 0 score 0; callers slice results to
+        len(row_ids)). The memory-scalable alternative to rows() —
+        bytes staged scale with set containers, not candidates × 128 KB
+        (SURVEY.md §7 hard part 2)."""
+        from pilosa_tpu.executor.batcher import _next_pow2
+
+        def build():
+            blocks, brow, bslot = frag.sparse_row_blocks(list(row_ids))
+            num_rows = _next_pow2(max(len(row_ids), 1))
+            b = blocks.shape[0]
+            b_pad = _next_pow2(max(b, 1))
+            if b_pad > b:
+                blocks = np.pad(blocks, ((0, b_pad - b), (0, 0)))
+                brow = np.pad(brow, (0, b_pad - b))
+                bslot = np.pad(bslot, (0, b_pad - b))
+            w32 = np.ascontiguousarray(blocks).view("<u4")
+            dev = (
+                jax.device_put(w32, self.device),
+                jax.device_put(brow, self.device),
+                jax.device_put(bslot, self.device),
+                num_rows,
+            )
+            return dev, w32.nbytes + brow.nbytes + bslot.nbytes
+
+        return self._get_or_build(self._key(frag, "sparse_rows", (row_ids,)), build)
+
     def matrix(self, frag):
         """(row_ids, u32[R, W]) for all non-empty rows."""
 
@@ -217,6 +247,59 @@ class DeviceStager:
 
         return self._get_or_build(
             self._stack_key(frags, "rows_stack", (row_ids_per_frag, k)), build
+        )
+
+    def sparse_rows_stacked(
+        self, frags, ids_by_shard: tuple[tuple[int, ...], ...], chunk: int
+    ):
+        """Merged block-sparse candidate staging for ALL shards: one
+        (blocks u32[B, 2048], global_row i32[B], slot i32[B],
+        shard i32[B], num_rows) bundle, where global_row = shard_index
+        * chunk + local candidate index. One kernel dispatch then
+        scores the whole index's chunk (ops.sparse_intersection_counts_
+        stacked). Returns None when no shard has candidates."""
+        from pilosa_tpu.executor.batcher import _next_pow2
+
+        def build():
+            all_blocks, rows, slots, shardix = [], [], [], []
+            for i, (f, ids) in enumerate(zip(frags, ids_by_shard)):
+                if f is None or not ids:
+                    continue
+                b, br, bs = f.sparse_row_blocks(list(ids))
+                if not b.shape[0]:
+                    continue
+                all_blocks.append(b)
+                rows.append(br.astype(np.int32) + np.int32(i * chunk))
+                slots.append(bs)
+                shardix.append(np.full(bs.size, i, dtype=np.int32))
+            num_rows = len(frags) * chunk
+            if not all_blocks:
+                return None, 0
+            blocks = np.concatenate(all_blocks)
+            brow = np.concatenate(rows)
+            bslot = np.concatenate(slots)
+            bshard = np.concatenate(shardix)
+            b = blocks.shape[0]
+            b_pad = _next_pow2(b)
+            if b_pad > b:
+                # zero blocks aimed at (shard 0, row 0) contribute 0
+                blocks = np.pad(blocks, ((0, b_pad - b), (0, 0)))
+                brow = np.pad(brow, (0, b_pad - b))
+                bslot = np.pad(bslot, (0, b_pad - b))
+                bshard = np.pad(bshard, (0, b_pad - b))
+            w32 = np.ascontiguousarray(blocks).view("<u4")
+            dev = (
+                jax.device_put(w32, self.device),
+                jax.device_put(brow, self.device),
+                jax.device_put(bslot, self.device),
+                jax.device_put(bshard, self.device),
+                num_rows,
+            )
+            nbytes = w32.nbytes + brow.nbytes + bslot.nbytes + bshard.nbytes
+            return dev, nbytes
+
+        return self._get_or_build(
+            self._stack_key(frags, "sparse_stack", (chunk, ids_by_shard)), build
         )
 
     def planes_stack(self, frags, bit_depth: int):

@@ -25,6 +25,9 @@ import numpy as np
 # Words per shard-row on device: 2^20 bits / 32.
 SHARD_WIDTH = 1 << 20
 WORDS_PER_ROW = SHARD_WIDTH // 32
+# Words per 2^16-bit container block: the sparse-staging granule.
+CONTAINER_WORDS = (1 << 16) // 32
+CONTAINERS_PER_ROW = SHARD_WIDTH >> 16  # 16
 
 
 def u64_to_u32(words64: np.ndarray) -> np.ndarray:
@@ -97,6 +100,53 @@ def intersection_counts_matrix(src, mat) -> jax.Array:
     """
     pc = jax.lax.population_count(jnp.bitwise_and(mat, src[None, :]))
     return jnp.sum(pc.astype(jnp.int32), axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("num_rows",))
+def sparse_intersection_counts(src, blocks, block_row, block_slot, num_rows: int):
+    """TopN scoring over block-sparse candidate rows.
+
+    Dense staging materialises every candidate row at 128 KB regardless
+    of sparsity (SURVEY.md §7 hard part 2); at the 1B-row scale most of
+    those words are zero. Here only nonempty 2^16-bit container blocks
+    are staged: ``blocks`` u32[B, 2048] with coordinate arrays
+    ``block_row`` i32[B] (candidate index) and ``block_slot`` i32[B]
+    (which of the row's 16 container positions). The kernel gathers the
+    matching src block, popcounts the AND, and segment-sums per row —
+    bit-identical to the dense matrix pass because absent blocks
+    contribute zero to an intersection.
+
+    src: u32[W]; returns i32[num_rows] (num_rows static — callers pad
+    candidate counts to powers of two to bound recompiles).
+    """
+    src_blk = src.reshape(-1, CONTAINER_WORDS)[block_slot]
+    pc = jax.lax.population_count(jnp.bitwise_and(blocks, src_blk))
+    per_block = jnp.sum(pc.astype(jnp.int32), axis=-1)
+    return jax.ops.segment_sum(per_block, block_row, num_segments=num_rows)
+
+
+@functools.partial(jax.jit, static_argnames=("num_rows",))
+def sparse_intersection_counts_stacked(
+    srcs, blocks, block_row, block_slot, block_shard, num_rows: int
+):
+    """Cross-shard TopN scoring in ONE dispatch.
+
+    Per-shard sequential kernel launches round-trip the host once per
+    shard — on a tunneled chip that is S × RTT per query. Here every
+    shard's candidate blocks are concatenated (block_shard says which
+    shard a block belongs to, block_row is a GLOBAL segment id =
+    shard_index * chunk + local candidate index) and one gather +
+    popcount + segment-sum serves the whole index — the single-device
+    analog of the reference's per-node scatter-gather collapsing into
+    one program (reference executor.go:1444-1593).
+
+    srcs: u32[S, W]; blocks: u32[B, 2048]; returns i32[num_rows].
+    """
+    per_shard = srcs.reshape(srcs.shape[0], -1, CONTAINER_WORDS)
+    src_blk = per_shard[block_shard, block_slot]
+    pc = jax.lax.population_count(jnp.bitwise_and(blocks, src_blk))
+    per_block = jnp.sum(pc.astype(jnp.int32), axis=-1)
+    return jax.ops.segment_sum(per_block, block_row, num_segments=num_rows)
 
 
 @jax.jit

@@ -1,6 +1,8 @@
 """CPU roaring-bitmap engine + reference file-format compatibility (L0)."""
 
 from .btree import BTreeContainers
+from .mmapstore import MmapContainers
+from .writer import build_fragment_file, write_roaring_file
 from .bitmap import (
     ARRAY_MAX_SIZE,
     BITMAP_N,
@@ -23,6 +25,9 @@ __all__ = [
     "ARRAY_MAX_SIZE",
     "BITMAP_N",
     "BTreeContainers",
+    "MmapContainers",
+    "build_fragment_file",
+    "write_roaring_file",
     "get_default_container_store",
     "set_default_container_store",
     "CONTAINER_ARRAY",

@@ -402,6 +402,15 @@ class Bitmap:
         )
         return ks, ns
 
+    def occupancy(self) -> tuple[np.ndarray, np.ndarray]:
+        """(sorted container keys, exclusive prefix sum of counts);
+        cached on mmap stores, computed on the fly for dict stores."""
+        f = getattr(self.containers, "occupancy", None)
+        if f is not None:
+            return f()
+        keys, ns = self.keys_and_counts()
+        return keys, np.concatenate(([0], np.cumsum(ns, dtype=np.int64)))
+
     # -- point ops --
 
     def add_no_oplog(self, v: int) -> bool:

@@ -78,6 +78,7 @@ class MmapContainers:
         "_deleted",
         "_n_new",
         "_base_n",
+        "_kc_cache",
     )
 
     def __init__(self, buf, metas: np.ndarray, offsets: np.ndarray) -> None:
@@ -88,6 +89,7 @@ class MmapContainers:
         self._deleted: set[int] = set()
         self._n_new = 0  # overlay keys not present in base
         self._base_n = int(metas.shape[0])
+        self._kc_cache: Optional[tuple[np.ndarray, np.ndarray]] = None
 
     # -- construction --------------------------------------------------------
 
@@ -227,6 +229,7 @@ class MmapContainers:
         """Like get(), but pins the container into the overlay so
         in-place mutations persist (ephemeral decodes from get() do
         not)."""
+        self._kc_cache = None  # caller is about to mutate occupancy
         c = self.overlay.get(key)
         if c is not None:
             return c
@@ -252,8 +255,10 @@ class MmapContainers:
         elif not in_base and key not in self.overlay:
             self._n_new += 1
         self.overlay[key] = c
+        self._kc_cache = None
 
     def __delitem__(self, key: int) -> None:
+        self._kc_cache = None
         had_overlay = self.overlay.pop(key, None) is not None
         in_base = self._bisect(key) >= 0
         if in_base:
@@ -333,6 +338,7 @@ class MmapContainers:
         self.overlay.clear()
         self._deleted.clear()
         self._n_new = 0
+        self._kc_cache = None
 
     # -- bulk fast paths -----------------------------------------------------
 
@@ -374,6 +380,26 @@ class MmapContainers:
                 order = np.argsort(keys, kind="stable")
                 keys, ns = keys[order], ns[order]
         return keys, ns
+
+    def occupancy(self) -> tuple[np.ndarray, np.ndarray]:
+        """(sorted container keys, exclusive-prefix-sum of cardinalities)
+        — the per-query index behind sparse staging and vectorised row
+        recounts. Cached until the next mutation, with dtypes downcast
+        to u32 when they fit: at the 1B-row scale (~15.6M containers per
+        fragment × 64 fragments) the resident cost is what decides
+        whether the north-star config fits in host RAM."""
+        if self._kc_cache is not None:
+            return self._kc_cache
+        keys, ns = self.keys_and_counts()
+        cs = np.concatenate(([0], np.cumsum(ns, dtype=np.int64)))
+        # margin of one row's key span so query-side clamping can never
+        # collide with a real key (see Fragment._row_key_spans)
+        if keys.size and int(keys[-1]) <= 0xFFFFFFFF - 16:
+            keys = keys.astype(np.uint32)
+        if cs.size and int(cs[-1]) <= 0xFFFFFFFF:
+            cs = cs.astype(np.uint32)
+        self._kc_cache = (keys, cs)
+        return self._kc_cache
 
     def max_key(self) -> Optional[int]:
         best = max(self.overlay) if self.overlay else None

@@ -1,0 +1,131 @@
+"""Block-sparse TopN staging: kernel equivalence with the dense matrix
+pass and executor-level bit-identity on tall sparse fragments (the
+1B-row regime where dense candidate staging is not a memory plan)."""
+
+import numpy as np
+
+from pilosa_tpu import SHARD_WIDTH, ops
+from pilosa_tpu.core import Holder
+from pilosa_tpu.executor import Executor
+
+
+def _sparse_fragment(tmp_path, n_rows=300, seed=31):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    fld = idx.create_field("f")
+    rng = np.random.default_rng(seed)
+    rows, cols = [], []
+    for r in range(n_rows):
+        k = int(rng.integers(1, 4))
+        rows += [r] * k
+        cols += rng.integers(0, SHARD_WIDTH, size=k).tolist()
+    # a couple of hot rows so TopN has structure + an interesting src
+    rows += [7] * 2000 + [11] * 1500
+    cols += (np.arange(2000) * 17 % SHARD_WIDTH).tolist()
+    cols += (np.arange(1500) * 29 % SHARD_WIDTH).tolist()
+    fld.import_bits(rows, cols)
+    return h
+
+
+class TestSparseKernel:
+    def test_matches_dense_scores(self, tmp_path):
+        h = _sparse_fragment(tmp_path)
+        frag = h.fragment("i", "f", "standard", 0)
+        ids = frag.row_ids()
+        blocks, brow, bslot = frag.sparse_row_blocks(ids)
+        assert blocks.shape[0] == frag.sparse_block_count(ids)
+        # src = row 7's words
+        src64 = frag.row_words(7)
+        src = np.ascontiguousarray(src64).view("<u4")
+        dense = np.ascontiguousarray(frag.packed_rows(ids)).view("<u4").reshape(
+            len(ids), -1
+        )
+        want = np.asarray(ops.intersection_counts_matrix(src, dense))
+        got = np.asarray(
+            ops.sparse_intersection_counts(
+                src,
+                np.ascontiguousarray(blocks).view("<u4"),
+                brow,
+                bslot,
+                len(ids),
+            )
+        )
+        assert np.array_equal(got, want)
+        h.close()
+
+    def test_empty_rows_score_zero(self, tmp_path):
+        h = _sparse_fragment(tmp_path, n_rows=5)
+        frag = h.fragment("i", "f", "standard", 0)
+        ids = [0, 1, 9999]  # 9999 has no bits
+        blocks, brow, bslot = frag.sparse_row_blocks(ids)
+        src = np.ascontiguousarray(frag.row_words(7)).view("<u4")
+        got = np.asarray(
+            ops.sparse_intersection_counts(
+                src, np.ascontiguousarray(blocks).view("<u4"), brow, bslot, 3
+            )
+        )
+        assert got[2] == 0
+        h.close()
+
+
+class TestSparseTopN:
+    def test_executor_bit_identity_and_path(self, tmp_path):
+        h = _sparse_fragment(tmp_path)
+        cpu = Executor(h, device_policy="never")
+        dev = Executor(h, device_policy="always")
+        q = "TopN(f, Row(f=7), n=10)"
+        want = cpu.execute("i", q)
+        got = dev.execute("i", q)
+        assert want == got
+        # the tall sparse candidate set must have taken the sparse path
+        kinds = {k[2] for k in dev.stager._cache if len(k) > 2}
+        assert "sparse_rows" in kinds
+        h.close()
+
+    def test_multishard_stacked_batched(self, tmp_path):
+        h = Holder(str(tmp_path / "ms"))
+        h.open()
+        idx = h.create_index("i")
+        fld = idx.create_field("f")
+        rng = np.random.default_rng(41)
+        rows, cols = [], []
+        for shard in range(3):
+            base = shard * SHARD_WIDTH
+            for r in range(200):
+                k = int(rng.integers(1, 4))
+                rows += [r + 100] * k
+                cols += (base + rng.integers(0, SHARD_WIDTH, size=k)).tolist()
+            rows += [7] * 900
+            cols += (base + rng.integers(0, SHARD_WIDTH, size=900)).tolist()
+        fld.import_bits(rows, cols)
+        cpu = Executor(h, device_policy="never")
+        dev = Executor(h, device_policy="always")
+        for q in ["TopN(f, Row(f=7), n=5)", "TopN(f, n=5)"]:
+            assert cpu.execute("i", q) == dev.execute("i", q), q
+        kinds = {k[1] for k in dev.stager._cache if len(k) > 1}
+        assert "sparse_stack" in kinds
+        # fused count tree: one jit per structure
+        q = "Count(Intersect(Union(Row(f=101), Row(f=102)), Row(f=7)))"
+        assert cpu.execute("i", q) == dev.execute("i", q)
+        assert len(dev._tree_jits) == 1
+        h.close()
+
+    def test_dense_fragment_keeps_dense_path(self, tmp_path):
+        h = Holder(str(tmp_path / "dense"))
+        h.open()
+        idx = h.create_index("i")
+        fld = idx.create_field("f")
+        rng = np.random.default_rng(5)
+        rows, cols = [], []
+        for r in range(8):  # few rows, each spread over many containers
+            rows += [r] * 4000
+            cols += rng.integers(0, SHARD_WIDTH, size=4000).tolist()
+        fld.import_bits(rows, cols)
+        cpu = Executor(h, device_policy="never")
+        dev = Executor(h, device_policy="always")
+        q = "TopN(f, Row(f=1), n=4)"
+        assert cpu.execute("i", q) == dev.execute("i", q)
+        kinds = {k[2] for k in dev.stager._cache if len(k) > 2}
+        assert "sparse_rows" not in kinds
+        h.close()
