@@ -258,6 +258,28 @@ def main():
         except Exception as e:  # keep the JSON line flowing
             print(f"tall bench failed: {type(e).__name__}: {e}", file=sys.stderr)
 
+    # ---- native C++ baseline (the Go-reference proxy; BASELINE_NATIVE
+    # .json is measured offline by native/baseline_topn.cpp). Quote it
+    # next to the headline so the ratio against a compiled baseline is
+    # visible, not just the Python-path one.
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BASELINE_NATIVE.json")) as f:
+            native = json.load(f)["measured"]
+        result["native_baseline"] = {
+            k: v.get("native_cpu_qps") for k, v in native.items()
+        }
+        tall_native = native.get("tall_1Bx64shards", {}).get("native_cpu_qps")
+        if tall_native and result.get("tall", {}).get("topn_qps"):
+            result["vs_native_baseline"] = round(
+                result["tall"]["topn_qps"] / tall_native, 2
+            )
+        kern_native = native.get("kernel_4096x1M", {}).get("native_cpu_qps")
+        if kern_native:
+            result["kernel_vs_native_baseline"] = round(best_qps / kern_native, 2)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"native baseline unavailable: {e}", file=sys.stderr)
+
     print(json.dumps(result))
 
 

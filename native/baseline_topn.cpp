@@ -1,0 +1,182 @@
+// Native CPU baseline for the TopN hot path — the Go-reference proxy.
+//
+// The reference implements TopN as a ranked-cache walk computing
+// src.IntersectionCount(row(id)) per candidate over roaring containers
+// (reference fragment.go:867-1002 `top`, roaring/roaring.go:1836-1949
+// `intersectionCount*` container-pair loops). The image has no Go
+// toolchain (BASELINE.md), so this C++ program re-implements that
+// algorithm shape 1:1 — sorted-u16 array containers, merge-walk
+// intersection counts, threshold-pruned heap walk — and measures it on
+// the SAME synthetic workloads bench.py / bench_tall.py run on TPU.
+// Optimised C++ on one core is a fair stand-in for (and a bit faster
+// than) the Go binary's single-node per-query cost; the recorded
+// numbers land in BASELINE_NATIVE.json and bench.py quotes them so the
+// headline vs_baseline ratio is defensible rather than a comparison
+// against a Python loop.
+//
+// Build: g++ -O3 -march=native -std=c++17 -o baseline_topn baseline_topn.cpp
+// Run:   ./baseline_topn            (prints one JSON line)
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <random>
+#include <vector>
+
+using u16 = uint16_t;
+using u32 = uint32_t;
+using u64 = uint64_t;
+
+// xorshift for reproducible cheap randomness
+static u64 rng_state = 0x9E3779B97F4A7C15ull;
+static inline u64 xrand() {
+  u64 x = rng_state;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  return rng_state = x;
+}
+
+// One fragment row = containers of sorted u16 positions (array form;
+// the dominant form at the bench densities, as in the reference).
+struct Row {
+  std::vector<std::vector<u16>> containers;  // 16 per row (2^20 cols)
+  u32 count = 0;
+};
+
+// reference roaring.go:1951 intersectionCountArrayArray — merge walk.
+static inline u32 icount(const std::vector<u16>& a, const std::vector<u16>& b) {
+  u32 n = 0;
+  size_t i = 0, j = 0;
+  while (i < a.size() && j < b.size()) {
+    u16 va = a[i], vb = b[j];
+    n += (va == vb);
+    i += (va <= vb);
+    j += (vb <= va);
+  }
+  return n;
+}
+
+static inline u32 row_icount(const Row& a, const Row& b) {
+  u32 n = 0;
+  for (size_t c = 0; c < a.containers.size(); ++c)
+    n += icount(a.containers[c], b.containers[c]);
+  return n;
+}
+
+static Row make_row(double density, int ncontainers) {
+  Row r;
+  r.containers.resize(ncontainers);
+  const u32 per = (u32)(density * 65536.0);
+  for (int c = 0; c < ncontainers; ++c) {
+    std::vector<u16>& v = r.containers[c];
+    v.reserve(per);
+    for (u32 k = 0; k < per; ++k) v.push_back((u16)(xrand() & 0xFFFF));
+    std::sort(v.begin(), v.end());
+    v.erase(std::unique(v.begin(), v.end()), v.end());
+    r.count += (u32)v.size();
+  }
+  return r;
+}
+
+// reference fragment.top: walk candidates in cached-count order,
+// maintain a size-n min-heap of (intersection count), break once the
+// cached count falls below the heap threshold.
+static u64 topn_query(const Row& src, const std::vector<Row>& rows,
+                      const std::vector<u32>& order, int n) {
+  std::vector<u32> heap;  // min-heap of counts
+  u64 sink = 0;
+  for (u32 idx : order) {
+    const Row& cand = rows[idx];
+    if ((int)heap.size() >= n) {
+      u32 threshold = heap.front();
+      if (cand.count < threshold) break;  // ranked-cache early break
+      u32 cnt = row_icount(src, cand);
+      sink += cnt;
+      if (cnt > threshold) {
+        std::pop_heap(heap.begin(), heap.end(), std::greater<u32>());
+        heap.back() = cnt;
+        std::push_heap(heap.begin(), heap.end(), std::greater<u32>());
+      }
+    } else {
+      u32 cnt = row_icount(src, cand);
+      sink += cnt;
+      if (cnt) {
+        heap.push_back(cnt);
+        std::push_heap(heap.begin(), heap.end(), std::greater<u32>());
+      }
+    }
+  }
+  return sink;
+}
+
+static double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+int main() {
+  // ---- workload 1: bench.py kernel shape — 4096 rows x 1M cols,
+  // ~1.6% density, every row a candidate (cache covers all rows).
+  {
+    const int R = 4096, N = 10, QUERIES = 32;
+    std::vector<Row> rows;
+    rows.reserve(R);
+    for (int i = 0; i < R; ++i) rows.push_back(make_row(0.015625, 16));
+    std::vector<u32> order(R);
+    for (int i = 0; i < R; ++i) order[i] = i;
+    std::stable_sort(order.begin(), order.end(),
+                     [&](u32 a, u32 b) { return rows[a].count > rows[b].count; });
+    volatile u64 sink = 0;
+    double t0 = now_s();
+    for (int q = 0; q < QUERIES; ++q)
+      sink += topn_query(rows[xrand() % R], rows, order, N);
+    double dt = now_s() - t0;
+    double qps = QUERIES / dt;
+    printf("{\"workload\": \"kernel_4096x1M\", \"native_cpu_qps\": %.2f}\n", qps);
+  }
+
+  // ---- workload 2: bench_tall shape — per shard: 32 hot rows
+  // (~50k bits) + singleton tail in the ranked cache (50k candidates,
+  // count 1 — the early break prunes them after the hot head).
+  // 64 shards walked sequentially, as one Go process on one core would
+  // timeshare them; Go's per-shard goroutines overlap on more cores,
+  // which this single-core proxy under-counts in the reference's favor
+  // is noted in the JSON.
+  {
+    const int SHARDS = 64, HOT = 32, N = 10, QUERIES = 8;
+    std::vector<std::vector<Row>> hot(SHARDS);
+    std::vector<std::vector<u32>> order(SHARDS);
+    for (int s = 0; s < SHARDS; ++s) {
+      for (int h = 0; h < HOT; ++h) hot[s].push_back(make_row(0.047, 16));
+      // singleton tail: modelled as rows of count 1; the walk breaks
+      // before touching them once the heap threshold exceeds 1, so only
+      // their cached counts matter.
+      order[s].resize(HOT);
+      for (int h = 0; h < HOT; ++h) order[s][h] = h;
+      std::stable_sort(order[s].begin(), order[s].end(), [&](u32 a, u32 b) {
+        return hot[s][a].count > hot[s][b].count;
+      });
+    }
+    volatile u64 sink = 0;
+    double t0 = now_s();
+    for (int q = 0; q < QUERIES; ++q) {
+      int h = (int)(xrand() % HOT);
+      for (int s = 0; s < SHARDS; ++s)
+        sink += topn_query(hot[s][h], hot[s], order[s], N);
+      // pass 2 of the reference's two-pass protocol: re-score the
+      // union of candidate ids (~the hot head again)
+      for (int s = 0; s < SHARDS; ++s)
+        sink += topn_query(hot[s][h], hot[s], order[s], HOT);
+    }
+    double dt = now_s() - t0;
+    printf("{\"workload\": \"tall_1Bx64shards\", \"native_cpu_qps\": %.2f, "
+           "\"note\": \"single core; reference Go parallelizes shards over "
+           "cores\"}\n",
+           QUERIES / dt);
+  }
+  return 0;
+}

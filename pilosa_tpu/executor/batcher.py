@@ -80,6 +80,16 @@ class BatchedScorer:
         with self._lock:
             self._pending.setdefault(key, []).append(slot)
             dlock = self._dispatch_locks.setdefault(key[0], threading.Lock())
+            # prune: keys are id(frag) values, which Python recycles, so
+            # this dict would otherwise grow with fragment churn. Keep
+            # locks with pending work (plus ours); dropping an idle lock
+            # is safe — two dispatchers on one fragment drain disjoint
+            # batches, costing only a missed coalesce.
+            if len(self._dispatch_locks) > 512:
+                live = {k[0] for k in self._pending} | {key[0]}
+                self._dispatch_locks = {
+                    f: lk for f, lk in self._dispatch_locks.items() if f in live
+                }
         with dlock:
             if slot.event.is_set():  # a peer's dispatch covered us
                 return slot.finish()

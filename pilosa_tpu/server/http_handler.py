@@ -23,6 +23,19 @@ from pilosa_tpu.utils import publicproto
 from pilosa_tpu.utils.stats import NOP_STATS
 
 
+def _decode_proto(fn, body: Optional[bytes]):
+    """Protobuf request decode with 400-on-malformed semantics: a
+    clipped or corrupt wire body must never execute partially (the
+    reference's gogo-proto unmarshal errors map to http 400,
+    http/handler.go marshalling errors)."""
+    try:
+        return fn(body or b"")
+    except (ValueError, TypeError, AttributeError, UnicodeDecodeError) as e:
+        # TypeError/AttributeError cover wire-type confusion (e.g. the
+        # query field sent as a varint): still malformed input, still 400
+        raise APIError(f"unmarshalling: {e}", status=400)
+
+
 def encode_result(r: Any) -> Any:
     """Query result → JSON shape (reference QueryResponse encoding)."""
     if isinstance(r, Row):
@@ -137,7 +150,7 @@ class Handler:
         # protobuf content negotiation (reference handlePostQuery:406 +
         # internal/public.proto QueryRequest)
         if req.is_proto:
-            pbreq = publicproto.decode_query_request(req.body or b"")
+            pbreq = _decode_proto(publicproto.decode_query_request, req.body)
             body = pbreq["query"]
             shards = pbreq["shards"]
             remote = pbreq["remote"]
@@ -210,7 +223,7 @@ class Handler:
 
     def post_import(self, req) -> dict:
         if req.is_proto:
-            body = publicproto.decode_import_request(req.body or b"")
+            body = _decode_proto(publicproto.decode_import_request, req.body)
             # reference wire timestamps are unix-nanoseconds
             # (Go time.Unix(0, ts)); the API layer expects seconds
             if body.get("timestamps"):
@@ -247,7 +260,7 @@ class Handler:
 
     def post_import_value(self, req) -> dict:
         if req.is_proto:
-            body = publicproto.decode_import_value_request(req.body or b"")
+            body = _decode_proto(publicproto.decode_import_value_request, req.body)
         else:
             body = json.loads(req.body or b"{}")
         if body.get("local"):
@@ -479,18 +492,27 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
             self.wfile.write(payload)
 
         def _error_payload(self, msg: str):
-            # protobuf clients get a QueryResponse{Err} they can
-            # unmarshal (reference http/error.go); a client that sent
-            # protobuf without an Accept header expects protobuf back,
-            # matching the success path's accepts_proto-or-is_proto
+            # Only the query route speaks protobuf errors: clients
+            # unmarshal a QueryResponse{Err} there (reference
+            # http/error.go). Import/admin routes get plain text, like
+            # the reference's http.Error calls (handlePostImport etc.)
+            # — a proto ImportResponse has no error field to carry msg.
+            # exactly the /index/{index}/query route shape — a FIELD
+            # named "query" (/index/i/field/query) must not match
+            parts = [p for p in urlparse(self.path).path.split("/") if p]
+            is_query = (
+                len(parts) == 3 and parts[0] == "index" and parts[2] == "query"
+            )
             wants_proto = publicproto.CONTENT_TYPE in (
                 self.headers.get("Accept") or ""
             ) or publicproto.CONTENT_TYPE in (self.headers.get("Content-Type") or "")
-            if wants_proto:
+            if is_query and wants_proto:
                 return (
                     publicproto.encode_query_response([], err=msg),
                     publicproto.CONTENT_TYPE,
                 )
+            if wants_proto:
+                return (msg + "\n").encode(), "text/plain; charset=utf-8"
             return json.dumps({"error": msg}).encode(), "application/json"
 
         def do_GET(self):

@@ -45,12 +45,20 @@ def _decode_fields(data: bytes) -> dict[int, object]:
             out[field_no] = v
         elif wire == 2:  # length-delimited
             ln, i = _read_varint(data, i)
+            if i + ln > len(data):
+                # a partially-written .meta must fail loudly, not decode
+                # to silently-truncated bytes / default field options
+                raise ValueError("length-delimited field overruns buffer")
             out[field_no] = data[i : i + ln]
             i += ln
         elif wire == 1:  # 64-bit
+            if i + 8 > len(data):
+                raise ValueError("fixed64 field overruns buffer")
             out[field_no] = int.from_bytes(data[i : i + 8], "little")
             i += 8
         elif wire == 5:  # 32-bit
+            if i + 4 > len(data):
+                raise ValueError("fixed32 field overruns buffer")
             out[field_no] = int.from_bytes(data[i : i + 4], "little")
             i += 4
         else:

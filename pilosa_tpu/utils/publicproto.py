@@ -58,12 +58,24 @@ def _decode_multi(data: bytes) -> dict[int, list]:
             v, i = _read_varint(data, i)
         elif wire == 2:
             ln, i = _read_varint(data, i)
+            if i + ln > len(data):
+                # a clipped length-delimited field must fail loudly, not
+                # silently execute a truncated request (the handler maps
+                # this to a 400)
+                raise ValueError(
+                    f"length-delimited field overruns buffer: "
+                    f"need {ln} bytes at {i}, have {len(data) - i}"
+                )
             v = data[i : i + ln]
             i += ln
         elif wire == 1:
+            if i + 8 > len(data):
+                raise ValueError("fixed64 field overruns buffer")
             v = int.from_bytes(data[i : i + 8], "little")
             i += 8
         elif wire == 5:
+            if i + 4 > len(data):
+                raise ValueError("fixed32 field overruns buffer")
             v = int.from_bytes(data[i : i + 4], "little")
             i += 4
         else:

@@ -268,3 +268,60 @@ def test_fragment_data_roundtrip(server):
     assert st == 200
     st, body = req(server, "POST", "/index/i/query", b"Row(g=1)")
     assert body["results"][0]["columns"] == [1, 2]
+
+
+def test_malformed_protobuf_is_400_not_executed(server):
+    """A clipped length-delimited field must 400, not silently execute a
+    truncated request (advisor finding: publicproto._decode_multi)."""
+    from pilosa_tpu.utils import publicproto
+
+    req(server, "POST", "/index/mp", body=b"")
+    req(server, "POST", "/index/mp/field/f", body=b"")
+    good = publicproto.encode_import_request(
+        "mp", "f", 0, row_ids=[1, 2], column_ids=[10, 20], timestamps=None
+    )
+    clipped = good[:-3]
+    url = server.uri + "/index/mp/field/f/import"
+    r = urllib.request.Request(
+        url,
+        data=clipped,
+        method="POST",
+        headers={"Content-Type": publicproto.CONTENT_TYPE},
+    )
+    try:
+        with urllib.request.urlopen(r) as resp:
+            status, payload, ctype = resp.status, resp.read(), resp.headers.get("Content-Type")
+    except urllib.error.HTTPError as e:
+        status, payload, ctype = e.code, e.read(), e.headers.get("Content-Type")
+    assert status == 400
+    # import routes answer errors in plain text (reference http.Error),
+    # not a protobuf QueryResponse
+    assert ctype.startswith("text/plain")
+    assert b"unmarshalling" in payload
+    # nothing was imported
+    st, body = req(server, "POST", "/index/mp/query", body=b"Count(Row(f=1))")
+    assert st == 200 and body["results"] == [0]
+
+
+def test_query_route_protobuf_error_payload(server):
+    """The query route DOES answer protobuf clients with
+    QueryResponse{Err} (reference http/error.go)."""
+    from pilosa_tpu.utils import publicproto
+
+    req(server, "POST", "/index/qe", body=b"")
+    url = server.uri + "/index/qe/query"
+    bad = publicproto.encode_query_request("ThisIsNotPQL((", shards=None)
+    r = urllib.request.Request(
+        url,
+        data=bad,
+        method="POST",
+        headers={"Content-Type": publicproto.CONTENT_TYPE},
+    )
+    try:
+        with urllib.request.urlopen(r) as resp:
+            payload, ctype = resp.read(), resp.headers.get("Content-Type")
+    except urllib.error.HTTPError as e:
+        payload, ctype = e.read(), e.headers.get("Content-Type")
+    assert ctype == publicproto.CONTENT_TYPE
+    decoded = publicproto.decode_query_response(payload)
+    assert decoded["error"]
