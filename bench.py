@@ -277,8 +277,8 @@ def main():
         kern_native = native.get("kernel_4096x1M", {}).get("native_cpu_qps")
         if kern_native:
             result["kernel_vs_native_baseline"] = round(best_qps / kern_native, 2)
-    except (OSError, ValueError, KeyError) as e:
-        print(f"native baseline unavailable: {e}", file=sys.stderr)
+    except Exception as e:  # any malformed baseline file — keep the JSON flowing
+        print(f"native baseline unavailable: {type(e).__name__}: {e}", file=sys.stderr)
 
     print(json.dumps(result))
 

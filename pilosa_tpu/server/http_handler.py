@@ -497,8 +497,9 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
             # http/error.go). Import/admin routes get plain text, like
             # the reference's http.Error calls (handlePostImport etc.)
             # — a proto ImportResponse has no error field to carry msg.
-            # exactly the /index/{index}/query route shape — a FIELD
-            # named "query" (/index/i/field/query) must not match
+            # The check below matches exactly the /index/{index}/query
+            # route shape — a FIELD named "query"
+            # (/index/i/field/query) must not match.
             parts = [p for p in urlparse(self.path).path.split("/") if p]
             is_query = (
                 len(parts) == 3 and parts[0] == "index" and parts[2] == "query"
