@@ -60,6 +60,10 @@ class RankCache:
     def get(self, id_: int) -> int:
         return self.entries.get(id_, 0)
 
+    def remove(self, id_: int) -> None:
+        if self.entries.pop(id_, None) is not None:
+            self.rankings = [p for p in self.rankings if p[0] != id_]
+
     def __len__(self) -> int:
         return len(self.entries)
 
@@ -119,6 +123,9 @@ class LRUCache:
         self._lru.move_to_end(id_)
         return n
 
+    def remove(self, id_: int) -> None:
+        self._lru.pop(id_, None)
+
     def __len__(self) -> int:
         return len(self._lru)
 
@@ -148,6 +155,9 @@ class NopCache:
 
     def get(self, id_: int) -> int:
         return 0
+
+    def remove(self, id_: int) -> None:
+        pass
 
     def __len__(self) -> int:
         return 0

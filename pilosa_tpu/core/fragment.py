@@ -704,6 +704,23 @@ class Fragment:
             self._row_cache.clear()
             self.checksums.clear()
             self._recompute_max_row_id()
+            # recount touched rows so the TopN cache tracks the merged
+            # state (the reference's write paths recount via cache.Add)
+            touched = {int(r) for r in rows}
+            if clear_rows is not None:
+                touched.update(int(r) for r in clear_rows)
+            if touched:
+                counts = self.row_counts_for(
+                    np.fromiter(touched, dtype=np.uint64, count=len(touched))
+                )
+                for row_id, cnt in zip(touched, counts):
+                    if cnt > 0:
+                        self.cache.bulk_add(row_id, int(cnt))
+                    else:
+                        # bulk_add's threshold guard would keep the old
+                        # count; a row the merge emptied must drop out
+                        self.cache.remove(row_id)
+                self.cache.invalidate()
 
     # -- packed-word export for device staging -------------------------------
 

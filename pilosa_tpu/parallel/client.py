@@ -120,14 +120,46 @@ class InternalClient:
 
     # -- fragment sync (reference FragmentBlocks/BlockData:637,682) --
 
-    def fragment_blocks(self, uri: str, index: str, field: str, shard: int) -> list[dict]:
+    def fragment_blocks(
+        self, uri: str, index: str, field: str, shard: int, view: str = "standard"
+    ) -> list[dict]:
         resp = self._request(
             "GET",
             uri,
             "/internal/fragment/blocks",
-            query={"index": index, "field": field, "shard": shard},
+            query={"index": index, "field": field, "shard": shard, "view": view},
         )
         return resp.get("blocks", [])
+
+    def send_block_fixes(
+        self,
+        uri: str,
+        index: str,
+        field: str,
+        view: str,
+        shard: int,
+        set_pairs,
+        clear_pairs,
+    ) -> None:
+        """Push a consensus block merge to a replica — reaches every
+        view, unlike Set/Clear PQL (see api.apply_block_fixes)."""
+        self._request(
+            "POST",
+            uri,
+            "/internal/fragment/block/data",
+            body=json.dumps(
+                {
+                    "index": index,
+                    "field": field,
+                    "view": view,
+                    "shard": shard,
+                    "rows": [int(p[0]) for p in set_pairs],
+                    "columns": [int(p[1]) for p in set_pairs],
+                    "clearRows": [int(p[0]) for p in clear_pairs],
+                    "clearColumns": [int(p[1]) for p in clear_pairs],
+                }
+            ).encode(),
+        )
 
     def block_data(
         self, uri: str, index: str, field: str, view: str, shard: int, block: int

@@ -16,10 +16,12 @@ hash + ring replicas) so resizes move the same minimal fragment set.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
@@ -36,6 +38,38 @@ from pilosa_tpu.parallel.wire import (
 STATE_STARTING = "STARTING"
 STATE_NORMAL = "NORMAL"
 STATE_RESIZING = "RESIZING"
+
+
+class ResizeJob:
+    """Resumable background resize job (reference resizeJob,
+    cluster.go:1309-1423): tracks per-node instruction completion and
+    exposes a state machine (RUNNING → DONE | ABORTED | FAILED) instead
+    of blocking the coordinator's message handler on an Event.wait."""
+
+    _ids = itertools.count(1)
+
+    RUNNING = "RUNNING"
+    DONE = "DONE"
+    ABORTED = "ABORTED"
+    FAILED = "FAILED"
+
+    def __init__(self, action: str, new_nodes: list, pending: set) -> None:
+        self.id = next(self._ids)
+        self.action = action
+        self.new_nodes = new_nodes
+        self.pending = pending
+        self.state = self.RUNNING
+        self.done = threading.Event()
+        self.error: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "action": self.action,
+            "state": self.state,
+            "pendingNodes": sorted(self.pending),
+            "error": self.error,
+        }
 
 # per-node liveness states (the reference's memberlist SWIM
 # alive/suspect/dead, gossip/gossip.go:431-494)
@@ -80,9 +114,13 @@ class Cluster:
         self.server = None  # attached Server (broadcaster target)
         self.mu = threading.RLock()
         self._joined = threading.Event()
-        self._resize_lock = threading.Lock()
-        self._resize_job: Optional[dict] = None
+        self._resize_job: Optional[ResizeJob] = None
+        # serial queue of deferred (add_node, remove_node) actions — the
+        # reference processes joins one at a time through the
+        # listenForJoins channel (cluster.go:1025, ±1 node per job)
+        self._resize_queue: deque = deque()
         self._resize_abort = threading.Event()
+        self.resize_timeout = 120.0
         self._pool = ThreadPoolExecutor(max_workers=16)
         # liveness probing (SWIM analog): consecutive probe failures per
         # node; down_after failures → DOWN, any failure → SUSPECT
@@ -561,15 +599,47 @@ class Cluster:
     def resize_abort(self) -> None:
         self._resize_abort.set()
         with self.mu:
+            # the operator is stopping the resize PROCESS: queued
+            # follow-up actions must not restart it behind their back
+            self._resize_queue.clear()
+            job = self._resize_job
+            if job is not None and job.state == ResizeJob.RUNNING:
+                job.state = ResizeJob.ABORTED
+                job.done.set()
             if self.state == STATE_RESIZING:
                 self.state = STATE_NORMAL
         self._broadcast_status()
 
     def _start_resize(self, add_node: Optional[Node] = None, remove_node: Optional[Node] = None) -> None:
         """Coordinator: compute fragment movements between the old and
-        new cluster shapes and drive the job (reference
-        generateResizeJob / fragSources)."""
-        with self._resize_lock:
+        new cluster shapes and launch a background ResizeJob (reference
+        generateResizeJob / fragSources / resizeJob.run). Returns
+        immediately — the message handler never blocks; a concurrent
+        action queues and runs after the active job, like the
+        reference's serial listenForJoins channel."""
+        with self.mu:
+            if self._resize_job is not None and self._resize_job.state == ResizeJob.RUNNING:
+                # dedupe: a joiner may resend node-join while its add is
+                # still in flight — a double-add would corrupt hashing
+                queued = any(
+                    (a is not None and add_node is not None and a.id == add_node.id)
+                    or (
+                        r is not None
+                        and remove_node is not None
+                        and r.id == remove_node.id
+                    )
+                    for a, r in self._resize_queue
+                )
+                if not queued:
+                    self._resize_queue.append((add_node, remove_node))
+                return
+            # re-validate (a queued action may be stale by the time it runs)
+            if add_node is not None and any(n.id == add_node.id for n in self.nodes):
+                return
+            if remove_node is not None and not any(
+                n.id == remove_node.id for n in self.nodes
+            ):
+                return
             self._resize_abort.clear()
             old_nodes = list(self.nodes)
             new_nodes = list(self.nodes)
@@ -578,38 +648,64 @@ class Cluster:
             if remove_node is not None:
                 new_nodes = [n for n in new_nodes if n.id != remove_node.id]
             new_nodes.sort(key=lambda n: n.id)
+            job = ResizeJob(
+                "remove" if remove_node is not None else "add",
+                new_nodes,
+                {n.id for n in new_nodes},
+            )
+            self._resize_job = job
+            self.state = STATE_RESIZING
+        self.send_async(self._status_message())
 
-            with self.mu:
-                self.state = STATE_RESIZING
-            self.send_async(self._status_message())
-
-            sources = self._frag_sources(old_nodes, new_nodes)
-            schema = self.server.holder.schema() if self.server else []
-
-            # instructions per receiving node
-            self._resize_job = {
-                "pending": {n.id for n in new_nodes},
-                "new_nodes": new_nodes,
-                "done": threading.Event(),
+        sources = self._frag_sources(old_nodes, new_nodes)
+        schema = self.server.holder.schema() if self.server else []
+        for node in new_nodes:
+            instr = {
+                "type": "resize-instruction",
+                "job": job.id,
+                "coordinator": self.uri,
+                "schema": schema,
+                "sources": sources.get(node.id, []),
+                "node": node.to_dict(),
+                "new_nodes": [n.to_dict() for n in new_nodes],
             }
-            for node in new_nodes:
-                instr = {
-                    "type": "resize-instruction",
-                    "coordinator": self.uri,
-                    "schema": schema,
-                    "sources": sources.get(node.id, []),
-                    "node": node.to_dict(),
-                    "new_nodes": [n.to_dict() for n in new_nodes],
-                }
+            try:
                 self.send_to(node, instr)
+            except Exception as e:  # unreachable node: job times out / aborts
+                if self.logger:
+                    self.logger.printf(
+                        "resize instruction to %s failed: %s", node.id, e
+                    )
+        threading.Thread(
+            target=self._await_resize_job, args=(job,), daemon=True
+        ).start()
 
-            if not self._resize_job["done"].wait(timeout=120):
-                if not self._resize_abort.is_set():
-                    raise TimeoutError("resize did not complete")
+    def _await_resize_job(self, job: ResizeJob) -> None:
+        """Background completion driver: finalize on success, roll the
+        cluster back to NORMAL on abort/timeout, then start the next
+        queued action."""
+        completed = job.done.wait(timeout=self.resize_timeout)
+        try:
+            if job.state == ResizeJob.ABORTED or self._resize_abort.is_set():
+                job.state = ResizeJob.ABORTED
+                with self.mu:
+                    if self.state == STATE_RESIZING:
+                        self.state = STATE_NORMAL
+                self._broadcast_status()
                 return
-
+            if not completed or job.state == ResizeJob.FAILED:
+                job.state = ResizeJob.FAILED
+                if job.error is None:
+                    job.error = f"resize timed out after {self.resize_timeout:.0f}s"
+                if self.logger:
+                    self.logger.printf("resize job %d failed: %s", job.id, job.error)
+                with self.mu:
+                    self.state = STATE_NORMAL
+                self._broadcast_status()
+                return
+            job.state = ResizeJob.DONE
             with self.mu:
-                self.nodes = new_nodes
+                self.nodes = job.new_nodes
                 self._sort_nodes()
                 self.state = STATE_NORMAL
                 self._save_topology()
@@ -617,6 +713,17 @@ class Cluster:
             # every node drops fragments it no longer owns
             self.send_async({"type": "holder-clean"})
             self._holder_clean()
+        finally:
+            next_action = None
+            with self.mu:
+                if self._resize_queue:
+                    next_action = self._resize_queue.popleft()
+            if next_action is not None:
+                self._start_resize(*next_action)
+
+    def resize_job_status(self) -> Optional[dict]:
+        job = self._resize_job
+        return job.to_dict() if job is not None else None
 
     def _frag_sources(self, old_nodes: list[Node], new_nodes: list[Node]) -> dict:
         """node_id -> [{index, field, view, shard, from_uri}] for each
@@ -632,27 +739,30 @@ class Cluster:
             rep = min(self.replica_n, n)
             return [nodes[(idx + i) % n] for i in range(rep)]
 
+        # Balance streaming load over source replicas: cycle through each
+        # fragment's old owners instead of always hammering the first one
+        # (reference fragSources spreads sources the same way,
+        # cluster.go:689-773).
+        rr = itertools.count()
         for iname, idx in holder.indexes.items():
             for fname, fld in idx.fields.items():
                 for vname, view in fld.views.items():
                     for shard in view.fragments:
-                        old_owner_ids = {n.id for n in owners(old_nodes, iname, shard)}
-                        old_uris = {
-                            n.id: n.uri for n in old_nodes if n.id in old_owner_ids
-                        }
+                        old_owners = owners(old_nodes, iname, shard)
+                        old_owner_ids = {n.id for n in old_owners}
                         for node in owners(new_nodes, iname, shard):
                             if node.id in old_owner_ids:
                                 continue
-                            src_uri = next(iter(old_uris.values()), None)
-                            if src_uri is None:
+                            if not old_owners:
                                 continue
+                            src = old_owners[next(rr) % len(old_owners)]
                             out.setdefault(node.id, []).append(
                                 {
                                     "index": iname,
                                     "field": fname,
                                     "view": vname,
                                     "shard": shard,
-                                    "from_uri": src_uri,
+                                    "from_uri": src.uri,
                                 }
                             )
         return out
@@ -673,6 +783,7 @@ class Cluster:
                 )
             complete = {
                 "type": "resize-complete",
+                "job": msg.get("job"),
                 "node_id": self.node_id,
                 "ok": True,
             }
@@ -684,14 +795,36 @@ class Cluster:
         except Exception as e:  # report failure to coordinator
             if self.logger:
                 self.logger.printf("resize instruction failed: %s", e)
+            fail = {
+                "type": "resize-complete",
+                "job": msg.get("job"),
+                "node_id": self.node_id,
+                "ok": False,
+                "error": str(e),
+            }
+            coord_uri = msg.get("coordinator")
+            try:
+                if coord_uri == self.uri:
+                    self._mark_resize_complete(fail)
+                else:
+                    self.client.send_message(coord_uri, fail)
+            except ClientError:
+                pass  # coordinator times the job out instead
 
     def _mark_resize_complete(self, msg: dict) -> None:
         job = self._resize_job
-        if job is None:
+        if job is None or job.state != ResizeJob.RUNNING:
             return
-        job["pending"].discard(msg["node_id"])
-        if not job["pending"]:
-            job["done"].set()
+        if msg.get("job") is not None and msg["job"] != job.id:
+            return  # straggler from a previous (timed-out/aborted) job
+        if not msg.get("ok", True):
+            job.state = ResizeJob.FAILED
+            job.error = msg.get("error") or f"node {msg.get('node_id')} failed"
+            job.done.set()
+            return
+        job.pending.discard(msg["node_id"])
+        if not job.pending:
+            job.done.set()
 
     def _holder_clean(self) -> None:
         """Remove fragments this node no longer owns (reference
@@ -758,7 +891,8 @@ class Cluster:
                         remotes = [n for n in nodes if n.id != self.node_id]
                         if remotes:
                             self._sync_fragment(
-                                iname, fname, vname, shard, frag, remotes
+                                iname, fname, vname, shard,
+                                frag.ensure_open(), remotes,
                             )
 
     def _sync_fragment(self, index, field, view, shard, frag, remotes) -> None:
@@ -768,7 +902,9 @@ class Cluster:
         remote_blocks = {}
         for node in remotes:
             try:
-                blocks = self.client.fragment_blocks(node.uri, index, field, shard)
+                blocks = self.client.fragment_blocks(
+                    node.uri, index, field, shard, view=view
+                )
                 remote_blocks[node.id] = {
                     b["id"]: bytes.fromhex(b["checksum"]) for b in blocks
                 }
@@ -784,10 +920,11 @@ class Cluster:
                     diff_ids.add(bid)
         for bid in sorted(diff_ids):
             # Gather (row, col) sets from every replica incl. self.
-            sets = []
+            # peer_sets keeps (node, set) PAIRED — a failed block_data
+            # fetch must not shift which set gets attributed to a node.
             my_rows, my_cols = frag.block_data(bid)
-            sets.append(set(zip(my_rows.tolist(), my_cols.tolist())))
-            uris = []
+            mine = set(zip(my_rows.tolist(), my_cols.tolist()))
+            peer_sets: list[tuple] = []
             for node in remotes:
                 if node.id not in remote_blocks:
                     continue
@@ -797,8 +934,8 @@ class Cluster:
                     )
                 except ClientError:
                     continue
-                sets.append(set(zip(d["rows"], d["columns"])))
-                uris.append(node.uri)
+                peer_sets.append((node, set(zip(d["rows"], d["columns"]))))
+            sets = [mine] + [s for _, s in peer_sets]
             # Majority consensus (reference mergeBlock: pair kept when
             # present on >= (replicas+1)/2 of the copies).
             total = len(sets)
@@ -810,8 +947,8 @@ class Cluster:
                 counts.update(s)
             consensus = {pair for pair, cnt in counts.items() if cnt >= threshold}
             # Apply locally.
-            to_set = consensus - sets[0]
-            to_clear = sets[0] - consensus
+            to_set = consensus - mine
+            to_clear = mine - consensus
             if to_set or to_clear:
                 frag.import_block_pairs(
                     np.array([p[0] for p in to_set], dtype=np.uint64),
@@ -819,27 +956,21 @@ class Cluster:
                     np.array([p[0] for p in to_clear], dtype=np.uint64),
                     np.array([p[1] for p in to_clear], dtype=np.uint64),
                 )
-            # Push fixes to each remote as Set/Clear batches (reference
-            # syncs via generated PQL, fragment.go:1857-1904). Only the
-            # standard view is reachable through Set/Clear; time/BSI
-            # views converge when each replica runs its own sweep.
-            from pilosa_tpu import SHARD_WIDTH
-
-            if view != "standard":
-                continue
-
-            base = shard * SHARD_WIDTH
-            for i, node in enumerate(n for n in remotes if n.id in remote_blocks):
-                theirs = sets[i + 1]
-                fixes = []
-                for row, col in sorted(consensus - theirs):
-                    fixes.append(f"Set({base + col}, {field}={row})")
-                for row, col in sorted(theirs - consensus):
-                    fixes.append(f"Clear({base + col}, {field}={row})")
-                if fixes:
+            # Push fixes to each remote through the view-aware block
+            # endpoint, so time-quantum and bsig_* views converge in
+            # ONE coordinator sweep. (The reference pushes generated
+            # Set/Clear PQL and can only reach the standard view that
+            # way — fragment.go:1874 "Only sync the standard block";
+            # its other views converge only when each replica runs its
+            # own pull sweep. Conscious improvement, same consensus.)
+            for node, theirs in peer_sets:
+                to_set_remote = sorted(consensus - theirs)
+                to_clear_remote = sorted(theirs - consensus)
+                if to_set_remote or to_clear_remote:
                     try:
-                        self.client.query_node(
-                            node.uri, index, "".join(fixes), remote=True
+                        self.client.send_block_fixes(
+                            node.uri, index, field, view, shard,
+                            to_set_remote, to_clear_remote,
                         )
                     except ClientError:
                         pass

@@ -314,14 +314,46 @@ class API:
 
     # -- fragment sync endpoints (reference api.go:376-472) --
 
-    def fragment_blocks(self, index: str, field: str, shard: int) -> list[dict]:
+    def fragment_blocks(
+        self, index: str, field: str, shard: int, view: str = VIEW_STANDARD
+    ) -> list[dict]:
         self._validate("fragment_blocks")
-        frag = self.holder.fragment(index, field, VIEW_STANDARD, shard)
+        frag = self.holder.fragment(index, field, view, shard)
         if frag is None:
             raise NotFoundError("fragment not found")
         return [
             {"id": bid, "checksum": digest.hex()} for bid, digest in frag.blocks()
         ]
+
+    def apply_block_fixes(
+        self,
+        index: str,
+        field: str,
+        view: str,
+        shard: int,
+        rows,
+        columns,
+        clear_rows,
+        clear_columns,
+    ) -> None:
+        """Anti-entropy push target: apply a peer's consensus block merge
+        to ANY view (time quantums, bsig_*) — the view-aware replacement
+        for the reference's standard-only Set/Clear PQL push
+        (reference fragment.go:1874 'Only sync the standard block')."""
+        import numpy as np
+
+        self._validate("import")
+        fld = self.holder.field(index, field)
+        if fld is None:
+            raise NotFoundError(f"field not found: {field}")
+        v = fld.create_view_if_not_exists(view)
+        frag = v.create_fragment_if_not_exists(shard)
+        frag.import_block_pairs(
+            np.asarray(rows, dtype=np.uint64),
+            np.asarray(columns, dtype=np.uint64),
+            np.asarray(clear_rows, dtype=np.uint64),
+            np.asarray(clear_columns, dtype=np.uint64),
+        )
 
     def fragment_block_data(
         self, index: str, field: str, view: str, shard: int, block: int
@@ -446,11 +478,19 @@ class API:
         nodes = []
         if self.cluster is not None:
             nodes = [n.to_dict() for n in self.cluster.nodes]
-        return {
+        out = {
             "state": self._state(),
             "nodes": nodes,
             "localID": getattr(self.cluster, "node_id", "") if self.cluster else "",
         }
+        job = (
+            self.cluster.resize_job_status()
+            if self.cluster is not None and hasattr(self.cluster, "resize_job_status")
+            else None
+        )
+        if job is not None:
+            out["resizeJob"] = job
+        return out
 
     def hosts(self) -> list[dict]:
         if self.cluster is None:

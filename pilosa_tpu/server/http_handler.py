@@ -121,6 +121,7 @@ class Handler:
             Route("GET", r"/internal/fragment/nodes", self.get_fragment_nodes),
             Route("GET", r"/internal/fragment/blocks", self.get_fragment_blocks),
             Route("GET", r"/internal/fragment/block/data", self.get_block_data),
+            Route("POST", r"/internal/fragment/block/data", self.post_block_fixes),
             Route("GET", r"/internal/fragment/data", self.get_fragment_data),
             Route("POST", r"/internal/fragment/data", self.post_fragment_data),
             Route("GET", r"/internal/shards/max", lambda req: {"standard": a.max_shards()}),
@@ -326,9 +327,28 @@ class Handler:
         q = req.query
         return {
             "blocks": self.api.fragment_blocks(
-                q["index"][0], q["field"][0], int(q["shard"][0])
+                q["index"][0],
+                q["field"][0],
+                int(q["shard"][0]),
+                view=q.get("view", ["standard"])[0],
             )
         }
+
+    def post_block_fixes(self, req) -> dict:
+        """Anti-entropy view-aware block-merge push (see
+        api.apply_block_fixes)."""
+        body = json.loads(req.body or b"{}")
+        self.api.apply_block_fixes(
+            body["index"],
+            body["field"],
+            body.get("view", "standard"),
+            int(body["shard"]),
+            body.get("rows", []),
+            body.get("columns", []),
+            body.get("clearRows", []),
+            body.get("clearColumns", []),
+        )
+        return {}
 
     def get_block_data(self, req) -> dict:
         q = req.query

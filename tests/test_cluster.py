@@ -334,7 +334,18 @@ class TestJoinProtocol:
                 v1 = s1.holder.view("i", "f", "standard")
                 assert owned1, "expected node 1 to own some shards"
                 assert owned1 <= set(v1.fragments)
-                # node 0 dropped what it no longer owns
+                # node 0 drops what it no longer owns (holder-clean
+                # runs just after the NORMAL broadcast — bounded wait)
+                import time as _time
+
+                deadline = _time.time() + 10
+                while _time.time() < deadline:
+                    v0 = s0.holder.view("i", "f", "standard")
+                    if all(
+                        s0.cluster.owns_shard("i", sh) for sh in v0.fragments
+                    ):
+                        break
+                    _time.sleep(0.05)
                 v0 = s0.holder.view("i", "f", "standard")
                 for shard in v0.fragments:
                     assert s0.cluster.owns_shard("i", shard)
@@ -368,6 +379,62 @@ class TestAntiEntropy:
             assert s1.holder.field("i", "f").row(1).columns().tolist() == [1, 2, 99]
             st, b0 = req(s0.uri, "POST", "/index/i/query", b"Row(f=1)")
             assert b0["results"][0]["columns"] == [1, 2, 99]
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_sync_converges_time_and_bsi_views_in_one_sweep(self, tmp_path):
+        """Time-quantum and bsig_* views converge after ONE coordinator
+        sweep: fixes are pushed through the view-aware block endpoint,
+        not Set/Clear PQL (which only reaches the standard view —
+        reference fragment.go:1874)."""
+        servers = boot_static_cluster(tmp_path, n=2, replicas=2)
+        try:
+            s0, s1 = servers
+            req(s0.uri, "POST", "/index/i", {})
+            req(
+                s0.uri,
+                "POST",
+                "/index/i/field/t",
+                {"options": {"type": "time", "timeQuantum": "YMD"}},
+            )
+            req(
+                s0.uri,
+                "POST",
+                "/index/i/field/v",
+                {"options": {"type": "int", "min": 0, "max": 1000}},
+            )
+            req(
+                s0.uri,
+                "POST",
+                "/index/i/query",
+                b"Set(1, t=1, 2020-03-05T00:00) SetValue(col=1, v=7)",
+            )
+            # diverge: write directly into node1's holder, bypassing routing
+            from datetime import datetime
+
+            s1.holder.field("i", "t").set_bit(1, 42, datetime(2020, 3, 5))
+            s1.holder.field("i", "v").set_value(50, 9)
+            # one sweep from the coordinator only
+            s0.cluster.sync_holder()
+
+            for s in (s0, s1):
+                # time views (standard + YMD quantums) all converged
+                for view in (
+                    "standard",
+                    "standard_2020",
+                    "standard_202003",
+                    "standard_20200305",
+                ):
+                    frag = s.holder.fragment("i", "t", view, 0)
+                    assert frag is not None, (s.uri, view)
+                    assert frag.row(1).columns().tolist() == [1, 42], (s.uri, view)
+                # BSI view converged: both columns readable on both nodes
+                fld = s.holder.field("i", "v")
+                bsig = fld.bsi_group("v")
+                vfrag = s.holder.fragment("i", "v", "bsig_v", 0)
+                assert vfrag.value(1, bsig.bit_depth()) == (7, True), s.uri
+                assert vfrag.value(50, bsig.bit_depth()) == (9, True), s.uri
         finally:
             for s in servers:
                 s.close()
@@ -485,6 +552,109 @@ class TestClusterImport:
             assert st == 200
             st, body = req(servers[1].uri, "POST", "/index/i/query", b'Sum(field="v")')
             assert body["results"][0] == {"value": 100, "count": 4}
+        finally:
+            for s in servers:
+                s.close()
+
+
+class TestAsyncResize:
+    def test_resize_job_async_and_status(self, tmp_path):
+        """The coordinator's join handling must not block: the job runs
+        in the background with introspectable state (reference
+        resizeJob, cluster.go:1309-1423)."""
+        import time as _time
+
+        ports = free_ports(2)
+        cfg0 = Config(
+            data_dir=str(tmp_path / "n0"),
+            bind=f"127.0.0.1:{ports[0]}",
+            device_policy="never",
+            metric="none",
+            cluster=ClusterConfig(disabled=False, coordinator=True),
+        )
+        s0 = Server(cfg0)
+        s0.open()
+        try:
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            req(s0.uri, "POST", "/index/i/query", b"Set(7, f=1)")
+
+            cfg1 = Config(
+                data_dir=str(tmp_path / "n1"),
+                bind=f"127.0.0.1:{ports[1]}",
+                device_policy="never",
+                metric="none",
+                cluster=ClusterConfig(
+                    disabled=False, coordinator=False, coordinator_host=s0.uri
+                ),
+            )
+            s1 = Server(cfg1)
+            t0 = _time.time()
+            s1.open()  # joiner blocks until NORMAL, coordinator does not
+            try:
+                job = s0.cluster.resize_job_status()
+                assert job is not None
+                assert job["action"] == "add"
+                deadline = _time.time() + 10
+                while _time.time() < deadline:
+                    if s0.cluster.resize_job_status()["state"] == "DONE":
+                        break
+                    _time.sleep(0.05)
+                assert s0.cluster.resize_job_status()["state"] == "DONE"
+                st, body = req(s0.uri, "GET", "/status")
+                assert body["resizeJob"]["state"] == "DONE"
+            finally:
+                s1.close()
+        finally:
+            s0.close()
+
+    def test_resize_abort_rolls_back(self, tmp_path):
+        """An aborted job returns the cluster to NORMAL with state
+        ABORTED (reference api.ResizeAbort:795)."""
+        servers = boot_static_cluster(tmp_path, n=1, replicas=1)
+        s0 = servers[0]
+        try:
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            req(s0.uri, "POST", "/index/i/query", b"Set(3, f=1)")
+            # start a resize toward an unreachable node: it can never
+            # complete, so abort must roll back
+            ghost = Node(id="zzzghost", uri="http://127.0.0.1:1", is_coordinator=False)
+            s0.cluster._start_resize(add_node=ghost)
+            assert s0.cluster.state == "RESIZING"
+            job = s0.cluster.resize_job_status()
+            assert job["state"] == "RUNNING"
+            s0.cluster.resize_abort()
+            assert s0.cluster.state == "NORMAL"
+            assert s0.cluster.resize_job_status()["state"] == "ABORTED"
+        finally:
+            s0.close()
+
+    def test_frag_sources_balanced(self, tmp_path):
+        """Source replicas are cycled, not always the first owner
+        (reference fragSources load spreading, cluster.go:689-773)."""
+        servers = boot_static_cluster(tmp_path, n=2, replicas=2)
+        try:
+            s0 = servers[0]
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            for sh in range(8):
+                req(
+                    s0.uri,
+                    "POST",
+                    "/index/i/query",
+                    f"Set({sh * SHARD_WIDTH + 1}, f=1)".encode(),
+                )
+            old_nodes = list(s0.cluster.nodes)
+            ghost = Node(id="zzzghost", uri="http://ghost:1", is_coordinator=False)
+            new_nodes = sorted(old_nodes + [ghost], key=lambda n: n.id)
+            sources = s0.cluster._frag_sources(old_nodes, new_nodes)
+            ghost_srcs = sources.get("zzzghost", [])
+            assert ghost_srcs, "ghost node should gain fragments"
+            from_uris = {src["from_uri"] for src in ghost_srcs}
+            # with replicas=2 both old nodes hold every fragment; a
+            # balanced picker uses both as sources
+            assert len(from_uris) == 2, from_uris
         finally:
             for s in servers:
                 s.close()
