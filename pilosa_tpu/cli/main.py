@@ -46,6 +46,14 @@ def main(argv=None) -> int:
     p.add_argument("--replicas", type=int)
     p.add_argument("--hosts", help="comma-separated static cluster hosts")
     p.add_argument("--verbose", action="store_true", default=None)
+    p.add_argument("--tls-certificate", help="TLS certificate path (enables https)")
+    p.add_argument("--tls-certificate-key", help="TLS certificate key path")
+    p.add_argument(
+        "--tls-skip-verify",
+        action="store_true",
+        default=None,
+        help="clients skip TLS peer verification",
+    )
     p.set_defaults(fn=cmd_server)
 
     p = sub.add_parser("import", help="bulk-import CSV bits or values")
@@ -129,6 +137,12 @@ def cmd_server(args) -> int:
     if args.hosts:
         cfg.cluster.hosts = args.hosts.split(",")
         cfg.cluster.disabled = False
+    if args.tls_certificate:
+        cfg.tls.certificate_path = args.tls_certificate
+    if args.tls_certificate_key:
+        cfg.tls.certificate_key_path = args.tls_certificate_key
+    if args.tls_skip_verify is not None:
+        cfg.tls.skip_verify = args.tls_skip_verify
 
     server = Server(cfg)
     server.open()

@@ -205,8 +205,15 @@ def encode_cache(ids: list[int]) -> bytes:
 
 
 def write_cache(path: str, ids: list[int]) -> None:
-    with open(path, "wb") as f:
+    # write-then-rename: a crash mid-flush must never leave a truncated
+    # .cache that chokes the next startup (the periodic flush loop
+    # exists precisely to survive crashes)
+    import os
+
+    tmp = path + ".flushing"
+    with open(tmp, "wb") as f:
         f.write(encode_cache(ids))
+    os.replace(tmp, path)
 
 
 def read_cache(path: str) -> Optional[list[int]]:

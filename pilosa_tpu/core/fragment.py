@@ -211,7 +211,11 @@ class Fragment:
     def flush_cache(self) -> None:
         p = self.cache_path()
         if p:
-            cache_mod.write_cache(p, self.cache.ids())
+            # snapshot ids under the fragment lock (concurrent writers
+            # mutate cache entries); write_cache itself is atomic
+            with self.mu:
+                ids = self.cache.ids()
+            cache_mod.write_cache(p, ids)
 
     # -- row materialisation -------------------------------------------------
 
@@ -714,12 +718,12 @@ class Fragment:
                     np.fromiter(touched, dtype=np.uint64, count=len(touched))
                 )
                 for row_id, cnt in zip(touched, counts):
+                    # drop first: bulk_add's threshold guard would
+                    # otherwise keep a stale higher count for rows the
+                    # merge shrank or emptied
+                    self.cache.remove(row_id)
                     if cnt > 0:
                         self.cache.bulk_add(row_id, int(cnt))
-                    else:
-                        # bulk_add's threshold guard would keep the old
-                        # count; a row the merge emptied must drop out
-                        self.cache.remove(row_id)
                 self.cache.invalidate()
 
     # -- packed-word export for device staging -------------------------------

@@ -23,8 +23,12 @@ class ClientError(Exception):
 
 
 class InternalClient:
-    def __init__(self, timeout: float = 30.0) -> None:
+    def __init__(self, timeout: float = 30.0, ssl_context=None) -> None:
         self.timeout = timeout
+        # for https:// peers (reference http/client.go builds its
+        # transport from the TLS config, server/server.go:166-240);
+        # None = system defaults
+        self.ssl_context = ssl_context
 
     def _request(
         self,
@@ -40,7 +44,9 @@ class InternalClient:
             url += "?" + urllib.parse.urlencode(query)
         req = urllib.request.Request(url, data=body, method=method)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout, context=self.ssl_context
+            ) as resp:
                 data = resp.read()
         except urllib.error.HTTPError as e:
             try:

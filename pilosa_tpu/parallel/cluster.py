@@ -53,9 +53,12 @@ class ResizeJob:
     ABORTED = "ABORTED"
     FAILED = "FAILED"
 
-    def __init__(self, action: str, new_nodes: list, pending: set) -> None:
+    def __init__(
+        self, action: str, new_nodes: list, pending: set, target_id: str = ""
+    ) -> None:
         self.id = next(self._ids)
         self.action = action
+        self.target_id = target_id  # the node being added/removed
         self.new_nodes = new_nodes
         self.pending = pending
         self.state = self.RUNNING
@@ -63,11 +66,14 @@ class ResizeJob:
         self.error: Optional[str] = None
 
     def to_dict(self) -> dict:
+        # .copy() is a single C-level op under the GIL, safe against a
+        # concurrent discard from the completion handler; iterating the
+        # live set here could raise "changed size during iteration"
         return {
             "id": self.id,
             "action": self.action,
             "state": self.state,
-            "pendingNodes": sorted(self.pending),
+            "pendingNodes": sorted(self.pending.copy()),
             "error": self.error,
         }
 
@@ -97,6 +103,7 @@ class Cluster:
         logger=None,
         probe_timeout: float = 2.0,
         down_after: int = 3,
+        ssl_context=None,
     ) -> None:
         self.node_id = node_id
         self.uri = uri
@@ -110,7 +117,7 @@ class Cluster:
         self.logger = logger
         self.state = STATE_STARTING
         self.nodes: list[Node] = []
-        self.client = InternalClient()
+        self.client = InternalClient(ssl_context=ssl_context)
         self.server = None  # attached Server (broadcaster target)
         self.mu = threading.RLock()
         self._joined = threading.Event()
@@ -126,7 +133,9 @@ class Cluster:
         # node; down_after failures → DOWN, any failure → SUSPECT
         self.down_after = down_after
         self._fail_counts: dict[str, int] = {}
-        self._probe_client = InternalClient(timeout=probe_timeout)
+        self._probe_client = InternalClient(
+            timeout=probe_timeout, ssl_context=ssl_context
+        )
 
     # -- wiring --------------------------------------------------------------
 
@@ -617,10 +626,15 @@ class Cluster:
         immediately — the message handler never blocks; a concurrent
         action queues and runs after the active job, like the
         reference's serial listenForJoins channel."""
+        target = add_node or remove_node
         with self.mu:
-            if self._resize_job is not None and self._resize_job.state == ResizeJob.RUNNING:
-                # dedupe: a joiner may resend node-join while its add is
+            running = self._resize_job
+            if running is not None and running.state == ResizeJob.RUNNING:
+                # dedupe against BOTH the running job's own action and
+                # the queue: a joiner resends node-join while its add is
                 # still in flight — a double-add would corrupt hashing
+                if target is not None and target.id == running.target_id:
+                    return
                 queued = any(
                     (a is not None and add_node is not None and a.id == add_node.id)
                     or (
@@ -633,12 +647,15 @@ class Cluster:
                 if not queued:
                     self._resize_queue.append((add_node, remove_node))
                 return
-            # re-validate (a queued action may be stale by the time it runs)
+            # re-validate (a queued action may be stale by the time it
+            # runs); a stale action must still let queued successors run
             if add_node is not None and any(n.id == add_node.id for n in self.nodes):
+                self._schedule_next_resize_locked()
                 return
             if remove_node is not None and not any(
                 n.id == remove_node.id for n in self.nodes
             ):
+                self._schedule_next_resize_locked()
                 return
             self._resize_abort.clear()
             old_nodes = list(self.nodes)
@@ -652,6 +669,7 @@ class Cluster:
                 "remove" if remove_node is not None else "add",
                 new_nodes,
                 {n.id for n in new_nodes},
+                target_id=target.id if target is not None else "",
             )
             self._resize_job = job
             self.state = STATE_RESIZING
@@ -719,7 +737,20 @@ class Cluster:
                 if self._resize_queue:
                     next_action = self._resize_queue.popleft()
             if next_action is not None:
+                # a stale action drains through to the next one inside
+                # _start_resize (_schedule_next_resize_locked)
                 self._start_resize(*next_action)
+
+    def _schedule_next_resize_locked(self) -> None:
+        """Caller holds self.mu and just dropped a stale action: hand
+        the next queued action to a fresh thread so the queue never
+        strands behind a no-op."""
+        if not self._resize_queue:
+            return
+        next_action = self._resize_queue.popleft()
+        threading.Thread(
+            target=self._start_resize, args=next_action, daemon=True
+        ).start()
 
     def resize_job_status(self) -> Optional[dict]:
         job = self._resize_job

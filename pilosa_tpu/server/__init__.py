@@ -1,7 +1,7 @@
 """API + HTTP + server runtime (L6/L7)."""
 
 from pilosa_tpu.server.api import API, APIError, NotFoundError
-from pilosa_tpu.server.config import ClusterConfig, Config
+from pilosa_tpu.server.config import ClusterConfig, Config, TLSConfig
 from pilosa_tpu.server.http_handler import Handler, encode_result, make_http_server
 from pilosa_tpu.server.server import Server
 
@@ -9,6 +9,7 @@ __all__ = [
     "API",
     "APIError",
     "ClusterConfig",
+    "TLSConfig",
     "Config",
     "Handler",
     "NotFoundError",

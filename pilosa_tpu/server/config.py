@@ -34,6 +34,19 @@ class ClusterConfig:
 
 
 @dataclass
+class TLSConfig:
+    """reference server/config.go:42-143 TLS block + server.go:166-240."""
+
+    certificate_path: str = ""
+    certificate_key_path: str = ""
+    skip_verify: bool = False  # clients skip peer verification
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.certificate_path and self.certificate_key_path)
+
+
+@dataclass
 class Config:
     data_dir: str = "~/.pilosa_tpu"
     bind: str = "localhost:10101"
@@ -50,7 +63,10 @@ class Config:
     mesh_devices: int | str = 0
     # cluster
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    # TLS on the listener + internal client (reference server.go:166-240)
+    tls: TLSConfig = field(default_factory=TLSConfig)
     anti_entropy_interval: float = 600.0  # reference server.go:238 (10m)
+    cache_flush_interval: float = 60.0  # reference holder.go:37 (1m)
     metric: str = "expvar"  # expvar | statsd | none
     metric_host: str = "127.0.0.1:8125"  # statsd UDP address
     # opt-in diagnostics phone-home endpoint (reference diagnostics.go);
@@ -85,6 +101,11 @@ class Config:
                     cattr = ck.replace("-", "_")
                     if hasattr(cfg.cluster, cattr):
                         setattr(cfg.cluster, cattr, cv)
+            elif key == "tls" and isinstance(v, dict):
+                for tk, tv in v.items():
+                    tattr = tk.replace("-", "_")
+                    if hasattr(cfg.tls, tattr):
+                        setattr(cfg.tls, tattr, tv)
             elif hasattr(cfg, key):
                 setattr(cfg, key, v)
             else:
@@ -95,7 +116,7 @@ class Config:
         """PILOSA_TPU_* environment overrides (reference PILOSA_* env)."""
         env = env if env is not None else os.environ
         for f in dataclasses.fields(self):
-            if f.name == "cluster":
+            if f.name in ("cluster", "tls"):
                 continue
             key = "PILOSA_TPU_" + f.name.upper()
             if key in env:
@@ -130,5 +151,10 @@ class Config:
             f"probe-timeout = {self.cluster.probe_timeout}",
             f"down-after = {self.cluster.down_after}",
             f"status-interval = {self.cluster.status_interval}",
+            "",
+            "[tls]",
+            f'certificate-path = "{self.tls.certificate_path}"',
+            f'certificate-key-path = "{self.tls.certificate_key_path}"',
+            f"skip-verify = {'true' if self.tls.skip_verify else 'false'}",
         ]
         return "\n".join(lines) + "\n"

@@ -125,6 +125,13 @@ class Server:
     # -- lifecycle (reference Server.Open:312) --
 
     def open(self) -> None:
+        tls = self.config.tls
+        if bool(tls.certificate_path) != bool(tls.certificate_key_path):
+            # half-configured TLS must not silently serve plaintext
+            raise ValueError(
+                "TLS misconfigured: both certificate-path and "
+                "certificate-key-path are required"
+            )
         self._set_file_limit()
         self.logger.printf(
             "pilosa_tpu %s starting, data=%s", __version__, self.holder.path
@@ -136,12 +143,24 @@ class Server:
         self.httpd = make_http_server(
             self.handler, self.config.host, self.config.port
         )
+        if self.config.tls.enabled:
+            # TLS on the listener (reference server/server.go:166-240:
+            # getListener wraps with tls.NewListener from the config's
+            # certificate paths)
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(
+                os.path.expanduser(self.config.tls.certificate_path),
+                os.path.expanduser(self.config.tls.certificate_key_path),
+            )
+            self.httpd.socket = ctx.wrap_socket(self.httpd.socket, server_side=True)
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True
         )
         self._serve_thread.start()
         self.logger.printf(
-            "pilosa_tpu server listening on http://%s:%d", *self.address()
+            "pilosa_tpu server listening on %s://%s:%d", self.scheme, *self.address()
         )
         if self.cluster is None and not self.config.cluster.disabled:
             self.cluster = self._build_cluster()
@@ -170,6 +189,25 @@ class Server:
     def _start_background_loops(self) -> None:
         """reference server.go: monitorAntiEntropy:400, monitorRuntime:683,
         monitorDiagnostics:633."""
+
+        def cache_flush_loop():
+            # reference monitorCacheFlush (holder.go:425): persist every
+            # OPENED fragment's TopN cache periodically so a crash loses
+            # at most one interval of ranking state. Never-touched lazy
+            # fragments have nothing new to flush.
+            interval = self.config.cache_flush_interval
+            if interval <= 0:
+                return
+            while not self._closed.wait(interval):
+                try:
+                    for idx in list(self.holder.indexes.values()):
+                        for fld in list(idx.fields.values()):
+                            for view in list(fld.views.values()):
+                                for frag in list(view.fragments.values()):
+                                    if frag._open:
+                                        frag.flush_cache()
+                except Exception as e:
+                    self.logger.printf("cache flush error: %s", e)
 
         def anti_entropy_loop():
             interval = self.config.anti_entropy_interval
@@ -260,6 +298,7 @@ class Server:
                     self.logger.printf("node-status push error: %s", e)
 
         for fn in (
+            cache_flush_loop,
             anti_entropy_loop,
             runtime_monitor_loop,
             diagnostics_loop,
@@ -284,6 +323,8 @@ class Server:
         cc = self.config.cluster
         data_dir = os.path.expanduser(self.config.data_dir)
         topology_path = os.path.join(data_dir, ".topology")
+        ssl_ctx = self.client_ssl_context()
+        scheme = self.scheme
         if cc.hosts:
             # Static topology: node identity = URI so every node derives
             # the identical ring (the reference's cluster-disabled mode
@@ -298,10 +339,11 @@ class Server:
                 logger=self.logger,
                 probe_timeout=cc.probe_timeout,
                 down_after=cc.down_after,
+                ssl_context=ssl_ctx,
             )
             cluster.set_nodes(
-                [Node(id=h if h.startswith("http") else f"http://{h}",
-                      uri=h if h.startswith("http") else f"http://{h}")
+                [Node(id=h if h.startswith("http") else f"{scheme}://{h}",
+                      uri=h if h.startswith("http") else f"{scheme}://{h}")
                  for h in cc.hosts]
             )
             return cluster
@@ -314,12 +356,17 @@ class Server:
             coordinator_uri=(
                 cc.coordinator_host
                 if cc.coordinator_host.startswith("http")
-                else (f"http://{cc.coordinator_host}" if cc.coordinator_host else None)
+                else (
+                    f"{scheme}://{cc.coordinator_host}"
+                    if cc.coordinator_host
+                    else None
+                )
             ),
             topology_path=topology_path,
             logger=self.logger,
             probe_timeout=cc.probe_timeout,
             down_after=cc.down_after,
+            ssl_context=ssl_ctx,
         )
 
     def address(self) -> tuple[str, int]:
@@ -328,9 +375,26 @@ class Server:
         return self.httpd.server_address[:2]
 
     @property
+    def scheme(self) -> str:
+        return "https" if self.config.tls.enabled else "http"
+
+    @property
     def uri(self) -> str:
         host, port = self.address()
-        return f"http://{host}:{port}"
+        return f"{self.scheme}://{host}:{port}"
+
+    def client_ssl_context(self):
+        """SSL context for node-to-node clients; honors skip-verify
+        (reference http/client.go transport from TLS config)."""
+        if not self.config.tls.enabled:
+            return None
+        import ssl
+
+        ctx = ssl.create_default_context()
+        if self.config.tls.skip_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        return ctx
 
     def close(self) -> None:
         self._closed.set()

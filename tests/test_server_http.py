@@ -325,3 +325,37 @@ def test_query_route_protobuf_error_payload(server):
     assert ctype == publicproto.CONTENT_TYPE
     decoded = publicproto.decode_query_response(payload)
     assert decoded["error"]
+
+
+def test_periodic_cache_flush(tmp_path):
+    """reference monitorCacheFlush (holder.go:425): fragment .cache
+    files persist on the interval, not only at close."""
+    import os
+    import time
+
+    from pilosa_tpu.server import Config, Server
+
+    cfg = Config(
+        data_dir=str(tmp_path / "data"),
+        bind="127.0.0.1:0",
+        metric="none",
+        cache_flush_interval=0.2,
+        anti_entropy_interval=0,
+    )
+    s = Server(cfg)
+    s.open()
+    try:
+        req(s, "POST", "/index/cf")
+        req(s, "POST", "/index/cf/field/f")
+        req(s, "POST", "/index/cf/query", b"Set(1, f=3) Set(2, f=3)")
+        frag = s.holder.fragment("cf", "f", "standard", 0)
+        cache_path = frag.cache_path()
+        deadline = time.time() + 5
+        while time.time() < deadline and not os.path.exists(cache_path):
+            time.sleep(0.05)
+        assert os.path.exists(cache_path)
+        from pilosa_tpu.core.cache import read_cache
+
+        assert read_cache(cache_path) == [3]
+    finally:
+        s.close()

@@ -1,0 +1,113 @@
+"""TLS on the listener + internal client (reference
+server/server.go:166-240, server/config.go TLS block): https serving,
+and a 2-node cluster whose node-to-node traffic rides TLS with
+skip-verify (self-signed certs)."""
+
+import json
+import ssl
+import subprocess
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.server import ClusterConfig, Config, Server, TLSConfig
+
+from test_cluster import free_ports
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", key, "-out", cert, "-days", "2",
+            "-subj", "/CN=127.0.0.1",
+            "-addext", "subjectAltName=IP:127.0.0.1",
+        ],
+        check=True,
+        capture_output=True,
+    )
+    return cert, key
+
+
+def _req(uri, method, path, body=None):
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    data = body if (body is None or isinstance(body, bytes)) else json.dumps(body).encode()
+    r = urllib.request.Request(uri + path, data=data, method=method)
+    with urllib.request.urlopen(r, timeout=30, context=ctx) as resp:
+        return resp.status, json.loads(resp.read() or b"{}")
+
+
+def test_https_serving(tmp_path, certs):
+    cert, key = certs
+    cfg = Config(
+        data_dir=str(tmp_path / "data"),
+        bind="127.0.0.1:0",
+        metric="none",
+        tls=TLSConfig(certificate_path=cert, certificate_key_path=key, skip_verify=True),
+    )
+    s = Server(cfg)
+    s.open()
+    try:
+        assert s.uri.startswith("https://")
+        st, _ = _req(s.uri, "POST", "/index/t", {})
+        assert st == 200
+        st, _ = _req(s.uri, "POST", "/index/t/field/f", {})
+        assert st == 200
+        st, body = _req(s.uri, "POST", "/index/t/query", b"Set(1, f=2)")
+        assert st == 200 and body["results"] == [True]
+        st, body = _req(s.uri, "POST", "/index/t/query", b"Count(Row(f=2))")
+        assert body["results"] == [1]
+        # plain http against the TLS listener must fail
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                "http://%s:%d/status" % s.address(), timeout=5
+            )
+    finally:
+        s.close()
+
+
+def test_tls_cluster_node_to_node(tmp_path, certs):
+    cert, key = certs
+    ports = free_ports(2)
+    hosts = [f"127.0.0.1:{p}" for p in ports]
+    servers = []
+    for i, p in enumerate(ports):
+        cfg = Config(
+            data_dir=str(tmp_path / f"n{i}"),
+            bind=hosts[i],
+            device_policy="never",
+            metric="none",
+            cluster=ClusterConfig(
+                disabled=False, coordinator=(i == 0), replicas=1, hosts=hosts
+            ),
+            tls=TLSConfig(
+                certificate_path=cert, certificate_key_path=key, skip_verify=True
+            ),
+        )
+        s = Server(cfg)
+        s.open()
+        servers.append(s)
+    try:
+        s0 = servers[0]
+        # node URIs derived with the https scheme
+        assert all(n.uri.startswith("https://") for n in s0.cluster.nodes)
+        _req(s0.uri, "POST", "/index/c", {})
+        _req(s0.uri, "POST", "/index/c/field/f", {})
+        # writes fan out over TLS to shard owners; reads scatter-gather
+        from pilosa_tpu import SHARD_WIDTH
+
+        cols = [sh * SHARD_WIDTH + 5 for sh in range(4)]
+        for c in cols:
+            st, body = _req(s0.uri, "POST", "/index/c/query", f"Set({c}, f=1)".encode())
+            assert st == 200 and body["results"] == [True]
+        for s in servers:
+            st, body = _req(s.uri, "POST", "/index/c/query", b"Row(f=1)")
+            assert body["results"][0]["columns"] == cols, s.uri
+    finally:
+        for s in servers:
+            s.close()
