@@ -103,6 +103,10 @@ class Fragment:
         self._row_cache: dict[int, Row] = {}
         self._op_file = None
         self._open = False
+        # occupancy index cache keyed by generation (mmap stores cache
+        # internally; dict stores would otherwise rebuild O(N log N)
+        # per query in the auto-policy estimate)
+        self._occ: Optional[tuple] = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -185,7 +189,11 @@ class Fragment:
         in ONE occupancy snapshot (row r spans keys [r*16, (r+1)*16));
         callers must not mix arrays from separate snapshots — a mutation
         between calls can change the index length."""
-        keys, cs = self.storage.occupancy()
+        occ = self._occ
+        if occ is None or occ[0] != self.generation:
+            keys, cs = self.storage.occupancy()
+            self._occ = occ = (self.generation, keys, cs)
+        _, keys, cs = occ
         first = row_ids.astype(np.uint64) * np.uint64(SHARD_WIDTH >> 16)
         last = (row_ids.astype(np.uint64) + np.uint64(1)) * np.uint64(
             SHARD_WIDTH >> 16

@@ -19,6 +19,7 @@ local loop over shards.
 
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass
 from datetime import datetime
@@ -120,6 +121,16 @@ class Executor:
         self.scorer = BatchedScorer()
         # fused count-of-tree programs keyed by query structure
         self._tree_jits: dict[str, Any] = {}
+        # auto-policy crossover, in estimated touched containers (see
+        # _touched_containers + AUTOTUNE.json). The default assumes a
+        # co-located chip (~1-2 ms dispatch ⇒ crossover ~10^2); deploys
+        # behind a high-RTT tunnel should raise it (the measured tunnel
+        # crossover on this rig is ~3,700).
+        self.auto_min_containers = int(
+            os.environ.get(
+                "PILOSA_AUTO_DEVICE_MIN_CONTAINERS", AUTO_DEVICE_MIN_CONTAINERS
+            )
+        )
         self._read_pool = None  # lazy; see execute()
         self._read_pool_mu = threading.Lock()
         # compiled shard_map kernels keyed by (kind, static args) — the
@@ -517,32 +528,41 @@ class Executor:
             return False
         if self.device_policy == "always":
             return True
-        # auto: worthwhile once fragments are dense enough
-        total = 0
-        for frag in self._involved_fragments(index, c, shard):
-            total += len(frag.storage.containers)
-        return total >= AUTO_DEVICE_MIN_CONTAINERS
+        return self._touched_containers(index, c, shard) >= self.auto_min_containers
 
-    def _involved_fragments(self, index, c: Call, shard: int):
-        out = []
+    def _touched_containers(self, index, c: Call, shard: int) -> int:
+        """Estimated container blocks this call subtree READS in this
+        shard — the CPU path's cost driver. Counting the fragment's
+        total containers (the old heuristic) mischooses the device for
+        a 2-row query on a tall fragment. Measured on the real chip
+        (AUTOTUNE.json): CPU ≈ 0.02 ms per touched container; the
+        device dispatch is flat, so the crossover is a touched-container
+        threshold."""
+        total = 0
         if c.name == "Row":
             try:
                 fname = c.field_arg()
             except ValueError:
-                return out
-            frag = self.holder.fragment(index, fname, VIEW_STANDARD, shard)
-            if frag:
-                out.append(frag)
+                fname = None
+            if fname:
+                frag = self.holder.fragment(index, fname, VIEW_STANDARD, shard)
+                if frag is not None:
+                    row_id, _ = c.uint_arg(fname)
+                    total += frag.sparse_block_count([row_id])
         elif c.name == "Range" and c.has_condition_arg():
             for fname in c.args:
+                f = self.holder.field(index, fname)
+                bsig = f.bsi_group(fname) if f is not None else None
                 frag = self.holder.fragment(
                     index, fname, VIEW_BSI_GROUP_PREFIX + fname, shard
                 )
-                if frag:
-                    out.append(frag)
+                if frag is not None and bsig is not None:
+                    total += frag.sparse_block_count(
+                        list(range(bsig.bit_depth() + 1))
+                    )
         for child in c.children:
-            out.extend(self._involved_fragments(index, child, shard))
-        return out
+            total += self._touched_containers(index, child, shard)
+        return total
 
     def _device_bitmap(self, index, c: Call, shard: int):
         """Lower a bitmap call subtree to a device u32[W] word vector."""
@@ -682,11 +702,8 @@ class Executor:
             return False
         if self.device_policy == "always":
             return True
-        total = 0
-        for shard in shards:
-            for frag in self._involved_fragments(index, c, shard):
-                total += len(frag.storage.containers)
-        return total >= AUTO_DEVICE_MIN_CONTAINERS
+        total = sum(self._touched_containers(index, c, s) for s in shards)
+        return total >= self.auto_min_containers
 
     def _tree_leaves(self, index, c: Call, batch):
         """Lower a bitmap call tree to (leaf device arrays, structure):
@@ -999,7 +1016,8 @@ class Executor:
             depth = bsig.bit_depth()
             if self._use_device(index, c, shard) or (
                 self.device_policy != "never"
-                and len(frag.storage.containers) >= AUTO_DEVICE_MIN_CONTAINERS
+                and frag.sparse_block_count(list(range(depth + 1)))
+                >= self.auto_min_containers
             ):
                 try:
                     filt, has_filter = self._device_filter(index, c, shard)
@@ -1047,7 +1065,8 @@ class Executor:
             depth = bsig.bit_depth()
             if self._use_device(index, c, shard) or (
                 self.device_policy != "never"
-                and len(frag.storage.containers) >= AUTO_DEVICE_MIN_CONTAINERS
+                and frag.sparse_block_count(list(range(depth + 1)))
+                >= self.auto_min_containers
             ):
                 try:
                     filt, has_filter = self._device_filter(index, c, shard)

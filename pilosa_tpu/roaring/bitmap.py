@@ -610,16 +610,32 @@ class Bitmap:
         return any(c.n for c in self.containers.values())
 
     def flip(self, start: int, end: int) -> "Bitmap":
-        """New bitmap with bits in [start, end] flipped (reference Flip:764,
-        inclusive range)."""
+        """New bitmap with bits in [start, end] flipped (reference
+        Flip:764, inclusive range) — container-wise: each in-range
+        container XORs a range mask in one vector op instead of the
+        reference's per-bit iterator walk."""
+        if end < start:
+            return self.clone()
         out = Bitmap()
-        for key in self.sorted_keys():
+        hi0, hi1 = highbits(start), highbits(end)
+        for key in self._iter_keys_sorted(None, hi0):
             out.containers[key] = self.containers[key].clone()
-        for v in range(start, end + 1):
-            if out.contains(v):
-                out.remove_no_oplog(v)
-            else:
-                out.add_no_oplog(v)
+        for key in range(hi0, hi1 + 1):
+            lo = lowbits(start) if key == hi0 else 0
+            hi = lowbits(end) if key == hi1 else MAX_CONTAINER_VAL
+            mask = np.zeros(BITMAP_N, dtype=np.uint64)
+            first_w, last_w = lo >> 6, hi >> 6
+            mask[first_w : last_w + 1] = ~np.uint64(0)
+            mask[first_w] &= ~np.uint64(0) << np.uint64(lo & 63)
+            if (hi & 63) != 63:
+                mask[last_w] &= (np.uint64(1) << np.uint64((hi & 63) + 1)) - np.uint64(1)
+            c = self.containers.get(key)
+            words = (c.words() if c is not None and c.n else np.zeros(BITMAP_N, dtype=np.uint64)) ^ mask
+            flipped = Container.from_words(words)
+            if flipped.n:
+                out.containers[key] = flipped
+        for key in self._iter_keys_sorted(hi1 + 1, None):
+            out.containers[key] = self.containers[key].clone()
         return out
 
     def offset_range(self, offset: int, start: int, end: int) -> "Bitmap":

@@ -272,3 +272,36 @@ def test_container_form_point_ops(form):
     b.remove(probe)
     assert not b.contains(probe)
     assert b.count() == len(before - {probe})
+
+
+class TestFlipVectorized:
+    def test_flip_matches_per_bit_semantics(self):
+        import numpy as np
+
+        from pilosa_tpu.roaring import Bitmap
+
+        rng = np.random.default_rng(51)
+        vals = np.unique(rng.integers(0, 1 << 18, size=4000, dtype=np.uint64))
+        b = Bitmap.from_sorted(vals)
+        for start, end in [
+            (0, 6),
+            (5, 5),
+            (100, (1 << 16) - 1),
+            ((1 << 16) - 3, (1 << 16) + 3),  # crosses a container edge
+            (70000, 200000),                 # spans whole containers
+            (0, (1 << 18) + 100),            # past the last set bit
+        ]:
+            got = set(int(v) for v in b.flip(start, end))
+            have = set(int(v) for v in vals)
+            want = (have - set(range(start, end + 1))) | (
+                set(range(start, end + 1)) - have
+            )
+            assert got == want, (start, end)
+
+    def test_flip_empty_and_reverse_range(self):
+        from pilosa_tpu.roaring import Bitmap
+
+        b = Bitmap(3, 70000)
+        assert sorted(b.flip(10, 5)) == [3, 70000]  # end < start = no-op clone
+        e = Bitmap()
+        assert sorted(e.flip(2, 4)) == [2, 3, 4]
