@@ -29,9 +29,15 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
+
+# process-start clock for the child's self-enforced deadline: the
+# parent's subprocess timeout runs from spawn, so measuring from inside
+# main() (after the jax import) would silently eat the guard margin
+_T_PROC_START = time.monotonic()
 
 
 def main():
@@ -41,7 +47,7 @@ def main():
     import jax.numpy as jnp
 
     global _T_CHILD_START
-    _T_CHILD_START = time.monotonic()
+    _T_CHILD_START = _T_PROC_START
 
     # The image's sitecustomize force-sets jax_platforms to the TPU
     # backend, overriding the JAX_PLATFORMS env var; re-assert it so
@@ -50,6 +56,111 @@ def main():
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     import os
+
+    # Self-enforced deadline: the parent SIGKILLs this child at its
+    # timeout, which would lose everything measured so far. A guard
+    # thread prints the progressively-filled result dict (marked
+    # partial) and exits just before that happens — cold compiles and
+    # first-time HBM staging at the 1B scale are the usual overrunners.
+    child_budget = float(os.environ.get("PILOSA_BENCH_CHILD_BUDGET", 400))
+    result: dict = {
+        "metric": "TopN queries/sec (measurement incomplete)",
+        "value": 0.0,
+        "unit": "queries/s",
+        "vs_baseline": None,
+    }
+    printed = threading.Event()
+    emit_lock = threading.Lock()
+
+    def emit(final: bool) -> None:
+        with emit_lock:
+            if printed.is_set():
+                return
+            # dict(result) is one C-level copy (atomic under the GIL);
+            # dumping the live dict could race a concurrent update
+            snapshot = dict(result)
+            # a result without a measured headline must never be
+            # persisted over the last COMPLETE measurement
+            if not final or snapshot.get("value", 0.0) == 0.0:
+                snapshot["partial"] = True
+            line = json.dumps(snapshot)
+            printed.set()
+        print(line, flush=True)
+
+    def guard():
+        remaining = child_budget - (time.monotonic() - _T_CHILD_START) - 15
+        if remaining > 0 and printed.wait(timeout=remaining):
+            return
+        emit(final=False)
+        os._exit(0)
+
+    threading.Thread(target=guard, daemon=True).start()
+    result["platform"] = jax.devices()[0].platform
+
+    # ---- Full-path north-star config FIRST (BASELINE config 4: 1B
+    # rows, 64 shards) — it is the headline metric and must not starve
+    # behind the kernel microbench when the budget is tight. The data
+    # dir builds resumably into .bench_cache/; a kernel-bench reserve is
+    # held back so the secondary numbers still get measured.
+    tall = None
+    if os.environ.get("PILOSA_BENCH_TALL", "1") != "0":
+        try:
+            import bench_tall
+
+            spent = time.monotonic() - _T_CHILD_START
+            # the full-path number is what matters: it gets the budget
+            # minus a small reserve; the kernel microbench below only
+            # runs if time is left (its numbers also live in BENCH_r*
+            # history)
+            tall_deadline = child_budget - spent - 70
+            if tall_deadline > 75:
+                tall = bench_tall.run(deadline_s=tall_deadline)
+                result["tall"] = tall
+                if tall.get("topn_qps"):
+                    rows = tall["build"]["rows"]
+                    result["metric"] = (
+                        f"TopN queries/sec (full path, {rows:,} rows x "
+                        f"{tall['shards']} shards, single chip)"
+                    )
+                    result["value"] = tall["topn_qps"]
+                    result["p50_ms"] = tall["topn_p50_ms"]
+                    if tall.get("cpu_topn_qps"):
+                        result["vs_baseline"] = round(
+                            tall["topn_qps"] / tall["cpu_topn_qps"], 2
+                        )
+                        result["baseline_cpu_qps"] = tall["cpu_topn_qps"]
+        except Exception as e:  # keep the JSON line flowing
+            print(f"tall bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+
+    # ---- native C++ baseline (the Go-reference proxy, measured offline
+    # by native/baseline_topn.cpp): attach before any early return — it
+    # costs only a local file read and belongs with the tall headline.
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BASELINE_NATIVE.json")) as f:
+            _native = json.load(f)["measured"]
+        result["native_baseline"] = {
+            k: v.get("native_cpu_qps") for k, v in _native.items()
+        }
+        _tall_native = _native.get("tall_1Bx64shards", {}).get("native_cpu_qps")
+        _tall_rows = result.get("tall", {}).get("build", {}).get("rows", 0)
+        # only compare against the native 1B number when THIS run was
+        # actually at (or near) the 1B scale
+        if (
+            _tall_native
+            and result.get("tall", {}).get("topn_qps")
+            and _tall_rows >= 900_000_000
+        ):
+            result["vs_native_baseline"] = round(
+                result["tall"]["topn_qps"] / _tall_native, 2
+            )
+    except Exception as e:  # any malformed baseline file — keep the JSON flowing
+        print(f"native baseline unavailable: {type(e).__name__}: {e}", file=sys.stderr)
+
+    if child_budget - (time.monotonic() - _T_CHILD_START) < 150:
+        # not enough room for the kernel microbench — ship what we have
+        emit(final=True)
+        return
 
     R = int(os.environ.get("PILOSA_BENCH_ROWS", 4096))
     W64 = 16384  # uint64 words per row (2^20 columns)
@@ -208,79 +319,43 @@ def main():
     cpu_query_s = per_row * R
     cpu_qps = 1.0 / cpu_query_s
 
-    result = {
-        "metric": f"TopN queries/sec ({R} rows x 1M cols, ~2% density, single chip)",
-        "value": round(best_qps, 2),
-        "unit": "queries/s",
-        "vs_baseline": round(best_qps / cpu_qps, 2),
-        "p50_ms": round(p50, 3),
+    kernel_fields = {
         "xla_qps": round(tpu_qps, 2),
         "pallas_qps": round(pallas_qps, 2),
         "batched_qps": round(batched_qps, 2),
         "batch_size": BATCH,
-        "baseline_cpu_qps": round(cpu_qps, 3),
-        "platform": jax.devices()[0].platform,
+        "kernel_qps": round(best_qps, 2),
+        "kernel_cpu_qps": round(cpu_qps, 3),
+        "kernel_vs_baseline": round(best_qps / cpu_qps, 2),
+        "kernel_p50_ms": round(p50, 3),
     }
+    result.update(kernel_fields)
+    # the kernel microbench is the headline only when the full-path
+    # north-star config didn't produce one
+    if not (tall and tall.get("topn_qps")):
+        result.update(
+            {
+                "metric": (
+                    f"TopN queries/sec ({R} rows x 1M cols, ~2% density, "
+                    "single chip)"
+                ),
+                "value": round(best_qps, 2),
+                "vs_baseline": round(best_qps / cpu_qps, 2),
+                "p50_ms": round(p50, 3),
+                "baseline_cpu_qps": round(cpu_qps, 3),
+            }
+        )
 
-    # ---- Full-path north-star config (BASELINE config 4: 1B rows, 64
-    # shards) through PQL -> executor -> stager -> kernels. When it
-    # runs, IT is the headline metric; the kernel numbers above stay as
-    # fields. The data dir builds resumably into .bench_cache/, so the
-    # first run may report fewer shards and later runs complete it.
-    child_budget = float(os.environ.get("PILOSA_BENCH_CHILD_BUDGET", 400))
-    spent = time.monotonic() - _T_CHILD_START
-    if os.environ.get("PILOSA_BENCH_TALL", "1") != "0" and child_budget - spent > 75:
-        try:
-            import bench_tall
-
-            tall = bench_tall.run(deadline_s=child_budget - spent - 20)
-            result["tall"] = tall
-            if tall.get("topn_qps"):
-                rows = tall["build"]["rows"]
-                result["metric"] = (
-                    f"TopN queries/sec (full path, {rows:,} rows x "
-                    f"{tall['shards']} shards, single chip)"
-                )
-                result["value"] = tall["topn_qps"]
-                result["p50_ms"] = tall["topn_p50_ms"]
-                # keep the headline ratio coherent: vs_baseline and
-                # baseline_cpu_qps must describe the SAME workload as
-                # value, or be absent
-                if tall.get("cpu_topn_qps"):
-                    result["vs_baseline"] = round(
-                        tall["topn_qps"] / tall["cpu_topn_qps"], 2
-                    )
-                    result["baseline_cpu_qps"] = tall["cpu_topn_qps"]
-                else:
-                    result["vs_baseline"] = None
-                    result["baseline_cpu_qps"] = None
-                result["kernel_vs_baseline"] = round(best_qps / cpu_qps, 2)
-        except Exception as e:  # keep the JSON line flowing
-            print(f"tall bench failed: {type(e).__name__}: {e}", file=sys.stderr)
-
-    # ---- native C++ baseline (the Go-reference proxy; BASELINE_NATIVE
-    # .json is measured offline by native/baseline_topn.cpp). Quote it
-    # next to the headline so the ratio against a compiled baseline is
-    # visible, not just the Python-path one.
     try:
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BASELINE_NATIVE.json")) as f:
-            native = json.load(f)["measured"]
-        result["native_baseline"] = {
-            k: v.get("native_cpu_qps") for k, v in native.items()
-        }
-        tall_native = native.get("tall_1Bx64shards", {}).get("native_cpu_qps")
-        if tall_native and result.get("tall", {}).get("topn_qps"):
-            result["vs_native_baseline"] = round(
-                result["tall"]["topn_qps"] / tall_native, 2
-            )
-        kern_native = native.get("kernel_4096x1M", {}).get("native_cpu_qps")
+        kern_native = (
+            result.get("native_baseline", {}).get("kernel_4096x1M")
+        )
         if kern_native:
             result["kernel_vs_native_baseline"] = round(best_qps / kern_native, 2)
-    except Exception as e:  # any malformed baseline file — keep the JSON flowing
-        print(f"native baseline unavailable: {type(e).__name__}: {e}", file=sys.stderr)
+    except Exception as e:
+        print(f"native kernel ratio unavailable: {type(e).__name__}: {e}", file=sys.stderr)
 
-    print(json.dumps(result))
+    emit(final=True)
 
 
 def _probe_main():
@@ -404,7 +479,9 @@ def _guarded_main():
             if obj is None:
                 reason = "bench child produced no JSON line"
             else:
-                if obj.get("platform") == "tpu":
+                if obj.get("platform") == "tpu" and not obj.get("partial"):
+                    # a deadline-cut partial must never shadow the last
+                    # COMPLETE real-device measurement
                     # Only a real-device result is worth replaying later;
                     # a CPU smoke run must not masquerade as the TPU number.
                     # Write-then-rename so a killed writer can't truncate

@@ -419,7 +419,7 @@ def bench_tall_scaled(tmp, scale):
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
-    ok = bool(tall.get("bit_identical")) and not tall.get("error")
+    ok = tall.get("bit_identical") is True and not tall.get("error")
     return _report(
         "tall_scaled",
         0,

@@ -186,19 +186,25 @@ def run(deadline_s: float = 1e9) -> dict:
         # CPU-oracle queries at 1B rows cost seconds each — two suffice
         # for the identity check; the measure loops absorb remaining
         # cold samples (a few cold p50 samples out of ~100 are noise).
+        # Deadline-checked between queries: the first device TopN pays
+        # the whole chunk-0 staging upload and can take minutes cold.
         ident = True
+        checked = 0
         for q in [topn[0], chains[0]]:
-            want = cpu.execute("tall", q)
             got = dev.execute("tall", q)
+            if remaining() < 90:
+                break
+            want = cpu.execute("tall", q)
             ident &= json.dumps(want) == json.dumps(got)
-        warm_budget = remaining() - 90
+            checked += 1
+        out["bit_identical"] = ident if checked else "skipped (deadline)"
+        warm_budget = min(remaining() - 80, 60)
         t_warm = time.monotonic()
         for q in topn + chains:
             if time.monotonic() - t_warm > warm_budget or remaining() < 25:
                 break
             dev.execute("tall", q)
         out["open_warm_s"] = round(time.monotonic() - t_open, 1)
-        out["bit_identical"] = ident
 
         budget = max(min(remaining() - 20, 60), 6)
         topn_qps, topn_p50 = _measure(
