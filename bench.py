@@ -46,9 +46,6 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    global _T_CHILD_START
-    _T_CHILD_START = _T_PROC_START
-
     # The image's sitecustomize force-sets jax_platforms to the TPU
     # backend, overriding the JAX_PLATFORMS env var; re-assert it so
     # CPU smoke runs work (the TPU driver leaves it unset/axon).
@@ -85,10 +82,12 @@ def main():
                 snapshot["partial"] = True
             line = json.dumps(snapshot)
             printed.set()
-        print(line, flush=True)
+            # print INSIDE the lock: the guard may os._exit immediately
+            # after observing printed — the line must be out by then
+            print(line, flush=True)
 
     def guard():
-        remaining = child_budget - (time.monotonic() - _T_CHILD_START) - 15
+        remaining = child_budget - (time.monotonic() - _T_PROC_START) - 15
         if remaining > 0 and printed.wait(timeout=remaining):
             return
         emit(final=False)
@@ -107,7 +106,7 @@ def main():
         try:
             import bench_tall
 
-            spent = time.monotonic() - _T_CHILD_START
+            spent = time.monotonic() - _T_PROC_START
             # the full-path number is what matters: it gets the budget
             # minus a small reserve; the kernel microbench below only
             # runs if time is left (its numbers also live in BENCH_r*
@@ -157,7 +156,7 @@ def main():
     except Exception as e:  # any malformed baseline file — keep the JSON flowing
         print(f"native baseline unavailable: {type(e).__name__}: {e}", file=sys.stderr)
 
-    if child_budget - (time.monotonic() - _T_CHILD_START) < 150:
+    if child_budget - (time.monotonic() - _T_PROC_START) < 150:
         # not enough room for the kernel microbench — ship what we have
         emit(final=True)
         return

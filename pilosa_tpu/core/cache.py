@@ -35,6 +35,25 @@ def sort_pairs(pairs: list[tuple[int, int]]) -> list[tuple[int, int]]:
     return sorted(pairs, key=lambda p: (-p[1], p[0]))
 
 
+class Rankings(list):
+    """Rankings snapshot (a list of (id, count) pairs) carrying its own
+    memo of per-slice id tuples. The memo lives ON the snapshot — not
+    on the cache — so a concurrent recalculate() swapping the cache's
+    rankings can never hand a caller ids inconsistent with the pairs
+    list it is iterating."""
+
+    def chunk_ids(self, lo: int, hi: int) -> tuple[int, ...]:
+        memo = getattr(self, "_memo", None)
+        if memo is None:
+            memo = self._memo = {}
+        t = memo.get((lo, hi))
+        if t is None:
+            # a racing duplicate build produces an identical tuple — benign
+            t = tuple(p[0] for p in self[lo:hi])
+            memo[(lo, hi)] = t
+        return t
+
+
 class RankCache:
     """Sorted top-K cache (reference rankCache, cache.go:136-286)."""
 
@@ -42,7 +61,7 @@ class RankCache:
         self.max_entries = max_entries
         self.threshold_buffer = int(THRESHOLD_FACTOR * max_entries)
         self.entries: dict[int, int] = {}
-        self.rankings: list[tuple[int, int]] = []
+        self.rankings: list[tuple[int, int]] = Rankings()
         self.threshold_value = 0
         self._update_time = 0.0
 
@@ -62,7 +81,7 @@ class RankCache:
 
     def remove(self, id_: int) -> None:
         if self.entries.pop(id_, None) is not None:
-            self.rankings = [p for p in self.rankings if p[0] != id_]
+            self.rankings = Rankings(p for p in self.rankings if p[0] != id_)
 
     def __len__(self) -> int:
         return len(self.entries)
@@ -84,7 +103,7 @@ class RankCache:
             rankings = rankings[: self.max_entries]
         else:
             self.threshold_value = 1
-        self.rankings = rankings
+        self.rankings = Rankings(rankings)
         self._update_time = time.monotonic()
         if len(self.entries) > self.threshold_buffer:
             for id_, _ in remove_items:
@@ -95,7 +114,7 @@ class RankCache:
 
     def clear(self) -> None:
         self.entries.clear()
-        self.rankings = []
+        self.rankings = Rankings()
         self.threshold_value = 0
         self._update_time = 0.0
 

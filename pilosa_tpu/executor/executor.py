@@ -1384,6 +1384,18 @@ class Executor:
             pool.shutdown(wait=False)
 
 
+def _chunk_ids(frag, pairs, lo: int, hi: int) -> tuple[int, ...]:
+    """Candidate ids for pairs[lo:hi]. Rankings snapshots memoize their
+    slice tuples on themselves (core.cache.Rankings), so repeated
+    queries don't rebuild multi-thousand-element tuples per shard per
+    query — and the memo can never disagree with the pairs list the
+    walk iterates, even across a concurrent cache recalculate."""
+    chunk = getattr(pairs, "chunk_ids", None)
+    if chunk is not None:
+        return chunk(lo, hi)
+    return tuple(p[0] for p in pairs[lo:hi])
+
+
 class _StackedLazyScores:
     """Cross-shard chunked lazy scoring: chunk k is scored for ALL
     shards in one sparse_intersection_counts_stacked dispatch the first
@@ -1409,7 +1421,8 @@ class _StackedLazyScores:
         self._next += 1
         lo, hi = k * self.CHUNK, (k + 1) * self.CHUNK
         ids_by_shard = tuple(
-            tuple(p[0] for p in ps[lo:hi]) for ps in self._pairs
+            _chunk_ids(frag, ps, lo, hi)
+            for frag, ps in zip(self._frags, self._pairs)
         )
         staged = self._ex.stager.sparse_rows_stacked(
             self._frags, ids_by_shard, self.CHUNK
@@ -1480,8 +1493,8 @@ class _LazyScores:
     def _score_chunk(self) -> None:
         # ids materialise per chunk, never as one huge tuple — on a 50k-
         # candidate cache only the chunks the walk reaches pay anything
-        ids = tuple(
-            p[0] for p in self._pairs[self._next : self._next + self.CHUNK]
+        ids = _chunk_ids(
+            self._frag, self._pairs, self._next, self._next + self.CHUNK
         )
         self._next += len(ids)
         frag = self._frag
