@@ -225,6 +225,25 @@ def run(deadline_s: float = 1e9) -> dict:
             chain_p50_ms=round(chain_p50, 2),
             platform=jax.devices()[0].platform,
         )
+        # serving throughput: 8 concurrent clients — pipelined round
+        # trips + the executor's continuous micro-batching; sequential
+        # qps on a tunneled chip is RTT-bound, this is the number a
+        # real serving deployment sees
+        if remaining() > 30:
+            from concurrent.futures import ThreadPoolExecutor
+
+            budget_c = min(remaining() - 15, 20)
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                t0 = time.perf_counter()
+                n = 0
+                while time.perf_counter() - t0 < budget_c:
+                    futs = [
+                        pool.submit(dev.execute, "tall", q) for q in topn
+                    ]
+                    for f in futs:
+                        f.result()
+                    n += len(topn)
+                out["topn_qps_c8"] = round(n / (time.perf_counter() - t0), 2)
         # CPU full-path baseline on a small sample (labelled: this is
         # this repo's Python roaring path, not the reference Go binary)
         if remaining() > 20:

@@ -1384,7 +1384,7 @@ class Executor:
             pool.shutdown(wait=False)
 
 
-def _chunk_ids(frag, pairs, lo: int, hi: int) -> tuple[int, ...]:
+def _chunk_ids(pairs, lo: int, hi: int) -> tuple[int, ...]:
     """Candidate ids for pairs[lo:hi]. Rankings snapshots memoize their
     slice tuples on themselves (core.cache.Rankings), so repeated
     queries don't rebuild multi-thousand-element tuples per shard per
@@ -1420,10 +1420,7 @@ class _StackedLazyScores:
         k = self._next
         self._next += 1
         lo, hi = k * self.CHUNK, (k + 1) * self.CHUNK
-        ids_by_shard = tuple(
-            _chunk_ids(frag, ps, lo, hi)
-            for frag, ps in zip(self._frags, self._pairs)
-        )
+        ids_by_shard = tuple(_chunk_ids(ps, lo, hi) for ps in self._pairs)
         staged = self._ex.stager.sparse_rows_stacked(
             self._frags, ids_by_shard, self.CHUNK
         )
@@ -1493,9 +1490,7 @@ class _LazyScores:
     def _score_chunk(self) -> None:
         # ids materialise per chunk, never as one huge tuple — on a 50k-
         # candidate cache only the chunks the walk reaches pay anything
-        ids = _chunk_ids(
-            self._frag, self._pairs, self._next, self._next + self.CHUNK
-        )
+        ids = _chunk_ids(self._pairs, self._next, self._next + self.CHUNK)
         self._next += len(ids)
         frag = self._frag
         occupied = frag.sparse_block_count(list(ids))

@@ -713,6 +713,11 @@ class Bitmap:
 
     def write_to(self, w) -> int:
         """Serialize in the reference's file format (roaring.go:543-613)."""
+        fast = getattr(self.containers, "serialize_clean", None)
+        if fast is not None:
+            n = fast(w)
+            if n is not None:
+                return n
         metas = []
         blobs = []
         for key, typ, cn, blob in self._iter_serialized():

@@ -250,3 +250,37 @@ class TestLazyHolderOpen:
         assert view.available_shards() == [0, 5]
         assert all(not fr._open for fr in view.fragments.values())
         h2.close()
+
+
+class TestSerializeClean:
+    def test_unmutated_store_streams_base_verbatim(self):
+        b = _random_bitmap(np.random.default_rng(60))
+        data = b.to_bytes()
+        lazy = Bitmap.unmarshal_mmap(data)
+        assert lazy.to_bytes() == data  # fast path: verbatim copy
+
+    def test_oplog_tail_not_copied(self):
+        import io
+
+        b = Bitmap()
+        b.add_no_oplog(5)
+        buf = io.BytesIO()
+        b.write_to(buf)
+        snapshot_len = len(buf.getvalue())
+        b2 = Bitmap.unmarshal_binary(buf.getvalue())
+        b2.op_writer = buf
+        b2.add(99)  # appends an op-log entry after the snapshot
+        lazy = Bitmap.unmarshal_mmap(buf.getvalue())
+        # ops replayed into the overlay -> fast path must NOT apply
+        out = lazy.to_bytes()
+        assert len(out) != snapshot_len or out != buf.getvalue()[:snapshot_len]
+        back = Bitmap.unmarshal_binary(out)
+        assert sorted(back) == [5, 99]
+
+    def test_mutated_store_falls_back(self):
+        b = _random_bitmap(np.random.default_rng(61))
+        lazy = Bitmap.unmarshal_mmap(b.to_bytes())
+        lazy.add_no_oplog((500 << 16) + 1)
+        out = lazy.to_bytes()
+        back = Bitmap.unmarshal_binary(out)
+        assert np.array_equal(back.slice_all(), lazy.slice_all())
