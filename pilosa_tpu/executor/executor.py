@@ -1384,6 +1384,16 @@ class Executor:
             pool.shutdown(wait=False)
 
 
+# Lazy-scoring chunk schedule, shared by both providers: a small head
+# (the walk usually prunes inside it) then large chunks for deep walks.
+FIRST_CHUNK = 512
+SCORE_CHUNK = 4096
+
+
+def _chunk_size(pos: int) -> int:
+    return FIRST_CHUNK if pos == 0 else SCORE_CHUNK
+
+
 def _chunk_ids(pairs, lo: int, hi: int) -> tuple[int, ...]:
     """Candidate ids for pairs[lo:hi]. Rankings snapshots memoize their
     slice tuples on themselves (core.cache.Rankings), so repeated
@@ -1397,13 +1407,18 @@ def _chunk_ids(pairs, lo: int, hi: int) -> tuple[int, ...]:
 
 
 class _StackedLazyScores:
-    """Cross-shard chunked lazy scoring: chunk k is scored for ALL
-    shards in one sparse_intersection_counts_stacked dispatch the first
-    time any shard's walk reads past chunk k-1. Chunk staging keys are
-    content-derived (the per-shard candidate id tuples), so repeated
-    queries reuse the HBM-resident blocks."""
+    """Cross-shard chunked lazy scoring: the next chunk is scored for
+    ALL shards in one sparse_intersection_counts_stacked dispatch the
+    first time any shard's walk reads past the scored prefix. Chunk
+    staging keys are content-derived (the per-shard candidate id
+    tuples), so repeated queries reuse the HBM-resident blocks.
 
-    CHUNK = 4096
+    The FIRST chunk is small: on skewed data the walk prunes within the
+    hot head (reference threshold break, fragment.go:969), so staging
+    4096 candidates x S shards up front wastes HBM upload — at the 1B
+    scale that is the difference between ~0.5 GB and ~2.3 GB of cold
+    staging. Later chunks grow to amortize dispatch count on deep
+    walks."""
 
     def __init__(self, ex, frags, pairs_by_shard, srcs) -> None:
         self._ex = ex
@@ -1411,18 +1426,17 @@ class _StackedLazyScores:
         self._pairs = pairs_by_shard
         self._srcs = srcs
         self._scores: list[dict[int, int]] = [{} for _ in frags]
-        self._next = 0
-        self._chunks = max(
-            (len(p) + self.CHUNK - 1) // self.CHUNK for p in pairs_by_shard
-        )
+        self._pos = 0  # scored prefix length (per shard)
+        self._max_len = max((len(p) for p in pairs_by_shard), default=0)
 
     def _score_next(self) -> None:
-        k = self._next
-        self._next += 1
-        lo, hi = k * self.CHUNK, (k + 1) * self.CHUNK
+        lo = self._pos
+        size = _chunk_size(lo)
+        hi = lo + size
+        self._pos = hi
         ids_by_shard = tuple(_chunk_ids(ps, lo, hi) for ps in self._pairs)
         staged = self._ex.stager.sparse_rows_stacked(
-            self._frags, ids_by_shard, self.CHUNK
+            self._frags, ids_by_shard, size
         )
         if staged is None:  # no shard contributed blocks — all score 0
             for i, ids in enumerate(ids_by_shard):
@@ -1435,7 +1449,7 @@ class _StackedLazyScores:
             )
         )
         for i, ids in enumerate(ids_by_shard):
-            base = i * self.CHUNK
+            base = i * size
             self._scores[i].update(
                 (rid, int(scores[base + j])) for j, rid in enumerate(ids)
             )
@@ -1454,7 +1468,7 @@ class _ShardScoreView:
     def __getitem__(self, row_id: int) -> int:
         p = self._p
         sc = p._scores[self._i]
-        while row_id not in sc and p._next < p._chunks:
+        while row_id not in sc and p._pos < p._max_len:
             p._score_next()
         return sc[row_id]
 
@@ -1474,10 +1488,10 @@ class _LazyScores:
         so repeated queries hit the stager's HBM cache;
       * each chunk independently picks block-sparse vs dense staging by
         container occupancy (sparse wins below half-full);
-      * dense chunks still coalesce through the BatchedScorer.
+      * dense chunks still coalesce through the BatchedScorer;
+      * the first chunk is small (the walk usually prunes within the
+        hot head — see _StackedLazyScores), later ones grow.
     """
-
-    CHUNK = 4096
 
     def __init__(self, ex, frag, pairs, src_words) -> None:
         self._ex = ex
@@ -1490,8 +1504,9 @@ class _LazyScores:
     def _score_chunk(self) -> None:
         # ids materialise per chunk, never as one huge tuple — on a 50k-
         # candidate cache only the chunks the walk reaches pay anything
-        ids = _chunk_ids(self._pairs, self._next, self._next + self.CHUNK)
-        self._next += len(ids)
+        size = _chunk_size(self._next)
+        ids = _chunk_ids(self._pairs, self._next, self._next + size)
+        self._next += size
         frag = self._frag
         occupied = frag.sparse_block_count(list(ids))
         if occupied * 2 < len(ids) * (SHARD_WIDTH >> 16):
