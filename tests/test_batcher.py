@@ -248,3 +248,33 @@ def test_stager_concurrent_cold_miss_stages_once(tmp_path):
     ent_bytes = sum(nb for _, nb in st._cache.values())
     assert st._bytes == ent_bytes  # budget charged exactly once
     h.close()
+
+
+class TestRankingsMemo:
+    def test_chunk_ids_consistent_across_recalculate(self):
+        """A provider holding a rankings snapshot must get ids for THAT
+        snapshot even if the cache recalculates concurrently."""
+        from pilosa_tpu.core.cache import RankCache
+
+        c = RankCache(100)
+        for i in range(20):
+            c.bulk_add(i, 100 - i)
+        c.recalculate()
+        snap = c.top()
+        want = tuple(p[0] for p in snap[0:8])
+        assert snap.chunk_ids(0, 8) == want
+        # cache swaps rankings; the old snapshot's memo still matches it
+        c.bulk_add(55, 999)
+        c.recalculate()
+        assert c.top() is not snap
+        assert snap.chunk_ids(0, 8) == want  # memo hit, same object data
+        new = c.top()
+        assert new.chunk_ids(0, 1) == (55,)
+
+    def test_memoization_returns_same_tuple(self):
+        from pilosa_tpu.core.cache import Rankings
+
+        r = Rankings([(5, 9), (3, 7), (1, 2)])
+        a = r.chunk_ids(0, 2)
+        assert a is r.chunk_ids(0, 2)
+        assert r.chunk_ids(2, 10) == (1,)
