@@ -33,6 +33,16 @@ import numpy as np
 REPO = os.path.dirname(os.path.abspath(__file__))
 CACHE_DIR = os.path.join(REPO, ".bench_cache", "tall_v1")
 
+
+def _effective_cache_dir(rows_per_shard: int) -> str:
+    """Non-default scales (dev smokes) get their OWN directory — a smoke
+    run must never wipe the 18 GB default-scale dataset. An explicitly
+    overridden CACHE_DIR (the gauntlet points it at a tmp dir) is used
+    as-is."""
+    if rows_per_shard != ROWS_PER_SHARD and CACHE_DIR.endswith("tall_v1"):
+        return CACHE_DIR + f"_rps{rows_per_shard}"
+    return CACHE_DIR
+
 SHARDS_DEFAULT = 64
 ROWS_PER_SHARD = 15_625_000  # x64 shards = 1.0e9 rows
 HOT_ROWS = 32
@@ -72,17 +82,18 @@ def build_data(
     from pilosa_tpu.roaring import build_fragment_file
 
     t0 = time.monotonic()
+    cache_dir = _effective_cache_dir(rows_per_shard)
     # a cache built at a different scale is a different dataset — rebuild
-    meta_path = os.path.join(CACHE_DIR, "build_meta.json")
+    meta_path = os.path.join(cache_dir, "build_meta.json")
     meta = {"rows_per_shard": rows_per_shard, "v": 2}
     try:
         with open(meta_path) as f:
             if json.load(f) != meta:
-                shutil.rmtree(CACHE_DIR)
+                shutil.rmtree(cache_dir)
     except (OSError, ValueError):
-        if os.path.isdir(CACHE_DIR):
-            shutil.rmtree(CACHE_DIR)
-    vdir = os.path.join(CACHE_DIR, "tall", "f", "views", "standard", "fragments")
+        if os.path.isdir(cache_dir):
+            shutil.rmtree(cache_dir)
+    vdir = os.path.join(cache_dir, "tall", "f", "views", "standard", "fragments")
     os.makedirs(vdir, exist_ok=True)
     with open(meta_path, "w") as f:
         json.dump(meta, f)
@@ -171,7 +182,7 @@ def run(deadline_s: float = 1e9) -> dict:
     from pilosa_tpu.core import Holder
     from pilosa_tpu.executor import Executor
 
-    h = Holder(CACHE_DIR)
+    h = Holder(_effective_cache_dir(rows_per_shard))
     t_open = time.monotonic()
     h.open()
     dev = Executor(h, device_policy="always")

@@ -12,6 +12,7 @@ from __future__ import annotations
 import argparse
 import csv
 import json
+import os
 import signal
 import sys
 import time
@@ -255,19 +256,27 @@ def cmd_export(args) -> int:
     return 0
 
 
-def cmd_check(args) -> int:
-    """Verify fragment file integrity (reference ctl/check.go)."""
+def _open_lazy(path):
+    """Mmap-open a roaring file: check/inspect of a 1B-scale fragment
+    (~15.6M containers) must stream, not materialize one Python object
+    per container. Same open semantics as the fragment runtime."""
     from pilosa_tpu.roaring import Bitmap
 
+    return Bitmap.open_mmap_file(path)
+
+
+def cmd_check(args) -> int:
+    """Verify fragment file integrity (reference ctl/check.go)."""
     rc = 0
     for path in args.files:
         if path.endswith(".cache") or path.endswith(".snapshotting"):
             continue
         try:
-            with open(path, "rb") as f:
-                b = Bitmap.unmarshal_binary(f.read())
-            # container-level invariants
-            for key in b.sorted_keys():
+            b = _open_lazy(path)
+            # container-level invariants (streaming: one ephemeral
+            # decode at a time)
+            n_containers = 0
+            for key in b._iter_keys_sorted():
                 c = b.containers[key]
                 p = c.positions()
                 if p.size != c.n:
@@ -276,7 +285,8 @@ def cmd_check(args) -> int:
                     )
                 if p.size > 1 and not (p[:-1] < p[1:]).all():
                     raise ValueError(f"container {key}: positions not sorted/unique")
-            print(f"{path}: ok (bits={b.count()}, containers={len(b.containers)}, ops={b.op_n})")
+                n_containers += 1
+            print(f"{path}: ok (bits={b.count()}, containers={n_containers}, ops={b.op_n})")
         except Exception as e:
             print(f"{path}: FAILED: {e}", file=sys.stderr)
             rc = 1
@@ -285,15 +295,12 @@ def cmd_check(args) -> int:
 
 def cmd_inspect(args) -> int:
     """Dump container layout (reference ctl/inspect.go)."""
-    from pilosa_tpu.roaring import Bitmap
-
     names = {1: "array", 2: "bitmap", 3: "run"}
     for path in args.files:
-        with open(path, "rb") as f:
-            b = Bitmap.unmarshal_binary(f.read())
+        b = _open_lazy(path)
         print(f"{path}: bits={b.count()} containers={len(b.containers)} opN={b.op_n}")
         print(f"{'KEY':>12} {'TYPE':>8} {'N':>8} {'SIZE':>8}")
-        for key in b.sorted_keys():
+        for key in b._iter_keys_sorted():
             c = b.containers[key]
             print(f"{key:>12} {names.get(c.typ, '?'):>8} {c.n:>8} {c.size():>8}")
     return 0

@@ -143,12 +143,9 @@ class Fragment:
         replays into the overlay (reference openStorage,
         fragment.go:167-224). The mmap stays alive for as long as the
         storage references it (numpy buffer export); no explicit close."""
-        size = os.path.getsize(self.path)
-        if size == 0:
+        if os.path.getsize(self.path) == 0:
             return
-        with open(self.path, "rb") as f:
-            mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
-        self.storage = Bitmap.unmarshal_mmap(mm)
+        self.storage = Bitmap.open_mmap_file(self.path)
         self.op_n = self.storage.op_n
 
     def close(self) -> None:
@@ -191,8 +188,13 @@ class Fragment:
         between calls can change the index length."""
         occ = self._occ
         if occ is None or occ[0] != self.generation:
+            # capture the generation BEFORE reading: if a writer bumps
+            # it mid-read we cache under the OLD tag and refresh on the
+            # next call, instead of pinning a stale snapshot to the new
+            # generation
+            gen = self.generation
             keys, cs = self.storage.occupancy()
-            self._occ = occ = (self.generation, keys, cs)
+            self._occ = occ = (gen, keys, cs)
         _, keys, cs = occ
         first = row_ids.astype(np.uint64) * np.uint64(SHARD_WIDTH >> 16)
         last = (row_ids.astype(np.uint64) + np.uint64(1)) * np.uint64(

@@ -753,6 +753,21 @@ class Bitmap:
         return b
 
     @classmethod
+    def open_mmap_file(cls, path: str) -> "Bitmap":
+        """Mmap a roaring file and parse it lazily (empty file → empty
+        bitmap). Shared by the fragment open path and the check/inspect
+        CLI — one place for the open semantics. The map stays alive for
+        as long as the returned bitmap references it."""
+        import mmap as _mmap
+        import os as _os
+
+        if _os.path.getsize(path) == 0:
+            return cls()
+        with open(path, "rb") as f:
+            mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+        return cls.unmarshal_mmap(mm)
+
+    @classmethod
     def unmarshal_mmap(cls, buf) -> "Bitmap":
         """Lazy-parse the reference file format over a buffer (mmap):
         the header becomes numpy views over the map, payloads decode on

@@ -351,16 +351,19 @@ class MmapContainers:
     # -- bulk fast paths -----------------------------------------------------
 
     def total_count(self) -> int:
-        """Sum of container cardinalities without decoding payloads."""
+        """Sum of container cardinalities without decoding payloads.
+        Lockless-reader safe: overlay/deleted are snapshotted with
+        single C-level copies before iteration (a concurrent writer
+        holds the fragment lock, readers do not)."""
         ns = self.metas["n"].astype(np.int64) + 1
         total = int(ns.sum())
-        if self._deleted:
-            keys = self.metas["key"]
-            for k in self._deleted:
+        deleted = tuple(self._deleted)
+        if deleted:
+            for k in deleted:
                 i = self._bisect(k)
                 if i >= 0:
                     total -= int(ns[i])
-        for k, c in self.overlay.items():
+        for k, c in dict(self.overlay).items():
             i = self._bisect(k)
             if i >= 0:
                 total -= int(ns[i])
@@ -372,16 +375,21 @@ class MmapContainers:
         merged store — one streaming pass, O(N) transient."""
         keys = np.ascontiguousarray(self.metas["key"])
         ns = self.metas["n"].astype(np.uint32) + 1
-        if self._deleted or self.overlay:
+        # one atomic snapshot each — lockless readers race writers, and
+        # building keys/counts from the LIVE dict in separate passes
+        # could yield arrays of different lengths
+        ov = dict(self.overlay)
+        deleted = set(self._deleted)
+        if deleted or ov:
             # mask out deleted + shadowed base entries
-            shadow = self._deleted | set(self.overlay)
+            shadow = deleted | set(ov)
             if shadow:
                 mask = ~np.isin(keys, np.fromiter(shadow, dtype=np.uint64))
                 keys, ns = keys[mask], ns[mask]
-            if self.overlay:
-                ok = np.fromiter(self.overlay.keys(), dtype=np.uint64)
+            if ov:
+                ok = np.fromiter(ov.keys(), dtype=np.uint64)
                 on = np.fromiter(
-                    (c.n for c in self.overlay.values()), dtype=np.uint32
+                    (c.n for c in ov.values()), dtype=np.uint32
                 )
                 keys = np.concatenate([keys, ok])
                 ns = np.concatenate([ns, on])

@@ -284,3 +284,52 @@ class TestSerializeClean:
         out = lazy.to_bytes()
         back = Bitmap.unmarshal_binary(out)
         assert np.array_equal(back.slice_all(), lazy.slice_all())
+
+
+class TestConcurrentMmapFragment:
+    def test_readers_and_writers_race(self, tmp_path):
+        """Concurrent point writes + reads on an mmap-backed fragment:
+        no exceptions, and the final state contains every written bit
+        (the overlay/occupancy caches must stay coherent under the
+        fragment lock)."""
+        import threading
+
+        p = str(tmp_path / "frag")
+        f = Fragment(p, "i", "f", "standard", 0)
+        f.open()
+        f.bulk_import(list(range(64)), list(range(64)))
+        f.close()
+        f2 = Fragment(p, "i", "f", "standard", 0)
+        f2.open()
+        errors = []
+        stop = threading.Event()
+
+        def writer(tid):
+            try:
+                for i in range(300):
+                    f2.set_bit(1000 + tid, i * 7 % SHARD_WIDTH)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    f2.row(3).count()
+                    f2.row_counts_for(np.arange(8, dtype=np.uint64))
+                    f2.sparse_block_count([1000, 1001, 5])
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        ws = [threading.Thread(target=writer, args=(t,)) for t in range(4)]
+        rs = [threading.Thread(target=reader) for _ in range(3)]
+        for t in rs + ws:
+            t.start()
+        for t in ws:
+            t.join()
+        stop.set()
+        for t in rs:
+            t.join()
+        assert not errors, errors[:3]
+        for tid in range(4):
+            assert f2.row(1000 + tid).count() == len({i * 7 % SHARD_WIDTH for i in range(300)})
+        f2.close()
