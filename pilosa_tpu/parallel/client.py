@@ -9,6 +9,8 @@ import urllib.parse
 import urllib.request
 from typing import Any, Optional
 
+from pilosa_tpu.utils import privateproto
+
 
 class ClientError(Exception):
     """A failed node-to-node request.
@@ -38,11 +40,12 @@ class InternalClient:
         body: Optional[bytes] = None,
         query: Optional[dict] = None,
         raw: bool = False,
+        headers: Optional[dict] = None,
     ):
         url = uri + path
         if query:
             url += "?" + urllib.parse.urlencode(query)
-        req = urllib.request.Request(url, data=body, method=method)
+        req = urllib.request.Request(url, data=body, method=method, headers=headers or {})
         try:
             with urllib.request.urlopen(
                 req, timeout=self.timeout, context=self.ssl_context
@@ -230,8 +233,18 @@ class InternalClient:
     # -- control messages (reference SendMessage, http/client.go:822) --
 
     def send_message(self, uri: str, msg: dict) -> None:
+        # control plane rides the reference's protobuf envelope
+        # (broadcast.go:71-113); JSON remains the debug fallback for
+        # message shapes with no wire mapping
+        if privateproto.encodable(msg):
+            body, headers = (
+                privateproto.marshal_message(msg),
+                {"Content-Type": privateproto.CONTENT_TYPE},
+            )
+        else:
+            body, headers = json.dumps(msg).encode(), None
         self._request(
-            "POST", uri, "/internal/cluster/message", body=json.dumps(msg).encode()
+            "POST", uri, "/internal/cluster/message", body=body, headers=headers
         )
 
     # -- misc --

@@ -19,7 +19,7 @@ from urllib.parse import parse_qs, urlparse
 from pilosa_tpu.core import Row
 from pilosa_tpu.executor import ValCount
 from pilosa_tpu.server.api import API, APIError
-from pilosa_tpu.utils import publicproto
+from pilosa_tpu.utils import privateproto, publicproto
 from pilosa_tpu.utils.stats import NOP_STATS
 
 
@@ -316,7 +316,19 @@ class Handler:
         return {}
 
     def post_cluster_message(self, req) -> dict:
-        self.api.cluster_message(json.loads(req.body or b"{}"))
+        if privateproto.CONTENT_TYPE in req.headers.get("content-type", ""):
+            try:
+                msg = privateproto.unmarshal_message(req.body or b"")
+            except APIError:
+                raise
+            except Exception as e:
+                # any decode failure is malformed input (wire-type
+                # confusion raises TypeError/AttributeError, not just
+                # ValueError) — it must 400, never execute or 500
+                raise APIError(f"unmarshaling message: {e}", 400)
+        else:
+            msg = json.loads(req.body or b"{}")
+        self.api.cluster_message(msg)
         return {}
 
     def get_fragment_nodes(self, req) -> list:

@@ -453,6 +453,34 @@ class TestURI:
         with pytest.raises(ValueError):
             URI.from_address("")
 
+    def test_parse_ipv6_and_validation(self):
+        # bracketed IPv6 literal (reference uri.go:29 hostRegexp)
+        u = URI.from_address("[fd42:4201::ed80]:9999")
+        assert (u.host, u.port) == ("[fd42:4201::ed80]", 9999)
+        # scheme-only spelling is valid, everything defaults
+        u = URI.from_address("https://")
+        assert (u.scheme, u.host, u.port) == ("https", "localhost", 10101)
+        for bad in ("foo bar", "host:port", "http://host:99999", "UPPER.example"):
+            with pytest.raises(ValueError):
+                URI.from_address(bad)
+        u = URI()
+        with pytest.raises(ValueError):
+            u.set_scheme("h ttp")
+        with pytest.raises(ValueError):
+            u.set_host("bad_host!")
+
+    def test_normalize_and_path(self):
+        # a '+'-qualified scheme normalizes to its base for HTTP clients
+        u = URI.from_address("https+pb://example.com:8080")
+        assert str(u) == "https+pb://example.com:8080"
+        assert u.normalize() == "https://example.com:8080"
+        assert u.path("/status") == "https://example.com:8080/status"
+        assert u.host_port() == "example.com:8080"
+
+    def test_dict_round_trip(self):
+        u = URI.from_address("https://example.com:8080")
+        assert URI.from_dict(u.to_dict()) == u
+
 
 class TestAttrSync:
     def test_attr_diff_converges(self, tmp_path):

@@ -1,0 +1,233 @@
+"""Control-plane protobuf envelope (reference broadcast.go:52-158,
+internal/private.proto): every cluster message round-trips through the
+1-byte-type + protobuf-body wire form, and the HTTP endpoint accepts it."""
+
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.utils import privateproto as pp
+
+
+SCHEMA = [
+    {
+        "name": "idx",
+        "keys": True,
+        "fields": [
+            {
+                "name": "f",
+                "options": {
+                    "type": "int",
+                    "cacheType": "ranked",
+                    "cacheSize": 50000,
+                    "min": -250,
+                    "max": 1000,
+                    "timeQuantum": "",
+                    "keys": False,
+                },
+                "views": ["bsig_f"],
+            },
+            {
+                "name": "t",
+                "options": {
+                    "type": "time",
+                    "cacheType": "ranked",
+                    "cacheSize": 50000,
+                    "min": 0,
+                    "max": 0,
+                    "timeQuantum": "YMD",
+                    "keys": True,
+                },
+                "views": ["standard", "standard_2017"],
+            },
+        ],
+    }
+]
+
+NODES = [
+    {"id": "n0", "uri": "http://127.0.0.1:10101", "isCoordinator": True, "state": "READY"},
+    {"id": "n1", "uri": "https://10.0.0.2:9999", "isCoordinator": False, "state": "DOWN"},
+]
+
+MESSAGES = [
+    {"type": "create-shard", "index": "idx", "shard": 37},
+    {"type": "create-index", "index": "idx", "keys": True},
+    {"type": "create-index", "index": "idx", "keys": False},
+    {"type": "delete-index", "index": "idx"},
+    {
+        "type": "create-field",
+        "index": "idx",
+        "field": "f",
+        "options": SCHEMA[0]["fields"][0]["options"],
+    },
+    {"type": "delete-field", "index": "idx", "field": "f"},
+    {"type": "create-view", "index": "idx", "field": "f", "view": "standard_2017"},
+    {"type": "delete-view", "index": "idx", "field": "f", "view": "standard_2017"},
+    {
+        "type": "cluster-status",
+        "state": "NORMAL",
+        "nodes": NODES,
+        "schema": SCHEMA,
+        "maxShards": {"idx": 63, "other": 0},
+    },
+    {
+        "type": "resize-instruction",
+        "job": 3,
+        "coordinator": "http://127.0.0.1:10101",
+        "schema": SCHEMA,
+        "sources": [
+            {
+                "index": "idx",
+                "field": "f",
+                "view": "standard",
+                "shard": 5,
+                "from_uri": "http://127.0.0.1:10102",
+            }
+        ],
+        "node": NODES[1],
+        "new_nodes": NODES,
+    },
+    {"type": "resize-complete", "job": 3, "node_id": "n1", "ok": True},
+    {"type": "resize-complete", "job": 3, "node_id": "n1", "ok": False, "error": "boom"},
+    {"type": "set-coordinator", "node": NODES[0]},
+    {"type": "update-coordinator", "node": NODES[0]},
+    {"type": "node-state", "node_id": "n1", "state": "READY"},
+    {"type": "recalculate-caches"},
+    {"type": "node-join", "node": NODES[1]},
+    {"type": "node-status", "node_id": "n0", "schema": SCHEMA, "maxShards": {"idx": 12}},
+    {"type": "holder-clean"},
+    {"type": "schema", "schema": SCHEMA},
+]
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("msg", MESSAGES, ids=lambda m: m["type"] + (":err" if m.get("error") else ""))
+    def test_round_trip(self, msg):
+        buf = pp.marshal_message(msg)
+        out = pp.unmarshal_message(buf)
+        # every key the sender set must survive the wire
+        for k, v in msg.items():
+            assert out[k] == v, (k, out.get(k), v)
+
+    def test_envelope_bytes_match_reference(self):
+        # broadcast.go:52-68 iota numbering
+        assert pp.marshal_message({"type": "create-shard", "index": "i", "shard": 0})[0] == 0
+        assert pp.marshal_message({"type": "create-index", "index": "i"})[0] == 1
+        assert pp.marshal_message({"type": "delete-index", "index": "i"})[0] == 2
+        assert pp.marshal_message({"type": "cluster-status", "state": "NORMAL", "nodes": []})[0] == 7
+        assert pp.marshal_message({"type": "recalculate-caches"})[0] == 13
+        assert pp.marshal_message({"type": "node-join", "node": NODES[0]})[0] == 14
+
+    def test_unknown_type_rejected(self):
+        with pytest.raises(KeyError):
+            pp.marshal_message({"type": "no-such-message"})
+        with pytest.raises(ValueError):
+            pp.unmarshal_message(b"")
+        with pytest.raises(ValueError):
+            pp.unmarshal_message(bytes([250]) + b"\x00")
+
+    def test_truncated_body_rejected(self):
+        buf = pp.marshal_message(
+            {"type": "cluster-status", "state": "NORMAL", "nodes": NODES, "schema": SCHEMA}
+        )
+        with pytest.raises(ValueError):
+            pp.unmarshal_message(buf[: len(buf) - 4])
+
+    def test_lenient_node_addresses_encode(self):
+        # addresses already in a topology must encode even when they
+        # would fail strict URI validation (underscore hosts etc.)
+        msg = {
+            "type": "node-join",
+            "node": {"id": "n9", "uri": "http://pilosa_node_1:10101", "isCoordinator": False},
+        }
+        out = pp.unmarshal_message(pp.marshal_message(msg))
+        assert out["node"]["uri"] == "http://pilosa_node_1:10101"
+
+    def test_wire_type_confusion_raises_value_error_shape(self):
+        # field 4 of Index encoded as varint instead of length-delimited:
+        # must raise (any exception type), never return a half-decoded dict
+        bad_schema = bytes([pp.MSG_SCHEMA]) + bytes([0x0A, 0x02, 0x20, 0x05])
+        with pytest.raises(Exception):
+            pp.unmarshal_message(bad_schema)
+
+    def test_negative_bsi_bounds_survive(self):
+        msg = {
+            "type": "create-field",
+            "index": "i",
+            "field": "f",
+            "options": {
+                "type": "int",
+                "cacheType": "ranked",
+                "cacheSize": 50000,
+                "min": -(2**40),
+                "max": 2**40,
+                "timeQuantum": "",
+                "keys": False,
+            },
+        }
+        out = pp.unmarshal_message(pp.marshal_message(msg))
+        assert out["options"]["min"] == -(2**40)
+        assert out["options"]["max"] == 2**40
+
+
+class TestWireIntegration:
+    def test_endpoint_accepts_protobuf(self, tmp_path):
+        from tests.test_cluster import boot_static_cluster
+
+        servers = boot_static_cluster(tmp_path, n=1)
+        try:
+            s = servers[0]
+            buf = pp.marshal_message({"type": "create-index", "index": "pbidx", "keys": False})
+            r = urllib.request.Request(
+                s.uri + "/internal/cluster/message",
+                data=buf,
+                method="POST",
+                headers={"Content-Type": pp.CONTENT_TYPE},
+            )
+            with urllib.request.urlopen(r, timeout=30) as resp:
+                assert resp.status == 200
+            assert s.holder.index("pbidx") is not None
+            # malformed protobuf must 400, not execute
+            bad = urllib.request.Request(
+                s.uri + "/internal/cluster/message",
+                data=bytes([250, 1, 2]),
+                method="POST",
+                headers={"Content-Type": pp.CONTENT_TYPE},
+            )
+            try:
+                with urllib.request.urlopen(bad, timeout=30) as resp:
+                    raise AssertionError(f"expected 400, got {resp.status}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_cluster_converges_over_protobuf_plane(self, tmp_path, monkeypatch):
+        """The schema broadcast between live nodes must actually travel
+        as protobuf (assert on the client's chosen encoding), and the
+        peer must apply it."""
+        from pilosa_tpu.parallel.client import InternalClient
+        from tests.test_cluster import boot_static_cluster, req
+
+        sent_types = []
+        orig = InternalClient._request
+
+        def spy(self, method, uri, path, body=None, query=None, raw=False, headers=None):
+            if path == "/internal/cluster/message":
+                sent_types.append((headers or {}).get("Content-Type", "json"))
+            return orig(self, method, uri, path, body=body, query=query, raw=raw, headers=headers)
+
+        monkeypatch.setattr(InternalClient, "_request", spy)
+        servers = boot_static_cluster(tmp_path, n=2)
+        try:
+            s0, s1 = servers
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            assert s1.holder.index("i") is not None
+            assert s1.holder.field("i", "f") is not None
+            assert sent_types and all(t == pp.CONTENT_TYPE for t in sent_types)
+        finally:
+            for s in servers:
+                s.close()
