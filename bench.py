@@ -156,8 +156,12 @@ def main():
     except Exception as e:  # any malformed baseline file — keep the JSON flowing
         print(f"native baseline unavailable: {type(e).__name__}: {e}", file=sys.stderr)
 
-    if child_budget - (time.monotonic() - _T_PROC_START) < 150:
-        # not enough room for the kernel microbench — ship what we have
+    if child_budget - (time.monotonic() - _T_PROC_START) < 210:
+        # Not enough room for the kernel microbench (measured ~160 s
+        # warm: matrix build + compile + three paths) — ship the
+        # complete tall headline rather than risk the deadline guard
+        # marking the whole line partial over the secondary numbers.
+        result["kernel_bench"] = "skipped (budget)"
         emit(final=True)
         return
 

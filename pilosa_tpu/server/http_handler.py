@@ -319,8 +319,6 @@ class Handler:
         if privateproto.CONTENT_TYPE in req.headers.get("content-type", ""):
             try:
                 msg = privateproto.unmarshal_message(req.body or b"")
-            except APIError:
-                raise
             except Exception as e:
                 # any decode failure is malformed input (wire-type
                 # confusion raises TypeError/AttributeError, not just

@@ -179,12 +179,18 @@ def _enc_uri_str(addr: str) -> bytes:
     rest = addr or ""
     if "://" in rest:
         scheme, rest = rest.split("://", 1)
-    if ":" in rest:
-        rest, _, p = rest.rpartition(":")
+    if rest.startswith("["):  # bracketed IPv6, optional :port
+        body, _, p = rest.partition("]")
+        if p.startswith(":") and p[1:].isdigit():
+            port = int(p[1:])
+        rest = body + "]"
+    elif rest.count(":") == 1:  # host:port
+        h, _, p = rest.partition(":")
         if p.isdigit():
             port = int(p)
-        else:  # bare IPv6 literal with no port
-            rest = f"{rest}:{p}"
+            rest = h
+    # else: zero colons (plain host) or 2+ colons (bare IPv6 literal,
+    # digits-only final group included — never split a port off it)
     if rest:
         host = rest
     out = bytearray()

@@ -111,6 +111,51 @@ class TestSparseTopN:
         assert len(dev._tree_jits) == 1
         h.close()
 
+    def test_pass2_reuses_pass1_scores(self, tmp_path, monkeypatch):
+        """TopN's exact-count pass must not re-dispatch scoring for ids
+        pass 1 already scored — on a tunneled chip that second round
+        trip is half the query latency."""
+        import pilosa_tpu.ops as ops_mod
+
+        # skewed fixture: a dozen hot rows with distinct high overlap
+        # vs a count-1 tail, so the ranked walk's threshold break
+        # prunes inside the head chunk (the 1B-bench shape)
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        fld = h.create_index("i").create_field("f")
+        rows, cols = [], []
+        for r in range(12):
+            k = 200 + r * 50
+            rows += [r] * k
+            cols += ((np.arange(k) * (r + 3)) % SHARD_WIDTH).tolist()
+        for r in range(300):  # singleton tail
+            rows.append(100 + r)
+            cols.append((r * 7919) % SHARD_WIDTH)
+        fld.import_bits(rows, cols)
+        cpu = Executor(h, device_policy="never")
+        dev = Executor(h, device_policy="always")
+        q = "TopN(f, Row(f=0), n=5)"
+        want = cpu.execute("i", q)
+        dev.execute("i", q)  # warm staging + compile
+
+        calls = []
+        for name in (
+            "sparse_intersection_counts_stacked",
+            "sparse_intersection_counts",
+        ):
+            orig = getattr(ops_mod, name)
+
+            def spy(*a, _orig=orig, _name=name, **kw):
+                calls.append(_name)
+                return _orig(*a, **kw)
+
+            monkeypatch.setattr(ops_mod, name, spy)
+        got = dev.execute("i", q)
+        assert got == want
+        # one scoring dispatch for pass 1; pass 2 served from the carry
+        assert len(calls) == 1
+        h.close()
+
     def test_dense_fragment_keeps_dense_path(self, tmp_path):
         h = Holder(str(tmp_path / "dense"))
         h.open()

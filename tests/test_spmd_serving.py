@@ -102,6 +102,34 @@ def test_spmd_kernels_reached(spmd_exec):
     assert {"count", "plane_counts", "topn_scores"} <= kinds
 
 
+def test_spmd_pass2_reuses_pass1_scores(cpu_exec, spmd_exec, monkeypatch):
+    """TopN pass 2 must be served from the cross-pass score carry on
+    the mesh path too — pass 1 scores every cache candidate, so the
+    exact-count pass never needs a second shard_map dispatch."""
+    q = "TopN(general, Row(general=1), n=5)"
+    want = cpu_exec.execute("i", q)
+    spmd_exec.execute("i", q)  # warm staging + compile
+
+    calls = []
+    orig = spmd_exec._spmd_kernel
+
+    def spy(kind, *statics):
+        fn = orig(kind, *statics)
+        if kind != "topn_scores":
+            return fn
+
+        def wrapped(*a, **kw):
+            calls.append(kind)
+            return fn(*a, **kw)
+
+        return wrapped
+
+    monkeypatch.setattr(spmd_exec, "_spmd_kernel", spy)
+    got = spmd_exec.execute("i", q)
+    assert _normalize(got) == _normalize(want)
+    assert calls == ["topn_scores"]  # pass 1 only
+
+
 def test_stack_is_mesh_sharded(spmd_exec, mesh):
     """Staged shard stacks carry a NamedSharding over the mesh axis."""
     spmd_exec.execute("i", "Count(Row(general=1))")
