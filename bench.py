@@ -49,8 +49,11 @@ def main():
     # The image's sitecustomize force-sets jax_platforms to the TPU
     # backend, overriding the JAX_PLATFORMS env var; re-assert it so
     # CPU smoke runs work (the TPU driver leaves it unset/axon).
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # honor JAX_PLATFORMS over the image's sitecustomize pinning, and
+    # persist XLA compiles so a cold driver run pays them only once
+    from pilosa_tpu.utils.jaxplatform import bootstrap
+
+    bootstrap()
 
     import os
 

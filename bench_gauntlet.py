@@ -431,9 +431,9 @@ def bench_tall_scaled(tmp, scale):
 
 
 def main():
-    from pilosa_tpu.utils.jaxplatform import honor_platform_env
+    from pilosa_tpu.utils.jaxplatform import bootstrap
 
-    honor_platform_env()
+    bootstrap()
     scale = int(os.environ.get("PILOSA_GAUNTLET_SCALE", 1))
     all_ok = True
     t0 = time.time()

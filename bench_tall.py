@@ -278,9 +278,8 @@ def run(deadline_s: float = 1e9) -> dict:
 
 
 if __name__ == "__main__":
-    import jax
+    from pilosa_tpu.utils.jaxplatform import bootstrap
 
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    bootstrap()
     deadline = float(os.environ.get("PILOSA_BENCH_TALL_DEADLINE", 1e9))
     print(json.dumps(run(deadline)))

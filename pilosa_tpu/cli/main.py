@@ -23,9 +23,9 @@ from pilosa_tpu import SHARD_WIDTH, __version__
 
 
 def main(argv=None) -> int:
-    from pilosa_tpu.utils.jaxplatform import honor_platform_env
+    from pilosa_tpu.utils.jaxplatform import bootstrap
 
-    honor_platform_env()
+    bootstrap()
     parser = argparse.ArgumentParser(
         prog="pilosa_tpu", description="TPU-native distributed bitmap index"
     )

@@ -32,9 +32,9 @@ class Server:
     def __init__(self, config: Optional[Config] = None, cluster=None) -> None:
         # entry point for every serving deployment: make JAX_PLATFORMS
         # win over the image's sitecustomize backend pinning
-        from pilosa_tpu.utils.jaxplatform import honor_platform_env
+        from pilosa_tpu.utils.jaxplatform import bootstrap
 
-        honor_platform_env()
+        bootstrap()
         self.config = config or Config()
         data_dir = os.path.expanduser(self.config.data_dir)
         self.logger = (

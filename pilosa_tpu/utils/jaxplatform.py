@@ -14,8 +14,49 @@ from __future__ import annotations
 import os
 
 
+def bootstrap() -> None:
+    """The one call every entry point makes before first backend use:
+    honor JAX_PLATFORMS, then enable the persistent compilation cache.
+    Keeping the pair in one hook means a new bench/tool can't get one
+    without the other."""
+    honor_platform_env()
+    enable_compilation_cache()
+
+
 def honor_platform_env() -> None:
     if os.environ.get("JAX_PLATFORMS"):
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> None:
+    """Persist XLA compilations across processes.
+
+    A cold server/bench process pays 20-40 s per kernel structure on the
+    TPU; the persistent cache turns every restart after the first into a
+    disk read. Opt-out with PILOSA_NO_COMPILATION_CACHE=1 (the cache dir
+    itself is harmless — entries key on HLO + compiler version).
+    """
+    if os.environ.get("PILOSA_NO_COMPILATION_CACHE"):
+        return
+    import jax
+
+    d = (
+        cache_dir
+        or os.environ.get("PILOSA_COMPILATION_CACHE_DIR")
+        or os.path.join(
+            os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")),
+            "pilosa_tpu",
+            "xla",
+        )
+    )
+    try:
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        # default min compile time is 1 s; the TopN/count kernels all
+        # clear it, but pin a low floor so the small SPMD programs
+        # cache too
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    except Exception:  # cache is an optimization, never a failure
+        pass

@@ -205,6 +205,11 @@ def _dec_uri_str(data: bytes) -> str:
     scheme = _str(f, 1) or "http"
     host = _str(f, 2) or "localhost"
     port = int(_first(f, 3, 0)) or 10101
+    if ":" in host and not host.startswith("["):
+        # bracket bare IPv6 hosts so the rendered address re-encodes to
+        # the same (host, port) on every relay hop — an unbracketed
+        # 'scheme://::1:10101' would re-parse as a 3-colon host
+        host = f"[{host}]"
     return f"{scheme}://{host}:{port}"
 
 

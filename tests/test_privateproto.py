@@ -136,16 +136,21 @@ class TestRoundTrip:
 
     def test_bare_ipv6_addresses_survive(self):
         # a digits-only final colon group must never be split off as a
-        # port from a bare IPv6 literal
+        # port from a bare IPv6 literal, and the decoded form must be
+        # a fixed point: re-encoding it yields the same (host, port)
         for addr, want in [
-            ("http://::1", "http://::1:10101"),
-            ("http://fd00::2", "http://fd00::2:10101"),
+            ("http://::1", "http://[::1]:10101"),
+            ("http://fd00::2", "http://[fd00::2]:10101"),
             ("http://[fd00::2]:9999", "http://[fd00::2]:9999"),
             ("http://[::1]", "http://[::1]:10101"),
         ]:
             msg = {"type": "node-join", "node": {"id": "x", "uri": addr}}
             out = pp.unmarshal_message(pp.marshal_message(msg))
             assert out["node"]["uri"] == want, addr
+            # idempotent across relay hops
+            msg2 = {"type": "node-join", "node": {"id": "x", "uri": out["node"]["uri"]}}
+            out2 = pp.unmarshal_message(pp.marshal_message(msg2))
+            assert out2["node"]["uri"] == want, addr
 
     def test_lenient_node_addresses_encode(self):
         # addresses already in a topology must encode even when they
