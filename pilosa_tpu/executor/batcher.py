@@ -53,10 +53,24 @@ class _Slot:
 
 class BatchedScorer:
     """Coalesces concurrent ``score`` calls with the same key (same
-    staged matrix) into batched kernel launches."""
+    staged matrix) into batched kernel launches.
 
-    def __init__(self, max_batch: int = 32) -> None:
+    The kernel pair is pluggable: the default scores a dense staged
+    matrix; the executor's stacked-sparse TopN path supplies the
+    block-sparse kernels instead (same drain/coalesce machinery, the
+    staged operand is opaque to it).
+    ``single_fn(src, staged) -> i32[R]``;
+    ``batch_fn(srcs[Q, ...], staged) -> i32[Q, R]``.
+    """
+
+    def __init__(self, max_batch: int = 32, single_fn=None, batch_fn=None) -> None:
         self.max_batch = max_batch
+        self._single_fn = single_fn or (
+            lambda src, staged: ops.intersection_counts_matrix(src, staged)
+        )
+        self._batch_fn = batch_fn or (
+            lambda srcs, staged: ops.intersection_counts_matrix_batch(srcs, staged)
+        )
         self._lock = threading.Lock()  # protects _pending/_dispatch_locks
         self._pending: dict[tuple, list[_Slot]] = {}
         # one dispatch lock per fragment identity (key[0]) — bounded by
@@ -117,9 +131,7 @@ class BatchedScorer:
 
         self.dispatches += 1
         if len(batch) == 1:
-            batch[0].result = np.asarray(
-                ops.intersection_counts_matrix(batch[0].src, mat)
-            )
+            batch[0].result = np.asarray(self._single_fn(batch[0].src, mat))
             batch[0].event.set()
             return
         for start in range(0, len(batch), self.max_batch):
@@ -132,9 +144,7 @@ class BatchedScorer:
             if q > len(chunk):
                 zero = jnp.zeros_like(srcs[0])
                 srcs = srcs + [zero] * (q - len(chunk))
-            scores = np.asarray(
-                ops.intersection_counts_matrix_batch(jnp.stack(srcs), mat)
-            )
+            scores = np.asarray(self._batch_fn(jnp.stack(srcs), mat))
             for i, s in enumerate(chunk):
                 s.result = scores[i]
                 s.event.set()

@@ -119,6 +119,20 @@ class Executor:
         # coalesces concurrent TopN scoring against the same staged
         # matrix into one batched kernel launch (see batcher.py)
         self.scorer = BatchedScorer()
+        # concurrent cross-shard TopN queries sharing a staged candidate
+        # chunk (the common case: every TopN's pass-1 head is the same
+        # cache-rankings prefix) coalesce into one stacked kernel launch
+        # — one device round-trip serves the whole batch. max_batch=8
+        # bounds the lax.map sweep; num_rows rides in the staged tuple.
+        self.stacked_scorer = BatchedScorer(
+            max_batch=8,
+            single_fn=lambda src, st: ops.sparse_intersection_counts_stacked(
+                src, *st
+            ),
+            batch_fn=lambda srcs, st: ops.sparse_intersection_counts_stacked_batch(
+                srcs, *st
+            ),
+        )
         # fused count-of-tree programs keyed by query structure
         self._tree_jits: dict[str, Any] = {}
         # auto-policy crossover, in estimated touched containers (see
@@ -1498,10 +1512,14 @@ class _StackedLazyScores:
             self._publish(ids_by_shard)
             return
         blocks, brow, bslot, bshard, num_rows = staged
-        scores = np.asarray(
-            ops.sparse_intersection_counts_stacked(
-                self._srcs, blocks, brow, bslot, bshard, num_rows
-            )
+        # route through the coalescing scorer: key on the staged arrays'
+        # identity (same live objects ⇔ same snapshot — the BatchedScorer
+        # contract), so concurrent queries over this chunk share one
+        # kernel launch and one fetch
+        scores = self._ex.stacked_scorer.score(
+            (id(blocks), id(brow)),
+            (blocks, brow, bslot, bshard, num_rows),
+            self._srcs,
         )
         for i, ids in enumerate(ids_by_shard):
             base = i * size

@@ -20,8 +20,6 @@ import functools
 import jax
 import jax.numpy as jnp
 
-_ONES = jnp.uint32(0xFFFFFFFF)
-
 
 def _filtered_exists(planes, filter_row):
     exists = planes[-1]

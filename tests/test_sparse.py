@@ -156,6 +156,93 @@ class TestSparseTopN:
         assert len(calls) == 1
         h.close()
 
+    def test_concurrent_topn_coalesce_stacked(self, tmp_path):
+        """Concurrent TopN queries sharing the staged candidate chunk
+        must coalesce into batched stacked-kernel launches (one device
+        round-trip serves the batch) and stay bit-identical."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        h = Holder(str(tmp_path / "cc"))
+        h.open()
+        fld = h.create_index("i").create_field("f")
+        rng = np.random.default_rng(13)
+        rows, cols = [], []
+        for shard in range(3):
+            base = shard * SHARD_WIDTH
+            for r in range(16):
+                k = 300 + 20 * r
+                rows += [r] * k
+                cols += (base + rng.integers(0, SHARD_WIDTH, size=k)).tolist()
+            for r in range(150):
+                rows.append(100 + r)
+                cols.append(base + (r * 7919) % SHARD_WIDTH)
+        fld.import_bits(rows, cols)
+        cpu = Executor(h, device_policy="never")
+        dev = Executor(h, device_policy="always")
+        queries = [f"TopN(f, Row(f={r}), n=5)" for r in range(8)]
+        want = {q: cpu.execute("i", q) for q in queries}
+        dev.execute("i", queries[0])  # warm staging + compile
+
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            for _ in range(3):
+                futs = {q: pool.submit(dev.execute, "i", q) for q in queries}
+                for q, f in futs.items():
+                    assert f.result() == want[q], q
+        h.close()
+
+    def test_stacked_scorer_batches_deterministically(self, tmp_path):
+        """Coalescing itself, without thread-timing luck: hold the
+        dispatch lock while peers enqueue, then release — one batched
+        launch must serve them all with per-query-correct scores."""
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        from pilosa_tpu import ops
+        from pilosa_tpu.executor.batcher import BatchedScorer
+
+        h = _sparse_fragment(tmp_path)
+        frag = h.fragment("i", "f", "standard", 0)
+        ids = tuple(frag.row_ids()[:32])
+        blocks, brow, bslot = frag.sparse_row_blocks(list(ids))
+        blocks32 = np.ascontiguousarray(blocks).view("<u4")
+        bshard = np.zeros(len(brow), dtype=brow.dtype)  # single shard
+        staged = (blocks32, brow, bslot, bshard, len(ids))
+
+        scorer = BatchedScorer(
+            max_batch=8,
+            single_fn=lambda src, st: ops.sparse_intersection_counts_stacked(
+                src, *st
+            ),
+            batch_fn=lambda srcs, st: ops.sparse_intersection_counts_stacked_batch(
+                srcs, *st
+            ),
+        )
+        key = (id(blocks32), id(brow))
+        srcs = [
+            np.ascontiguousarray(frag.row_words(r)).view("<u4")[None, :]
+            for r in (7, 11, 0, 1)
+        ]
+        want = [
+            np.asarray(ops.sparse_intersection_counts_stacked(s, *staged))
+            for s in srcs
+        ]
+
+        # pre-create + hold the dispatch lock so every score() call
+        # enqueues; release once all four are pending
+        dlock = scorer._dispatch_locks.setdefault(key[0], threading.Lock())
+        dlock.acquire()
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            futs = [pool.submit(scorer.score, key, staged, s) for s in srcs]
+            while sum(len(v) for v in scorer._pending.values()) < 4:
+                pass
+            dlock.release()
+            got = [f.result() for f in futs]
+        for g, w in zip(got, want):
+            assert np.array_equal(g, w)
+        assert scorer.batched_queries == 4
+        assert scorer.dispatches == 1
+        h.close()
+
     def test_dense_fragment_keeps_dense_path(self, tmp_path):
         h = Holder(str(tmp_path / "dense"))
         h.open()
