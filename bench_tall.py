@@ -254,7 +254,12 @@ def run(deadline_s: float = 1e9) -> dict:
                         n += len(queries)
                     return round(n / (time.perf_counter() - t0), 2)
 
+            d0, q0 = dev.stacked_scorer.dispatches, dev.stacked_scorer.batched_queries
             out["topn_qps_c8"] = measure_c8(topn, min(remaining() - 15, 20))
+            # coalescing telemetry: how many concurrent queries shared a
+            # stacked kernel launch during the c8 window
+            out["c8_coalesced_queries"] = dev.stacked_scorer.batched_queries - q0
+            out["c8_dispatches"] = dev.stacked_scorer.dispatches - d0
             if remaining() > 30:
                 out["chain_qps_c8"] = measure_c8(chains, min(remaining() - 15, 15))
         # CPU full-path baseline on a small sample (labelled: this is

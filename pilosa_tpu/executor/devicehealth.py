@@ -1,0 +1,181 @@
+"""Device health gate: graceful TPU -> CPU degradation.
+
+A tunneled/remote accelerator can wedge mid-serving (a hung PJRT call
+blocks in C and never returns). The reference has no analog — its
+compute is the serving process — but here every query would otherwise
+hang behind a dead device even though the executor carries a complete
+CPU roaring path for every call. This gate makes device loss a latency
+event instead of an outage:
+
+* read calls run on a guard pool with a deadline measured from the
+  moment the call STARTS (queue wait is accounted separately, so a
+  busy pool can't fake a dead device);
+* a call that blows its deadline does NOT immediately condemn the
+  device: the gate first probes it directly. A healthy probe means the
+  call was merely slow — the deadline extends and the call keeps
+  running. Only a probe that fails or hangs trips the gate;
+* while tripped, reads skip the device entirely (the executor's
+  device predicates consult ``healthy``, which every thread sees — no
+  per-thread state to propagate through map-reduce pools);
+* a background probe loop restores the gate when the device answers,
+  and fires ``on_restore`` so the owner can replace locks/pools that
+  abandoned workers may hold forever (a blocked C call cannot be
+  cancelled from Python; the leak is bounded by in-flight calls at the
+  moment of the wedge).
+
+The same SUSPECT/DOWN philosophy as node liveness (parallel/cluster.py)
+applied to the accelerator itself.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor, TimeoutError as FutureTimeout
+from typing import Callable, Optional
+
+
+class DeviceDown(Exception):
+    """Raised to the caller when the device is gated off or a guarded
+    call exceeded its deadline; callers fall back to the CPU path."""
+
+
+def _default_probe() -> None:
+    """One tiny compile-free device round-trip (dispatch + fetch)."""
+    import jax
+    import numpy as np
+
+    x = jax.device_put(np.ones((8,), dtype=np.int32))
+    np.asarray(x + 1)
+
+
+class DeviceHealth:
+    def __init__(
+        self,
+        timeout_s: float = 120.0,
+        probe_interval_s: float = 15.0,
+        probe_timeout_s: float = 20.0,
+        probe_fn: Optional[Callable[[], None]] = None,
+        max_workers: int = 32,
+        on_restore: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self.timeout_s = timeout_s
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self._probe_fn = probe_fn or _default_probe
+        self._max_workers = max_workers
+        self.on_restore = on_restore
+        self._lock = threading.Lock()
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._healthy = True
+        self._probing = False
+        # telemetry (read by stats/tests)
+        self.trips = 0
+        self.restores = 0
+        self.slow_calls = 0  # deadline passed but the probe cleared the device
+
+    @property
+    def healthy(self) -> bool:
+        return self._healthy
+
+    def _probe_once(self) -> bool:
+        """Run the probe on a side thread with its own deadline; a
+        hung probe is abandoned and counts as failure."""
+        ok = threading.Event()
+
+        def attempt():
+            try:
+                self._probe_fn()
+                ok.set()
+            except Exception:
+                pass
+
+        threading.Thread(target=attempt, daemon=True).start()
+        return ok.wait(timeout=self.probe_timeout_s)
+
+    def guard(self, fn: Callable, timeout_s: Optional[float] = None):
+        """Run ``fn`` under the deadline. Returns its result, or raises
+        DeviceDown when the gate is closed or the device is judged
+        dead. A slow-but-alive device (deadline passed, probe answers)
+        extends the deadline instead of tripping — a long pure-CPU
+        stretch inside the call can never condemn a healthy device."""
+        if not self._healthy:
+            raise DeviceDown("device gated off")
+        with self._lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self._max_workers,
+                    thread_name_prefix="device-guard",
+                )
+            pool = self._pool
+        timeout = timeout_s or self.timeout_s
+        started = threading.Event()
+
+        def run():
+            started.set()
+            return fn()
+
+        try:
+            fut = pool.submit(run)
+        except RuntimeError as e:  # pool shut down under us (close())
+            raise DeviceDown(str(e))
+        # queue wait is not runtime — but a pool that can't start work
+        # within a full deadline is saturated with hung workers, which
+        # is itself the dead-device symptom
+        if not started.wait(timeout=timeout):
+            self._trip("guard pool saturated")
+            raise DeviceDown("guard pool saturated")
+        while True:
+            try:
+                return fut.result(timeout=timeout)
+            except FutureTimeout:
+                if self._probe_once():
+                    # device answers: the call is slow, not stuck —
+                    # extend and keep waiting
+                    self.slow_calls += 1
+                    continue
+                self._trip("device probe failed after call deadline")
+                raise DeviceDown("device call timed out and probe failed")
+
+    def _trip(self, reason: str) -> None:
+        with self._lock:
+            if not self._healthy:
+                return
+            self._healthy = False
+            self.trips += 1
+            # abandon the pool: its hung workers never come back; a
+            # fresh pool is created on restore
+            self._pool = None
+            if not self._probing:
+                self._probing = True
+                threading.Thread(
+                    target=self._probe_loop, name="device-probe", daemon=True
+                ).start()
+
+    def _probe_loop(self) -> None:
+        while True:
+            time.sleep(self.probe_interval_s)
+            with self._lock:
+                if self._healthy:  # restored elsewhere / closed
+                    self._probing = False
+                    return
+            if self._probe_once():
+                with self._lock:
+                    self._healthy = True
+                    self.restores += 1
+                    self._probing = False
+                cb = self.on_restore
+                if cb is not None:
+                    try:
+                        cb()
+                    except Exception:
+                        pass
+                return
+            # probe hung or failed: thread abandoned, loop again
+
+    def close(self) -> None:
+        with self._lock:
+            pool, self._pool = self._pool, None
+            self._healthy = True  # stops a running probe loop
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)

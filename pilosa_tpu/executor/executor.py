@@ -34,6 +34,7 @@ from pilosa_tpu.core.field import FIELD_TYPE_SET
 from pilosa_tpu.core.fragment import DEFAULT_MIN_THRESHOLD
 from pilosa_tpu.core.timequantum import TIME_FORMAT, views_by_time_range
 from pilosa_tpu.executor.batcher import BatchedScorer
+from pilosa_tpu.executor.devicehealth import DeviceDown
 from pilosa_tpu.executor.stager import DeviceStager
 from pilosa_tpu.pql import BETWEEN, Call, Condition, NEQ, Query, parse
 from pilosa_tpu.roaring import Bitmap
@@ -87,6 +88,20 @@ class _NotDeviceable(Exception):
     """Raised when a call subtree can't run on the device path."""
 
 
+def _make_stacked_scorer() -> BatchedScorer:
+    """Coalescing scorer for the cross-shard stacked-sparse TopN path.
+    max_batch=8 bounds the lax.map sweep; num_rows rides in the staged
+    tuple. A factory because the device health gate rebuilds it on
+    restore (its dispatch locks may be held by abandoned workers)."""
+    return BatchedScorer(
+        max_batch=8,
+        single_fn=lambda src, st: ops.sparse_intersection_counts_stacked(src, *st),
+        batch_fn=lambda srcs, st: ops.sparse_intersection_counts_stacked_batch(
+            srcs, *st
+        ),
+    )
+
+
 class Executor:
     def __init__(
         self,
@@ -98,6 +113,7 @@ class Executor:
         translate_store=None,
         max_writes_per_request: int = 5000,
         mesh=None,
+        health=None,
     ) -> None:
         self.holder = holder
         self.cluster = cluster  # None = single-node
@@ -122,17 +138,15 @@ class Executor:
         # concurrent cross-shard TopN queries sharing a staged candidate
         # chunk (the common case: every TopN's pass-1 head is the same
         # cache-rankings prefix) coalesce into one stacked kernel launch
-        # — one device round-trip serves the whole batch. max_batch=8
-        # bounds the lax.map sweep; num_rows rides in the staged tuple.
-        self.stacked_scorer = BatchedScorer(
-            max_batch=8,
-            single_fn=lambda src, st: ops.sparse_intersection_counts_stacked(
-                src, *st
-            ),
-            batch_fn=lambda srcs, st: ops.sparse_intersection_counts_stacked_batch(
-                srcs, *st
-            ),
-        )
+        # — one device round-trip serves the whole batch.
+        self.stacked_scorer = _make_stacked_scorer()
+        # optional device health gate (executor/devicehealth.py):
+        # serving deployments pass one so a wedged accelerator degrades
+        # reads to the CPU roaring path instead of hanging them; bare
+        # executors (tests, benches) skip the per-call guard hop
+        self.health = health
+        if health is not None:
+            health.on_restore = self._on_device_restore
         # fused count-of-tree programs keyed by query structure
         self._tree_jits: dict[str, Any] = {}
         # auto-policy crossover, in estimated touched containers (see
@@ -325,7 +339,46 @@ class Executor:
 
     # -- dispatch (reference executeCall, executor.go:165) -------------------
 
+    def _cpu_forced(self) -> bool:
+        """True while the device gate is tripped. Checked by the device
+        predicates, so it applies on EVERY thread — including cluster
+        map-reduce pool workers — without per-thread state."""
+        return self.health is not None and not self.health.healthy
+
+    def _on_device_restore(self) -> None:
+        """Replace machinery whose locks abandoned guard workers may
+        hold forever (a dispatcher hung inside a dead kernel launch
+        keeps its per-fragment dispatch lock; a hung staging upload
+        keeps the stager's). Fresh instances start clean; zombies keep
+        mutating their orphaned predecessors harmlessly."""
+        self.scorer = BatchedScorer()
+        self.stacked_scorer = _make_stacked_scorer()
+        self.stager.reset_after_wedge()
+
     def _execute_call(self, index, c: Call, shards, opt) -> Any:
+        """Read calls run under the device health gate when one is
+        configured: a wedged accelerator trips the gate and the same
+        call re-runs on the CPU roaring path (reads are pure — safe to
+        re-run; the gate state itself forces the CPU predicates, so the
+        re-run is device-free on every thread). Writes never touch the
+        device and skip the guard."""
+        from pilosa_tpu.pql.ast import WRITE_CALLS
+
+        if (
+            self.health is not None
+            and self.device_policy != "never"
+            and c.name not in WRITE_CALLS
+            and not self._cpu_forced()
+        ):
+            try:
+                return self.health.guard(
+                    lambda: self._execute_call_inner(index, c, shards, opt)
+                )
+            except DeviceDown:
+                pass  # gate now closed; fall through to the CPU path
+        return self._execute_call_inner(index, c, shards, opt)
+
+    def _execute_call_inner(self, index, c: Call, shards, opt) -> Any:
         name = c.name
         if name == "Sum":
             return self._execute_sum(index, c, shards, opt)
@@ -538,7 +591,7 @@ class Executor:
     # -- device path ---------------------------------------------------------
 
     def _use_device(self, index, c: Call, shard: int) -> bool:
-        if self.device_policy == "never":
+        if self.device_policy == "never" or self._cpu_forced():
             return False
         if self.device_policy == "always":
             return True
@@ -712,7 +765,7 @@ class Executor:
         return self.cluster is None or opt.remote
 
     def _use_device_batched(self, index, c: Call, shards) -> bool:
-        if self.device_policy == "never" or len(shards) < 2:
+        if self.device_policy == "never" or len(shards) < 2 or self._cpu_forced():
             return False
         if self.device_policy == "always":
             return True
@@ -1430,6 +1483,8 @@ class Executor:
             pool, self._read_pool = self._read_pool, None
         if pool is not None:
             pool.shutdown(wait=False)
+        if self.health is not None:
+            self.health.close()
 
 
 # Lazy-scoring chunk schedule, shared by both providers: a small head

@@ -326,3 +326,19 @@ class DeviceStager:
             # value to current waiters through the _InFlight object, but
             # nothing stale survives here if one errors after clear().
             self._inflight.clear()
+
+    def reset_after_wedge(self) -> None:
+        """Recover from a device wedge (called by the health gate on
+        restore): drop every staged array (handles created by the dead
+        runtime may be invalid) and fail out in-flight entries whose
+        builders are hung inside dead device calls — new queries
+        rebuild instead of waiting on a zombie forever. Safe because
+        ``_mu`` is never held across a device call."""
+        with self._mu:
+            self._cache.clear()
+            self._bytes = 0
+            stale, self._inflight = self._inflight, {}
+        for fl in stale.values():
+            if not fl.event.is_set():
+                fl.error = RuntimeError("staging abandoned: device wedged")
+                fl.event.set()

@@ -56,6 +56,11 @@ class Config:
     # TPU execution
     device_policy: str = "auto"  # never | auto | always
     stager_budget_bytes: int = 8 << 30
+    # device health gate: reads slower than this fall back to the CPU
+    # roaring path and gate the device off until a probe answers
+    # (executor/devicehealth.py); 0 disables the gate. The default
+    # clears a cold first-query compile (~40 s) with margin.
+    device_timeout: float = 120.0
     # SPMD: number of local devices to mesh the shard axis over.
     # 0/1 = single-device; >1 builds a jax.sharding.Mesh and the
     # executor lowers multi-shard Count/Sum/TopN through ICI

@@ -65,6 +65,15 @@ class Server:
         self.stager = DeviceStager(
             budget_bytes=self.config.stager_budget_bytes, mesh=self.mesh
         )
+        # serving deployments get the device health gate: a wedged
+        # accelerator (hung tunnel/PJRT call) degrades reads to the CPU
+        # roaring path instead of hanging them, and a background probe
+        # restores the device path when it answers again
+        health = None
+        if self.config.device_policy != "never" and self.config.device_timeout > 0:
+            from pilosa_tpu.executor.devicehealth import DeviceHealth
+
+            health = DeviceHealth(timeout_s=self.config.device_timeout)
         self.executor = Executor(
             self.holder,
             cluster=cluster,
@@ -73,6 +82,7 @@ class Server:
             translate_store=self.translate_store,
             max_writes_per_request=self.config.max_writes_per_request,
             mesh=self.mesh,
+            health=health,
         )
         self.api = API(self.holder, self.executor, cluster=cluster, server=self)
         self.handler = Handler(

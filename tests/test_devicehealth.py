@@ -1,0 +1,162 @@
+"""Device health gate: a wedged accelerator degrades reads to the CPU
+path (bit-identically) instead of hanging them, and a succeeding probe
+restores the device path. The wedge is simulated by patching a device
+kernel to block longer than the gate timeout."""
+
+import time
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SHARD_WIDTH
+from pilosa_tpu.core import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor.devicehealth import DeviceDown, DeviceHealth
+
+
+def _failing_probe():
+    raise RuntimeError("device wedged")
+
+
+def _holder(tmp_path):
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    fld = h.create_index("i").create_field("f")
+    rng = np.random.default_rng(5)
+    rows, cols = [], []
+    for shard in range(2):
+        base = shard * SHARD_WIDTH
+        for r in range(10):
+            k = 200 + 30 * r
+            rows += [r] * k
+            cols += (base + rng.integers(0, SHARD_WIDTH, size=k)).tolist()
+    fld.import_bits(rows, cols)
+    return h
+
+
+class TestDeviceHealthUnit:
+    def test_guard_runs_and_times_out(self):
+        # wedged device: the deadline passes AND the probe fails
+        hlth = DeviceHealth(
+            timeout_s=0.2,
+            probe_interval_s=3600,
+            probe_timeout_s=0.1,
+            probe_fn=_failing_probe,
+        )
+        assert hlth.guard(lambda: 41 + 1) == 42
+        with pytest.raises(DeviceDown):
+            hlth.guard(lambda: time.sleep(2))
+        assert not hlth.healthy
+        assert hlth.trips == 1
+        # gate closed: further guarded calls refuse immediately
+        t0 = time.monotonic()
+        with pytest.raises(DeviceDown):
+            hlth.guard(lambda: 1)
+        assert time.monotonic() - t0 < 0.1
+        hlth.close()
+
+    def test_slow_call_with_live_device_does_not_trip(self):
+        # deadline passes mid-call but the probe answers: the gate must
+        # extend the deadline and return the result, not condemn the
+        # device (a long pure-CPU stretch can never fake a dead device)
+        hlth = DeviceHealth(
+            timeout_s=0.15,
+            probe_interval_s=3600,
+            probe_timeout_s=1.0,
+            probe_fn=lambda: None,
+        )
+        assert hlth.guard(lambda: (time.sleep(0.5), 99)[1]) == 99
+        assert hlth.healthy
+        assert hlth.trips == 0
+        assert hlth.slow_calls >= 1
+        hlth.close()
+
+    def test_probe_restores(self):
+        hlth = DeviceHealth(
+            timeout_s=0.2,
+            probe_interval_s=0.05,
+            probe_timeout_s=1.0,
+            probe_fn=lambda: None,  # device recovers: probe succeeds
+        )
+        hlth._trip("test wedge")
+        assert not hlth.healthy
+        deadline = time.monotonic() + 5
+        while not hlth.healthy and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert hlth.healthy
+        assert hlth.restores == 1
+        assert hlth.guard(lambda: 7) == 7
+        hlth.close()
+
+
+class TestExecutorDegradation:
+    def test_wedged_kernel_falls_back_to_cpu(self, tmp_path, monkeypatch):
+        h = _holder(tmp_path)
+        cpu = Executor(h, device_policy="never")
+        hlth = DeviceHealth(
+            timeout_s=0.5,
+            probe_interval_s=3600,
+            probe_timeout_s=0.1,
+            probe_fn=_failing_probe,
+        )
+        dev = Executor(h, device_policy="always", health=hlth)
+        q = "TopN(f, Row(f=3), n=5)"
+        want = cpu.execute("i", q)
+        assert dev.execute("i", q) == want  # healthy path first
+
+        # wedge the stacked scoring kernel (blocks past the deadline)
+        import pilosa_tpu.executor.executor as exmod
+
+        def hang(*a, **kw):
+            time.sleep(30)
+
+        monkeypatch.setattr(
+            exmod.ops, "sparse_intersection_counts_stacked", hang
+        )
+        monkeypatch.setattr(
+            exmod.ops, "sparse_intersection_counts", hang
+        )
+        t0 = time.monotonic()
+        got = dev.execute("i", q)
+        elapsed = time.monotonic() - t0
+        assert got == want  # served by the CPU fallback, bit-identical
+        assert elapsed < 10  # did not wait out the 30 s hang
+        assert hlth.trips == 1 and not hlth.healthy
+        # gate closed: subsequent reads go straight to CPU, fast
+        t0 = time.monotonic()
+        assert dev.execute("i", "Count(Row(f=3))") == cpu.execute(
+            "i", "Count(Row(f=3))"
+        )
+        assert time.monotonic() - t0 < 2
+        # writes never touch the gate
+        assert dev.execute("i", "Set(999999, f=3)") == [True]
+        dev.close()
+        h.close()
+
+    def test_recovery_restores_device_path(self, tmp_path):
+        h = _holder(tmp_path)
+        hlth = DeviceHealth(
+            timeout_s=0.5,
+            probe_interval_s=0.05,
+            probe_timeout_s=1.0,
+            probe_fn=lambda: None,
+        )
+        dev = Executor(h, device_policy="always", health=hlth)
+        cpu = Executor(h, device_policy="never")
+        q = "Count(Intersect(Row(f=1), Row(f=2)))"
+        want = cpu.execute("i", q)
+        old_scorer = dev.scorer
+        old_stacked = dev.stacked_scorer
+        # trip the gate directly (simulates a timed-out call)
+        hlth._trip("test wedge")
+        assert dev.execute("i", q) == want  # CPU while gated
+        deadline = time.monotonic() + 5
+        while not hlth.healthy and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert hlth.healthy
+        # restore replaced the machinery whose locks zombies may hold
+        assert dev.scorer is not old_scorer
+        assert dev.stacked_scorer is not old_stacked
+        assert dev.execute("i", q) == want  # device path again
+        dev.close()
+        h.close()
