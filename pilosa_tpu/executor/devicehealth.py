@@ -119,11 +119,16 @@ class DeviceHealth:
             fut = pool.submit(run)
         except RuntimeError as e:  # pool shut down under us (close())
             raise DeviceDown(str(e))
-        # queue wait is not runtime — but a pool that can't start work
-        # within a full deadline is saturated with hung workers, which
-        # is itself the dead-device symptom
+        # queue wait is not runtime. A pool that can't start work within
+        # a full deadline is EITHER saturated with hung workers (dead
+        # device) or merely carrying a burst of long CPU-side reads —
+        # the probe distinguishes: only a failed probe condemns the
+        # device; a healthy one degrades just this call to CPU.
         if not started.wait(timeout=timeout):
-            self._trip("guard pool saturated")
+            fut.cancel()
+            if self._probe_once():
+                raise DeviceDown("guard pool saturated (device alive)")
+            self._trip("guard pool saturated and probe failed")
             raise DeviceDown("guard pool saturated")
         while True:
             try:
@@ -143,14 +148,18 @@ class DeviceHealth:
                 return
             self._healthy = False
             self.trips += 1
-            # abandon the pool: its hung workers never come back; a
-            # fresh pool is created on restore
-            self._pool = None
+            pool, self._pool = self._pool, None
             if not self._probing:
                 self._probing = True
                 threading.Thread(
                     target=self._probe_loop, name="device-probe", daemon=True
                 ).start()
+        if pool is not None:
+            # release the abandoned pool's IDLE workers (they'd block
+            # on its queue forever otherwise — N flap cycles must not
+            # leak N×max_workers threads); truly hung workers ignore
+            # the shutdown, bounding the leak to them alone
+            pool.shutdown(wait=False, cancel_futures=True)
 
     def _probe_loop(self) -> None:
         while True:
@@ -160,16 +169,19 @@ class DeviceHealth:
                     self._probing = False
                     return
             if self._probe_once():
-                with self._lock:
-                    self._healthy = True
-                    self.restores += 1
-                    self._probing = False
+                # replace zombie-locked machinery BEFORE opening the
+                # gate: a read passing the healthy check must never see
+                # the old scorers/stager whose locks hung workers hold
                 cb = self.on_restore
                 if cb is not None:
                     try:
                         cb()
                     except Exception:
                         pass
+                with self._lock:
+                    self._healthy = True
+                    self.restores += 1
+                    self._probing = False
                 return
             # probe hung or failed: thread abandoned, loop again
 

@@ -56,6 +56,10 @@ class DeviceStager:
         self._bytes = 0
         self._mu = threading.Lock()
         self._inflight: dict[tuple, _InFlight] = {}
+        # bumped by reset_after_wedge: a builder that started before a
+        # wedge publishes to its own waiters but must never re-insert a
+        # dead-runtime handle into the post-reset cache
+        self._epoch = 0
         self.hits = 0
         self.misses = 0
 
@@ -73,6 +77,7 @@ class DeviceStager:
                 self._cache.move_to_end(key)
                 self.hits += 1
                 return ent[0]
+            epoch = self._epoch
             fl = self._inflight.get(key)
             if fl is None:
                 fl = _InFlight()
@@ -95,12 +100,17 @@ class DeviceStager:
             raise
         with self._mu:
             self.misses += 1
-            self._cache[key] = (value, nbytes)
-            self._bytes += nbytes
-            while self._bytes > self.budget_bytes and len(self._cache) > 1:
-                _, (_, old_bytes) = self._cache.popitem(last=False)
-                self._bytes -= old_bytes
-            self._inflight.pop(key, None)
+            if self._epoch == epoch:
+                self._cache[key] = (value, nbytes)
+                self._bytes += nbytes
+                while self._bytes > self.budget_bytes and len(self._cache) > 1:
+                    _, (_, old_bytes) = self._cache.popitem(last=False)
+                    self._bytes -= old_bytes
+                self._inflight.pop(key, None)
+            elif self._inflight.get(key) is fl:
+                # same epoch-stale builder still registered (no rebuild
+                # raced in): unregister without caching the stale value
+                self._inflight.pop(key, None)
         fl.value = value
         fl.event.set()
         return value
@@ -337,6 +347,7 @@ class DeviceStager:
         with self._mu:
             self._cache.clear()
             self._bytes = 0
+            self._epoch += 1  # zombie builders must not repopulate
             stale, self._inflight = self._inflight, {}
         for fl in stale.values():
             if not fl.event.is_set():

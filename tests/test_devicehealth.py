@@ -71,6 +71,60 @@ class TestDeviceHealthUnit:
         assert hlth.slow_calls >= 1
         hlth.close()
 
+    def test_saturated_pool_with_live_device_degrades_without_trip(self):
+        # every worker busy with long (CPU-side) work: a new call must
+        # fall back for ITSELF but not condemn the healthy device
+        hlth = DeviceHealth(
+            timeout_s=0.2,
+            probe_interval_s=3600,
+            probe_timeout_s=1.0,
+            probe_fn=lambda: None,
+            max_workers=1,
+        )
+        import threading
+
+        release = threading.Event()
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=1) as tp:
+            occupier = tp.submit(lambda: hlth.guard(release.wait))
+            time.sleep(0.05)  # occupier now holds the only guard worker
+            with pytest.raises(DeviceDown):
+                hlth.guard(lambda: 1)
+            assert hlth.healthy  # gate stays open
+            assert hlth.trips == 0
+            release.set()
+            occupier.result(timeout=5)
+        hlth.close()
+
+    def test_stager_epoch_blocks_zombie_reinsert(self):
+        from pilosa_tpu.executor.stager import DeviceStager
+
+        st = DeviceStager()
+        import threading
+
+        entered = threading.Event()
+        proceed = threading.Event()
+
+        def slow_builder():
+            entered.set()
+            proceed.wait(timeout=10)
+            return ("stale-handle", 8)
+
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.update(v=st._get_or_build(("k",), slow_builder))
+        )
+        t.start()
+        entered.wait(timeout=5)
+        st.reset_after_wedge()  # wedge + restore while builder is live
+        proceed.set()
+        t.join(timeout=5)
+        # the zombie's value reached its own caller...
+        assert out["v"] == "stale-handle"
+        # ...but never entered the post-reset cache
+        assert st._get_or_build(("k",), lambda: ("fresh", 8)) == "fresh"
+
     def test_probe_restores(self):
         hlth = DeviceHealth(
             timeout_s=0.2,
