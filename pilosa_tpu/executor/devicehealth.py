@@ -31,7 +31,11 @@ from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor, TimeoutError as FutureTimeout
+from concurrent.futures import (
+    CancelledError,
+    ThreadPoolExecutor,
+    TimeoutError as FutureTimeout,
+)
 from typing import Callable, Optional
 
 
@@ -73,6 +77,7 @@ class DeviceHealth:
         self.trips = 0
         self.restores = 0
         self.slow_calls = 0  # deadline passed but the probe cleared the device
+        self.saturations = 0  # guard pool full at submit deadline
 
     @property
     def healthy(self) -> bool:
@@ -119,6 +124,9 @@ class DeviceHealth:
             fut = pool.submit(run)
         except RuntimeError as e:  # pool shut down under us (close())
             raise DeviceDown(str(e))
+        # a concurrent _trip may cancel us while queued — wake the
+        # started wait immediately instead of sleeping out the deadline
+        fut.add_done_callback(lambda f: started.set())
         # queue wait is not runtime. A pool that can't start work within
         # a full deadline is EITHER saturated with hung workers (dead
         # device) or merely carrying a burst of long CPU-side reads —
@@ -126,13 +134,18 @@ class DeviceHealth:
         # device; a healthy one degrades just this call to CPU.
         if not started.wait(timeout=timeout):
             fut.cancel()
+            self.saturations += 1
             if self._probe_once():
                 raise DeviceDown("guard pool saturated (device alive)")
             self._trip("guard pool saturated and probe failed")
             raise DeviceDown("guard pool saturated")
+        if fut.cancelled():
+            raise DeviceDown("guard pool shut down mid-queue")
         while True:
             try:
                 return fut.result(timeout=timeout)
+            except CancelledError:
+                raise DeviceDown("guard pool shut down mid-queue")
             except FutureTimeout:
                 if self._probe_once():
                     # device answers: the call is slow, not stuck —
@@ -171,13 +184,16 @@ class DeviceHealth:
             if self._probe_once():
                 # replace zombie-locked machinery BEFORE opening the
                 # gate: a read passing the healthy check must never see
-                # the old scorers/stager whose locks hung workers hold
+                # the old scorers/stager whose locks hung workers hold.
+                # A failed callback abandons THIS restore attempt (the
+                # loop retries) — opening the gate without the reset
+                # would re-expose the zombie locks it exists to retire.
                 cb = self.on_restore
                 if cb is not None:
                     try:
                         cb()
                     except Exception:
-                        pass
+                        continue
                 with self._lock:
                     self._healthy = True
                     self.restores += 1

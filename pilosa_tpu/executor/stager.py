@@ -94,7 +94,11 @@ class DeviceStager:
             value, nbytes = builder()
         except BaseException as e:
             with self._mu:
-                self._inflight.pop(key, None)
+                # identity check mirrors the success path: an
+                # epoch-stale zombie that raises must not evict a
+                # post-reset rebuild's in-flight entry
+                if self._inflight.get(key) is fl:
+                    self._inflight.pop(key, None)
             fl.error = e
             fl.event.set()
             raise
