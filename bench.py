@@ -144,18 +144,18 @@ def main():
         result["native_baseline"] = {
             k: v.get("native_cpu_qps") for k, v in _native.items()
         }
-        _tall_native = _native.get("tall_1Bx64shards", {}).get("native_cpu_qps")
         _tall_rows = result.get("tall", {}).get("build", {}).get("rows", 0)
-        # only compare against the native 1B number when THIS run was
+        # only compare against the native 1B numbers when THIS run was
         # actually at (or near) the 1B scale
-        if (
-            _tall_native
-            and result.get("tall", {}).get("topn_qps")
-            and _tall_rows >= 900_000_000
-        ):
-            result["vs_native_baseline"] = round(
-                result["tall"]["topn_qps"] / _tall_native, 2
-            )
+        if _tall_rows >= 900_000_000:
+            for native_key, tall_key, out_key in (
+                ("tall_1Bx64shards", "topn_qps", "vs_native_baseline"),
+                ("tall_chains_1Bx64shards", "chain_qps", "chain_vs_native_baseline"),
+            ):
+                nv = _native.get(native_key, {}).get("native_cpu_qps")
+                tv = result.get("tall", {}).get(tall_key)
+                if nv and tv:
+                    result[out_key] = round(tv / nv, 2)
     except Exception as e:  # any malformed baseline file — keep the JSON flowing
         print(f"native baseline unavailable: {type(e).__name__}: {e}", file=sys.stderr)
 

@@ -118,6 +118,46 @@ static double now_s() {
   return ts.tv_sec + ts.tv_nsec * 1e-9;
 }
 
+// reference unionArrayArray (roaring.go:2149): merge-walk materialising
+// the union container, as the reference's Row algebra does before the
+// final Count.
+static std::vector<u16> cunion(const std::vector<u16>& a,
+                               const std::vector<u16>& b) {
+  std::vector<u16> out;
+  out.reserve(a.size() + b.size());
+  size_t i = 0, j = 0;
+  while (i < a.size() && j < b.size()) {
+    u16 va = a[i], vb = b[j];
+    out.push_back(va <= vb ? va : vb);
+    i += (va <= vb);
+    j += (vb <= va);
+  }
+  out.insert(out.end(), a.begin() + i, a.end());
+  out.insert(out.end(), b.begin() + j, b.end());
+  return out;
+}
+
+static Row row_union(const Row& a, const Row& b) {
+  Row r;
+  r.containers.resize(a.containers.size());
+  for (size_t c = 0; c < a.containers.size(); ++c) {
+    r.containers[c] = cunion(a.containers[c], b.containers[c]);
+    r.count += (u32)r.containers[c].size();
+  }
+  return r;
+}
+
+// Count(Intersect(Union(a,b), Union(c,d))) per shard — the bench_tall
+// chain family (reference executeBitmapCallShard -> Row algebra ->
+// row.Count, executor.go:704-996). The final intersect uses the
+// count-only merge walk, slightly favoring this baseline.
+static u64 chain_query(const Row& a, const Row& b, const Row& c,
+                       const Row& d) {
+  Row u1 = row_union(a, b);
+  Row u2 = row_union(c, d);
+  return row_icount(u1, u2);
+}
+
 int main() {
   // ---- workload 1: bench.py kernel shape — 4096 rows x 1M cols,
   // ~1.6% density, every row a candidate (cache covers all rows).
@@ -177,6 +217,24 @@ int main() {
            "\"note\": \"single core; reference Go parallelizes shards over "
            "cores\"}\n",
            QUERIES / dt);
+
+    // ---- workload 3: bench_tall chain family on the same data —
+    // Count(Intersect(Union(a,b), Union(c,d))) across 64 shards,
+    // 4 distinct hot rows per query (bench_tall.py _queries chains).
+    volatile u64 sink3 = 0;
+    const int CQUERIES = 16;
+    double t1 = now_s();
+    for (int q = 0; q < CQUERIES; ++q) {
+      int a = (int)(xrand() % HOT), b = (a + 5) % HOT, c = (a + 11) % HOT,
+          d = (a + 17) % HOT;
+      for (int s = 0; s < SHARDS; ++s)
+        sink3 += chain_query(hot[s][a], hot[s][b], hot[s][c], hot[s][d]);
+    }
+    double dt1 = now_s() - t1;
+    printf("{\"workload\": \"tall_chains_1Bx64shards\", \"native_cpu_qps\": "
+           "%.2f, \"note\": \"single core; reference Go parallelizes shards "
+           "over cores\"}\n",
+           CQUERIES / dt1);
   }
   return 0;
 }

@@ -78,6 +78,7 @@ class DeviceHealth:
         self.restores = 0
         self.slow_calls = 0  # deadline passed but the probe cleared the device
         self.saturations = 0  # guard pool full at submit deadline
+        self.restore_failures = 0  # on_restore raised; restore retried
 
     @property
     def healthy(self) -> bool:
@@ -193,6 +194,10 @@ class DeviceHealth:
                     try:
                         cb()
                     except Exception:
+                        # visible, not silent: a deterministic callback
+                        # bug would otherwise keep a healthy device
+                        # gated forever with no signal
+                        self.restore_failures += 1
                         continue
                 with self._lock:
                     self._healthy = True

@@ -521,14 +521,19 @@ class TestChaos:
             victim_cfg = s2.config
             s2.close()
             deadline = time.monotonic() + 15
+            saw_down = False
             while time.monotonic() < deadline:
                 if any(
                     n.state == "DOWN"
                     for n in s0.cluster.nodes
                     if n.uri != s0.uri and n.uri != s1.uri
                 ):
+                    saw_down = True
                     break
                 time.sleep(0.1)
+            # the degraded-path claim is only tested if the victim was
+            # actually observed DOWN
+            assert saw_down, "victim never marked DOWN"
             time.sleep(1.0)  # load against the degraded cluster
 
             # restart the victim on its old port + data dir
