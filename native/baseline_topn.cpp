@@ -147,15 +147,87 @@ static Row row_union(const Row& a, const Row& b) {
   return r;
 }
 
-// Count(Intersect(Union(a,b), Union(c,d))) per shard — the bench_tall
-// chain family (reference executeBitmapCallShard -> Row algebra ->
-// row.Count, executor.go:704-996). The final intersect uses the
-// count-only merge walk, slightly favoring this baseline.
-static u64 chain_query(const Row& a, const Row& b, const Row& c,
-                       const Row& d) {
+// reference intersectArrayArray (roaring.go:1951) — materializing form.
+static std::vector<u16> cintersect(const std::vector<u16>& a,
+                                   const std::vector<u16>& b) {
+  std::vector<u16> out;
+  size_t i = 0, j = 0;
+  while (i < a.size() && j < b.size()) {
+    u16 va = a[i], vb = b[j];
+    if (va == vb) out.push_back(va);
+    i += (va <= vb);
+    j += (vb <= va);
+  }
+  return out;
+}
+
+static Row row_intersect(const Row& a, const Row& b) {
+  Row r;
+  r.containers.resize(a.containers.size());
+  for (size_t c = 0; c < a.containers.size(); ++c) {
+    r.containers[c] = cintersect(a.containers[c], b.containers[c]);
+    r.count += (u32)r.containers[c].size();
+  }
+  return r;
+}
+
+// count-only walks for the final op of each chain (slightly favoring
+// this baseline: the reference materializes the final Row too).
+static u32 cunion_count(const std::vector<u16>& a, const std::vector<u16>& b) {
+  u32 n = 0;
+  size_t i = 0, j = 0;
+  while (i < a.size() && j < b.size()) {
+    u16 va = a[i], vb = b[j];
+    ++n;
+    i += (va <= vb);
+    j += (vb <= va);
+  }
+  return n + (u32)(a.size() - i) + (u32)(b.size() - j);
+}
+
+static u32 cdiff_count(const std::vector<u16>& a, const std::vector<u16>& b) {
+  u32 n = 0;
+  size_t i = 0, j = 0;
+  while (i < a.size() && j < b.size()) {
+    u16 va = a[i], vb = b[j];
+    n += (va < vb);
+    i += (va <= vb);
+    j += (vb <= va);
+  }
+  return n + (u32)(a.size() - i);
+}
+
+// The three bench_tall chain shapes (bench_tall.py _queries; reference
+// executeBitmapCallShard -> Row algebra -> row.Count,
+// executor.go:704-996), per shard:
+//   1. Count(Intersect(Union(a,b), Union(c,d)))
+//   2. Count(Union(Intersect(a,b), Intersect(c,d), a))
+//   3. Count(Difference(Union(a,b,c), d))
+static u64 chain_query1(const Row& a, const Row& b, const Row& c,
+                        const Row& d) {
   Row u1 = row_union(a, b);
   Row u2 = row_union(c, d);
   return row_icount(u1, u2);
+}
+
+static u64 chain_query2(const Row& a, const Row& b, const Row& c,
+                        const Row& d) {
+  Row i1 = row_intersect(a, b);
+  Row i2 = row_intersect(c, d);
+  Row u = row_union(i1, i2);
+  u64 n = 0;
+  for (size_t k = 0; k < u.containers.size(); ++k)
+    n += cunion_count(u.containers[k], a.containers[k]);
+  return n;
+}
+
+static u64 chain_query3(const Row& a, const Row& b, const Row& c,
+                        const Row& d) {
+  Row u = row_union(row_union(a, b), c);
+  u64 n = 0;
+  for (size_t k = 0; k < u.containers.size(); ++k)
+    n += cdiff_count(u.containers[k], d.containers[k]);
+  return n;
 }
 
 int main() {
@@ -219,16 +291,20 @@ int main() {
            QUERIES / dt);
 
     // ---- workload 3: bench_tall chain family on the same data —
-    // Count(Intersect(Union(a,b), Union(c,d))) across 64 shards,
-    // 4 distinct hot rows per query (bench_tall.py _queries chains).
+    // the SAME three shapes bench_tall's chain_qps averages over,
+    // across 64 shards, 4 distinct hot rows per query.
     volatile u64 sink3 = 0;
-    const int CQUERIES = 16;
+    const int CQUERIES = 15;  // 5 iterations x 3 shapes
     double t1 = now_s();
-    for (int q = 0; q < CQUERIES; ++q) {
+    for (int q = 0; q < CQUERIES / 3; ++q) {
       int a = (int)(xrand() % HOT), b = (a + 5) % HOT, c = (a + 11) % HOT,
           d = (a + 17) % HOT;
       for (int s = 0; s < SHARDS; ++s)
-        sink3 += chain_query(hot[s][a], hot[s][b], hot[s][c], hot[s][d]);
+        sink3 += chain_query1(hot[s][a], hot[s][b], hot[s][c], hot[s][d]);
+      for (int s = 0; s < SHARDS; ++s)
+        sink3 += chain_query2(hot[s][a], hot[s][b], hot[s][c], hot[s][d]);
+      for (int s = 0; s < SHARDS; ++s)
+        sink3 += chain_query3(hot[s][a], hot[s][b], hot[s][c], hot[s][d]);
     }
     double dt1 = now_s() - t1;
     printf("{\"workload\": \"tall_chains_1Bx64shards\", \"native_cpu_qps\": "

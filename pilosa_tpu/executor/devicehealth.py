@@ -62,6 +62,7 @@ class DeviceHealth:
         probe_fn: Optional[Callable[[], None]] = None,
         max_workers: int = 32,
         on_restore: Optional[Callable[[], None]] = None,
+        logger=None,
     ) -> None:
         self.timeout_s = timeout_s
         self.probe_interval_s = probe_interval_s
@@ -69,6 +70,7 @@ class DeviceHealth:
         self._probe_fn = probe_fn or _default_probe
         self._max_workers = max_workers
         self.on_restore = on_restore
+        self._logger = logger  # printf-style, like utils/logger.py
         self._lock = threading.Lock()
         self._pool: Optional[ThreadPoolExecutor] = None
         self._healthy = True
@@ -156,6 +158,13 @@ class DeviceHealth:
                 self._trip("device probe failed after call deadline")
                 raise DeviceDown("device call timed out and probe failed")
 
+    def _log(self, fmt: str, *args) -> None:
+        if self._logger is not None:
+            try:
+                self._logger.printf(fmt, *args)
+            except Exception:
+                pass
+
     def _trip(self, reason: str) -> None:
         with self._lock:
             if not self._healthy:
@@ -168,6 +177,7 @@ class DeviceHealth:
                 threading.Thread(
                     target=self._probe_loop, name="device-probe", daemon=True
                 ).start()
+        self._log("device health: gated off (%s)", reason)
         if pool is not None:
             # release the abandoned pool's IDLE workers (they'd block
             # on its queue forever otherwise — N flap cycles must not
@@ -193,16 +203,21 @@ class DeviceHealth:
                 if cb is not None:
                     try:
                         cb()
-                    except Exception:
+                    except Exception as e:
                         # visible, not silent: a deterministic callback
                         # bug would otherwise keep a healthy device
                         # gated forever with no signal
                         self.restore_failures += 1
+                        self._log(
+                            "device health: restore callback failed "
+                            "(attempt %d): %s", self.restore_failures, e
+                        )
                         continue
                 with self._lock:
                     self._healthy = True
                     self.restores += 1
                     self._probing = False
+                self._log("device health: restored (trip #%d)", self.trips)
                 return
             # probe hung or failed: thread abandoned, loop again
 

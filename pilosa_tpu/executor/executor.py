@@ -114,6 +114,7 @@ class Executor:
         max_writes_per_request: int = 5000,
         mesh=None,
         health=None,
+        auto_min_containers: Optional[int] = None,
     ) -> None:
         self.holder = holder
         self.cluster = cluster  # None = single-node
@@ -153,12 +154,17 @@ class Executor:
         # _touched_containers + AUTOTUNE.json). The default assumes a
         # co-located chip (~1-2 ms dispatch ⇒ crossover ~10^2); deploys
         # behind a high-RTT tunnel should raise it (the measured tunnel
-        # crossover on this rig is ~3,700).
-        self.auto_min_containers = int(
-            os.environ.get(
-                "PILOSA_AUTO_DEVICE_MIN_CONTAINERS", AUTO_DEVICE_MIN_CONTAINERS
+        # crossover on this rig is ~3,700). Precedence: explicit
+        # constructor value (the server plumbs its config knob here) >
+        # PILOSA_AUTO_DEVICE_MIN_CONTAINERS env > AUTOTUNE default.
+        if auto_min_containers is not None:
+            self.auto_min_containers = int(auto_min_containers)
+        else:
+            self.auto_min_containers = int(
+                os.environ.get(
+                    "PILOSA_AUTO_DEVICE_MIN_CONTAINERS", AUTO_DEVICE_MIN_CONTAINERS
+                )
             )
-        )
         self._read_pool = None  # lazy; see execute()
         self._read_pool_mu = threading.Lock()
         # compiled shard_map kernels keyed by (kind, static args) — the

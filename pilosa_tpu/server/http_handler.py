@@ -413,9 +413,18 @@ class Handler:
         }
 
     def get_debug_vars(self, req) -> dict:
-        if hasattr(self.stats, "snapshot"):
-            return self.stats.snapshot()
-        return {}
+        out = self.stats.snapshot() if hasattr(self.stats, "snapshot") else {}
+        health = getattr(self.api.executor, "health", None)
+        if health is not None:
+            out["device_health"] = {
+                "healthy": health.healthy,
+                "trips": health.trips,
+                "restores": health.restores,
+                "slow_calls": health.slow_calls,
+                "saturations": health.saturations,
+                "restore_failures": health.restore_failures,
+            }
+        return out
 
     def get_debug_pprof(self, req):
         """Live thread stack dump — the CPython analog of the reference's
@@ -512,6 +521,25 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
             except APIError as e:
                 payload, ctype = self._error_payload(str(e))
                 self.send_response(e.status)
+            except KeyError as e:
+                # executor lookups raise KeyError("index/field not
+                # found: ...") — the reference maps exactly those to
+                # 404 (successResponse.check, http/handler.go:285-310).
+                # Any OTHER KeyError is an internal bug and must stay a
+                # logged 500, not an invisible not-found.
+                msg = str(e).strip("'\"")
+                if "not found" not in msg:
+                    traceback.print_exc()
+                    payload, ctype = self._error_payload(f"internal error: {msg}")
+                    self.send_response(500)
+                else:
+                    payload, ctype = self._error_payload(msg)
+                    self.send_response(404)
+            except ValueError as e:
+                # bad user input (parse-adjacent arg errors, malformed
+                # bodies) — 400, like the reference's BadRequest family
+                payload, ctype = self._error_payload(str(e))
+                self.send_response(400)
             except Exception as e:  # panic recovery (reference ServeHTTP:239-276)
                 traceback.print_exc()
                 payload, ctype = self._error_payload(f"internal error: {e}")

@@ -73,7 +73,9 @@ class Server:
         if self.config.device_policy != "never" and self.config.device_timeout > 0:
             from pilosa_tpu.executor.devicehealth import DeviceHealth
 
-            health = DeviceHealth(timeout_s=self.config.device_timeout)
+            health = DeviceHealth(
+                timeout_s=self.config.device_timeout, logger=self.logger
+            )
         self.executor = Executor(
             self.holder,
             cluster=cluster,
@@ -83,6 +85,11 @@ class Server:
             max_writes_per_request=self.config.max_writes_per_request,
             mesh=self.mesh,
             health=health,
+            auto_min_containers=(
+                self.config.auto_device_min_containers
+                if self.config.auto_device_min_containers > 0
+                else None
+            ),
         )
         self.api = API(self.holder, self.executor, cluster=cluster, server=self)
         self.handler = Handler(

@@ -470,6 +470,16 @@ class TestChaos:
             for c in range(0, 4 * SHARD_WIDTH, SHARD_WIDTH // 2):
                 req(s0.uri, "POST", "/index/i/query", f"Set({c}, f=1)".encode())
 
+            def attempt(uri, body):
+                """One request with a single retry: under full-suite
+                machine load a transient connect hiccup must not be
+                recorded as a correctness failure."""
+                try:
+                    return req(uri, "POST", "/index/i/query", body)
+                except Exception:
+                    time.sleep(0.05)
+                    return req(uri, "POST", "/index/i/query", body)
+
             def writer(base_col, uri):
                 i = 0
                 while not stop.is_set():
@@ -479,9 +489,7 @@ class TestChaos:
                     # the more permissive of its two endpoints
                     window_open = dead_window.is_set()
                     try:
-                        st, _ = req(
-                            uri, "POST", "/index/i/query", f"Set({col}, f=2)".encode()
-                        )
+                        st, _ = attempt(uri, f"Set({col}, f=2)".encode())
                         if st == 200:
                             writes_done.append(col)
                         elif not (window_open or dead_window.is_set()):
@@ -497,9 +505,7 @@ class TestChaos:
             def reader(uri):
                 while not stop.is_set():
                     try:
-                        st, body = req(
-                            uri, "POST", "/index/i/query", b"Count(Row(f=1))"
-                        )
+                        st, body = attempt(uri, b"Count(Row(f=1))")
                         if st != 200:
                             read_failures.append(st)
                     except Exception as e:
@@ -520,7 +526,7 @@ class TestChaos:
             dead_window.set()
             victim_cfg = s2.config
             s2.close()
-            deadline = time.monotonic() + 15
+            deadline = time.monotonic() + 30
             saw_down = False
             while time.monotonic() < deadline:
                 if any(
@@ -545,7 +551,7 @@ class TestChaos:
             time.sleep(0.5)
             stop.set()
             for t in threads:
-                t.join(timeout=10)
+                t.join(timeout=30)
                 assert not t.is_alive(), "worker thread hung"
 
             assert not write_errors, write_errors[:5]

@@ -124,6 +124,47 @@ def test_tri_path_equivalence(execs):
     assert not mismatches, mismatches[:3]
 
 
+def test_keyed_tri_path_equivalence():
+    """String-keyed index: key translation happens once at the query
+    boundary, so all three paths must agree through it too."""
+    from pilosa_tpu.core import FieldOptions as FO
+    from pilosa_tpu.utils.translate import TranslateStore
+
+    rng = np.random.default_rng(17)
+    h = Holder()
+    h.open()
+    idx = h.create_index("k", keys=True)
+    idx.create_field("likes", FO(keys=True))
+    ts = TranslateStore()
+    cpu = Executor(h, device_policy="never", translate_store=ts)
+    dev = Executor(h, device_policy="always", translate_store=ts)
+    spmd = Executor(h, device_policy="always", mesh=make_mesh(), translate_store=ts)
+    users = [f"user-{i}" for i in range(40)]
+    things = [f"thing-{i}" for i in range(12)]
+    for _ in range(400):
+        u = users[rng.integers(0, len(users))]
+        t = things[rng.integers(0, len(things))]
+        cpu.execute("k", f'Set("{u}", likes="{t}")')
+    def norm(results):
+        out = []
+        for r in results:
+            out.append(sorted(r.keys) if hasattr(r, "keys") else r)
+        return out
+    for i in range(60):
+        a = things[rng.integers(0, len(things))]
+        b = things[rng.integers(0, len(things))]
+        for q in (
+            f'Count(Row(likes="{a}"))',
+            f'Row(likes="{a}")',
+            f'Count(Intersect(Row(likes="{a}"), Row(likes="{b}")))',
+            f'Count(Union(Row(likes="{a}"), Row(likes="{b}")))',
+            f'TopN(likes, Row(likes="{a}"), n=4)',
+        ):
+            want = norm(cpu.execute("k", q))
+            assert norm(dev.execute("k", q)) == want, q
+            assert norm(spmd.execute("k", q)) == want, q
+
+
 def test_equivalence_after_mutations(execs):
     """Interleave writes with reads: staged state must track mutations
     (generation-keyed staging) on both device paths."""
