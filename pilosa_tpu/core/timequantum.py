@@ -45,16 +45,26 @@ def views_by_time(name: str, t: datetime, quantum: str) -> list[str]:
 
 
 def _add_months(t: datetime, months: int) -> datetime:
-    # mirrors Go's AddDate month arithmetic for the first-of-period points
-    # this walker generates (always day 1 when stepping months/years)
+    """Go AddDate month arithmetic, including its normalization: a day
+    that doesn't exist in the target month rolls forward (Jan 29 + 1
+    month = Mar 1; Feb 29 + 1 year = Mar 1). The walker probes month/
+    year boundaries from arbitrary mid-walk days, so overflow is a
+    reachable case, not a corner."""
+    import calendar
+
     month = t.month - 1 + months
     year = t.year + month // 12
     month = month % 12 + 1
-    return t.replace(year=year, month=month)
+    last = calendar.monthrange(year, month)[1]
+    if t.day <= last:
+        return t.replace(year=year, month=month)
+    return t.replace(year=year, month=month, day=last) + timedelta(
+        days=t.day - last
+    )
 
 
 def _next_year_gte(t: datetime, end: datetime) -> bool:
-    nxt = t.replace(year=t.year + 1)
+    nxt = _add_months(t, 12)
     return nxt.year == end.year or end > nxt
 
 
@@ -109,7 +119,7 @@ def views_by_time_range(name: str, start: datetime, end: datetime, quantum: str)
     while t < end:
         if has_year and _next_year_gte(t, end):
             results.append(view_by_time_unit(name, t, "Y"))
-            t = t.replace(year=t.year + 1)
+            t = _add_months(t, 12)  # Go AddDate(1,0,0): Feb 29 -> Mar 1
         elif has_month and _next_month_gte(t, end):
             results.append(view_by_time_unit(name, t, "M"))
             t = _add_months(t, 1)

@@ -537,7 +537,19 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
                     self.send_response(404)
             except ValueError as e:
                 # bad user input (parse-adjacent arg errors, malformed
-                # bodies) — 400, like the reference's BadRequest family
+                # bodies) — 400, like the reference's BadRequest family.
+                # A ValueError can also be an internal bug surfacing
+                # through this catch; keep the trace reachable without
+                # spamming logs on every client typo: debugf always,
+                # full traceback when verbose.
+                if handler.logger is not None:
+                    handler.logger.debugf(
+                        "400 %s %s: %s\n%s",
+                        method,
+                        parsed.path,
+                        e,
+                        traceback.format_exc(),
+                    )
                 payload, ctype = self._error_payload(str(e))
                 self.send_response(400)
             except Exception as e:  # panic recovery (reference ServeHTTP:239-276)

@@ -245,6 +245,28 @@ class TestField:
         for v in f.views.values():
             assert v.row(1).count() == 0
 
+    def test_time_range_walker_survives_month_boundary_days(self):
+        """Go AddDate normalization (Jan 29 + 1 month = Mar 1): the
+        range walker probes month/year boundaries from mid-walk days,
+        so day >= 29 starts used to crash with 'day is out of range'."""
+        from pilosa_tpu.core.timequantum import views_by_time_range
+
+        for start, end in [
+            (datetime(2019, 1, 29), datetime(2019, 3, 2)),
+            (datetime(2019, 1, 31), datetime(2019, 4, 1)),
+            (datetime(2020, 2, 29), datetime(2021, 3, 1)),  # leap day
+            (datetime(2019, 12, 31, 23), datetime(2020, 1, 1, 2)),
+        ]:
+            views = views_by_time_range("standard", start, end, "YMDH")
+            assert views, (start, end)
+            assert len(views) == len(set(views))
+        # leap-day start with a years-only quantum exercises the
+        # down-walk's year step (Go AddDate(1,0,0) on Feb 29 -> Mar 1)
+        views = views_by_time_range(
+            "standard", datetime(2020, 2, 29), datetime(2023, 1, 1), "Y"
+        )
+        assert views == ["standard_2020", "standard_2021", "standard_2022"]
+
     def test_int_field_value(self, tmp_path):
         f = Field(
             str(tmp_path / "f"),

@@ -124,6 +124,43 @@ def test_tri_path_equivalence(execs):
     assert not mismatches, mismatches[:3]
 
 
+def test_time_quantum_tri_path_equivalence():
+    """Time-field ranges (per-quantum view unions) must agree across
+    all three paths for random timestamps and random range windows."""
+    from pilosa_tpu.core.field import FIELD_TYPE_TIME
+
+    rng = np.random.default_rng(23)
+    h = Holder()
+    h.open()
+    idx = h.create_index("t")
+    f = idx.create_field(
+        "ev", FieldOptions(type=FIELD_TYPE_TIME, time_quantum="YMD")
+    )
+    cpu = Executor(h, device_policy="never")
+    dev = Executor(h, device_policy="always")
+    spmd = Executor(h, device_policy="always", mesh=make_mesh())
+    days = [f"2019-{m:02d}-{d:02d}T{hh:02d}:00"
+            for m in (1, 2, 3) for d in (1, 5, 14, 28) for hh in (0, 12)]
+    for _ in range(300):
+        row = int(rng.integers(0, 8))
+        col = int(rng.integers(0, 2 * SHARD_WIDTH))
+        ts = days[rng.integers(0, len(days))]
+        cpu.execute("t", f"Set({col}, ev={row}, {ts})")
+    windows = [
+        ("2019-01-01T00:00", "2019-02-01T00:00"),
+        ("2019-01-05T00:00", "2019-03-28T00:00"),
+        ("2019-02-14T00:00", "2019-02-15T00:00"),
+        ("2018-12-01T00:00", "2020-01-01T00:00"),
+    ]
+    for i in range(40):
+        row = int(rng.integers(0, 8))
+        lo, hi = windows[rng.integers(0, len(windows))]
+        q = f"Count(Range(ev={row}, {lo}, {hi}))"
+        want = _normalize(cpu.execute("t", q))
+        assert _normalize(dev.execute("t", q)) == want, q
+        assert _normalize(spmd.execute("t", q)) == want, q
+
+
 def test_keyed_tri_path_equivalence():
     """String-keyed index: key translation happens once at the query
     boundary, so all three paths must agree through it too."""
