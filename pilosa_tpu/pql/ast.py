@@ -132,14 +132,57 @@ class Call:
         )
 
     def __str__(self) -> str:
-        parts = [str(c) for c in self.children]
+        """Serialize back to PARSEABLE PQL. The remote-execution leg
+        re-sends calls as text (reference remoteExec,
+        executor.go:1393-1440 sends q.String()), so every special form
+        must invert its parse exactly — the internal ``_``-prefixed
+        args are positional syntax, not named arguments:
+
+          TopN(field, child?, args)      SetRowAttrs(field, row, args)
+          Set(col, args, timestamp?)     Clear/SetColumnAttrs(col, args)
+          Range(field=row, start, end)
+        """
+        name = self.name or "!UNNAMED"
+        special = name in (
+            "Set",
+            "Clear",
+            "SetColumnAttrs",
+            "SetRowAttrs",
+            "TopN",
+            "Range",
+        )
+        parts: list[str] = []
+        if special:
+            # positional grammar of the special forms
+            if "_field" in self.args:
+                parts.append(str(self.args["_field"]))  # bare, never quoted
+                if "_row" in self.args:
+                    parts.append(str(self.args["_row"]))
+            elif "_col" in self.args:
+                parts.append(format_value(self.args["_col"]))
+        parts += [str(c) for c in self.children]
         for key in self.keys():
+            if key.startswith("_") and special:
+                continue  # rendered positionally above / below
             v = self.args[key]
             if isinstance(v, Condition):
                 parts.append(v.string_with_field(key))
             else:
+                # reserved args on a NON-special call render named —
+                # the parser's generic fallback accepts them that way
+                # (e.g. Row(_col=5)); dropping them would change the
+                # query on the remote leg
                 parts.append(f"{key}={format_value(v)}")
-        return f"{self.name or '!UNNAMED'}({', '.join(parts)})"
+        if special:
+            # trailing positional timestamps render bare (quoting them
+            # would fail the parser's timestamp grammar)
+            if "_start" in self.args:
+                parts.append(str(self.args["_start"]))
+            if "_end" in self.args:
+                parts.append(str(self.args["_end"]))
+            if "_timestamp" in self.args:
+                parts.append(str(self.args["_timestamp"]))
+        return f"{name}({', '.join(parts)})"
 
     __repr__ = __str__
 
@@ -165,7 +208,15 @@ def format_value(v: Any) -> str:
     if isinstance(v, bool):
         return "true" if v else "false"
     if isinstance(v, str):
-        return f'"{v}"'
+        # escape exactly what the parser's _quoted_string unescapes —
+        # an unescaped quote in a value would re-parse as different PQL
+        # on the remote leg (injection), or not parse at all
+        s = (
+            v.replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+        return f'"{s}"'
     if isinstance(v, list):
         return "[" + ",".join(format_value(x) for x in v) + "]"
     return str(v)

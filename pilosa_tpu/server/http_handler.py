@@ -542,7 +542,11 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
                 # through this catch; keep the trace reachable without
                 # spamming logs on every client typo: debugf always,
                 # full traceback when verbose.
-                if handler.logger is not None:
+                # format_exc is not free — only pay it when debugf
+                # will actually emit (verbose logger)
+                if handler.logger is not None and getattr(
+                    handler.logger, "verbose", False
+                ):
                     handler.logger.debugf(
                         "400 %s %s: %s\n%s",
                         method,

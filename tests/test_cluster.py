@@ -729,6 +729,77 @@ class TestClusterImport:
                 s.close()
 
 
+class TestClusterEquivalenceFuzz:
+    def test_cluster_matches_single_node(self, tmp_path):
+        """Random queries against a 3-node cluster (asked on every
+        node) must match a single-node server holding the same data —
+        the HTTP analog of the tri-path executor fuzz: placement,
+        fan-out, remote exec, and reduce order all under test."""
+        import numpy as np
+
+        rng = np.random.default_rng(99)
+        cluster = boot_static_cluster(tmp_path, n=3, replicas=2)
+        single = boot_static_cluster(tmp_path / "single", n=1)
+        try:
+            n_shards, n_rows = 4, 16
+            rows = rng.integers(0, n_rows, size=2500)
+            cols = rng.integers(0, n_shards * SHARD_WIDTH, size=2500)
+            vcols = rng.choice(n_shards * SHARD_WIDTH, size=400, replace=False)
+            vvals = rng.integers(-50, 500, size=400)
+            for s in (cluster[0], single[0]):
+                req(s.uri, "POST", "/index/i", {})
+                req(s.uri, "POST", "/index/i/field/f", {})
+                req(
+                    s.uri,
+                    "POST",
+                    "/index/i/field/v",
+                    {"options": {"type": "int", "min": -50, "max": 500}},
+                )
+                st, _ = req(
+                    s.uri,
+                    "POST",
+                    "/index/i/field/f/import",
+                    {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()},
+                )
+                assert st == 200
+                st, _ = req(
+                    s.uri,
+                    "POST",
+                    "/index/i/field/v/import-value",
+                    {"columnIDs": vcols.tolist(), "values": vvals.tolist()},
+                )
+                assert st == 200
+                st, _ = req(s.uri, "POST", "/recalculate-caches", {})
+                assert st == 200
+
+            def gen_query():
+                kind = rng.choice(["count", "row", "topn", "sum", "range"])
+                a, b = int(rng.integers(0, n_rows)), int(rng.integers(0, n_rows))
+                if kind == "count":
+                    op = rng.choice(["Intersect", "Union", "Difference", "Xor"])
+                    return f"Count({op}(Row(f={a}), Row(f={b})))"
+                if kind == "row":
+                    return f"Row(f={a})"
+                if kind == "topn":
+                    return f"TopN(f, Row(f={a}), n={int(rng.integers(1, 6))})"
+                if kind == "sum":
+                    return f"Sum(Row(f={a}), field=v)"
+                pred = int(rng.integers(-60, 510))
+                op = rng.choice(["<", "<=", "==", "!=", ">", ">="])
+                return f"Count(Range(v {op} {pred}))"
+
+            for i in range(40):
+                q = gen_query()
+                st, want = req(single[0].uri, "POST", "/index/i/query", q.encode())
+                assert st == 200, (q, want)
+                for node in cluster:
+                    st, got = req(node.uri, "POST", "/index/i/query", q.encode())
+                    assert st == 200 and got == want, (q, node.uri, got, want)
+        finally:
+            for s in cluster + single:
+                s.close()
+
+
 class TestAsyncResize:
     def test_resize_job_async_and_status(self, tmp_path):
         """The coordinator's join handling must not block: the job runs

@@ -180,13 +180,45 @@ class TestStringRoundtrip:
         assert str(c) == "Count(Intersect(Row(a=1), Row(b=2)))"
         c = one("Range(bytes >< [10, 20])")
         assert "10" in str(c) and "20" in str(c)
-        # parse(str(x)) == x for generic calls (positional forms like
-        # Set/TopN stringify with _col/_field args, as in the reference)
+
+    def test_every_call_shape_reparses(self):
+        """parse(str(parse(q))) == parse(q) for EVERY call form — the
+        remote-execution leg re-sends calls as text (reference
+        remoteExec, executor.go:1393-1440), so a form that doesn't
+        re-parse breaks every cross-node query using it (a TopN with a
+        source child did exactly that before this contract existed)."""
         for q in [
             "Count(Intersect(Row(a=1), Row(b=2)))",
             "Union(Row(a=1), Row(b=2), Row(c=3))",
             'F(x="hello", y=[1,2,3], z=null)',
+            "Set(33, stargazer=5)",
+            "Set(33, stargazer=5, 2017-06-21T09:30)",
+            'Set("alice", likes="pizza")',
+            "Clear(33, stargazer=5)",
+            "TopN(f, n=5)",
+            "TopN(f, Row(g=2), n=5)",
+            "TopN(f, Union(Row(g=1), Row(g=2)), n=3, threshold=7)",
+            'TopN(f, n=2, attrName="cat", attrValues=["a","b"])',
+            "TopN(f, Row(g=1), n=4, tanimotoThreshold=70)",
+            "TopN(f, ids=[1,2,3])",
+            'SetRowAttrs(f, 9, name="x", rank=3)',
+            'SetColumnAttrs(7, active=true, score=1.5)',
+            "Sum(field=v)",
+            "Sum(Row(f=1), field=v)",
+            "Min(field=v)",
+            "Max(field=v)",
+            "Range(v > 10)",
+            "Range(v >< [10, 20])",
+            "Range(v != null)",
+            "Range(f=1, 2010-01-01T00:00, 2010-01-03T00:00)",
+            # strings with quote/backslash/newline must re-parse to the
+            # same value, never to different PQL (remote-leg injection)
+            'SetRowAttrs(f, 9, name="pi\\"zza")',
+            'SetRowAttrs(f, 9, name="a\\\\b")',
+            'SetColumnAttrs(7, note="x\\", rank=999")',
+            # reserved args on non-special calls (the parser's generic
+            # fallback accepts them) must survive serialization
+            "Row(_col=5)",
         ]:
             c = one(q)
-            assert one(str(c)) == c
-        assert str(one("Set(33, stargazer=5)")) == "Set(_col=33, stargazer=5)"
+            assert one(str(c)) == c, (q, str(c))
