@@ -152,26 +152,33 @@ class Call:
             "Range",
         )
         parts: list[str] = []
+        positional: set[str] = set()
         if special:
-            # positional grammar of the special forms
+            # positional grammar of the special forms; track exactly
+            # which reserved args the positional syntax covers — any
+            # OTHER reserved arg still renders named below (the parser
+            # accepts reserved names as ordinary args), so nothing is
+            # ever silently dropped from the remote leg
             if "_field" in self.args:
                 parts.append(str(self.args["_field"]))  # bare, never quoted
+                positional.add("_field")
                 if "_row" in self.args:
                     parts.append(str(self.args["_row"]))
+                    positional.add("_row")
             elif "_col" in self.args:
                 parts.append(format_value(self.args["_col"]))
+                positional.add("_col")
+            positional.update(
+                k for k in ("_start", "_end", "_timestamp") if k in self.args
+            )
         parts += [str(c) for c in self.children]
         for key in self.keys():
-            if key.startswith("_") and special:
+            if key in positional:
                 continue  # rendered positionally above / below
             v = self.args[key]
             if isinstance(v, Condition):
                 parts.append(v.string_with_field(key))
             else:
-                # reserved args on a NON-special call render named —
-                # the parser's generic fallback accepts them that way
-                # (e.g. Row(_col=5)); dropping them would change the
-                # query on the remote leg
                 parts.append(f"{key}={format_value(v)}")
         if special:
             # trailing positional timestamps render bare (quoting them
@@ -219,4 +226,11 @@ def format_value(v: Any) -> str:
         return f'"{s}"'
     if isinstance(v, list):
         return "[" + ",".join(format_value(x) for x in v) + "]"
+    if isinstance(v, float):
+        # positional notation only: the PQL number grammar has no
+        # exponent form, so str(1e-07) would re-parse as the STRING
+        # '1e-07' on the remote leg — a silent type change
+        from decimal import Decimal
+
+        return format(Decimal(repr(v)), "f")
     return str(v)

@@ -773,7 +773,9 @@ class TestClusterEquivalenceFuzz:
                 assert st == 200
 
             def gen_query():
-                kind = rng.choice(["count", "row", "topn", "sum", "range"])
+                kind = rng.choice(
+                    ["count", "row", "topn", "topn_plain", "sum", "range", "minmax"]
+                )
                 a, b = int(rng.integers(0, n_rows)), int(rng.integers(0, n_rows))
                 if kind == "count":
                     op = rng.choice(["Intersect", "Union", "Difference", "Xor"])
@@ -782,19 +784,42 @@ class TestClusterEquivalenceFuzz:
                     return f"Row(f={a})"
                 if kind == "topn":
                     return f"TopN(f, Row(f={a}), n={int(rng.integers(1, 6))})"
+                if kind == "topn_plain":
+                    return f"TopN(f, n={int(rng.integers(1, 8))})"
                 if kind == "sum":
                     return f"Sum(Row(f={a}), field=v)"
+                if kind == "minmax":
+                    return rng.choice(["Min", "Max"]) + "(field=v)"
                 pred = int(rng.integers(-60, 510))
                 op = rng.choice(["<", "<=", "==", "!=", ">", ">="])
                 return f"Count(Range(v {op} {pred}))"
 
-            for i in range(40):
-                q = gen_query()
+            for i in range(50):
+                # multi-call requests exercise the concurrent read pool
+                # + batched coalescing through the cluster fan-out
+                q = gen_query() if rng.random() < 0.7 else gen_query() + " " + gen_query()
                 st, want = req(single[0].uri, "POST", "/index/i/query", q.encode())
                 assert st == 200, (q, want)
                 for node in cluster:
                     st, got = req(node.uri, "POST", "/index/i/query", q.encode())
                     assert st == 200 and got == want, (q, node.uri, got, want)
+
+            # interleave writes (same write to both deployments, any
+            # cluster node) with immediate cross-checks
+            for i in range(10):
+                row = int(rng.integers(0, n_rows))
+                col = int(rng.integers(0, n_shards * SHARD_WIDTH))
+                w = f"Set({col}, f={row})"
+                st1, r1 = req(
+                    cluster[i % 3].uri, "POST", "/index/i/query", w.encode()
+                )
+                st2, r2 = req(single[0].uri, "POST", "/index/i/query", w.encode())
+                assert st1 == 200 and st2 == 200 and r1 == r2, (w, r1, r2)
+                q = f"Count(Row(f={row}))"
+                _, want = req(single[0].uri, "POST", "/index/i/query", q.encode())
+                for node in cluster:
+                    _, got = req(node.uri, "POST", "/index/i/query", q.encode())
+                    assert got == want, (q, node.uri, got, want)
         finally:
             for s in cluster + single:
                 s.close()

@@ -72,7 +72,7 @@ def _gen_bitmap(rng, depth: int) -> str:
         field = rng.choice(["f", "g"])
         return f"Row({field}={int(rng.integers(0, N_ROWS))})"
     op = rng.choice(["Intersect", "Union", "Difference", "Xor"])
-    arity = 2 if op in ("Difference", "Xor") else int(rng.integers(2, 4))
+    arity = int(rng.integers(2, 4))  # Difference/Xor are n-ary too
     kids = ", ".join(_gen_bitmap(rng, depth - 1) for _ in range(arity))
     return f"{op}({kids})"
 

@@ -219,6 +219,16 @@ class TestStringRoundtrip:
             # reserved args on non-special calls (the parser's generic
             # fallback accepts them) must survive serialization
             "Row(_col=5)",
+            # reserved args a special form's positional grammar doesn't
+            # cover must render named, not vanish
+            "Set(33, f=9, _row=7)",
+            # floats must stay positional notation (no exponent) and
+            # stay floats across the wire
+            "SetColumnAttrs(7, score=0.0000001)",
+            "SetColumnAttrs(7, big=123456789.5)",
         ]:
             c = one(q)
             assert one(str(c)) == c, (q, str(c))
+        # exactness: the re-parsed float equals the original bit-for-bit
+        c = one("SetColumnAttrs(7, score=0.0000001)")
+        assert one(str(c)).args["score"] == 1e-07
