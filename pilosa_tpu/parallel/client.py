@@ -17,11 +17,13 @@ class ClientError(Exception):
 
     ``transport`` is True when the node never answered (refused
     connection, DNS, socket timeout) — liveness evidence — and False
-    for HTTP-level errors, where the node is provably alive."""
+    for HTTP-level errors, where the node is provably alive.
+    ``status`` carries the HTTP status code when one was received."""
 
-    def __init__(self, msg: str, transport: bool = False) -> None:
+    def __init__(self, msg: str, transport: bool = False, status=None) -> None:
         super().__init__(msg)
         self.transport = transport
+        self.status = status
 
 
 class InternalClient:
@@ -56,7 +58,7 @@ class InternalClient:
                 msg = json.loads(e.read()).get("error", str(e))
             except Exception:
                 msg = str(e)
-            raise ClientError(f"{method} {url}: {msg}") from e
+            raise ClientError(f"{method} {url}: {msg}", status=e.code) from e
         except (urllib.error.URLError, OSError) as e:
             raise ClientError(f"{method} {url}: {e}", transport=True) from e
         if raw:
@@ -187,6 +189,10 @@ class InternalClient:
         )
 
     # -- shard streaming for resize (reference RetrieveShardFromURI:544) --
+
+    def fragment_inventory(self, uri: str) -> list[dict]:
+        """Every (index, field, view, shard) the node holds."""
+        return self._request("GET", uri, "/internal/fragments")
 
     def retrieve_fragment(
         self, uri: str, index: str, field: str, view: str, shard: int

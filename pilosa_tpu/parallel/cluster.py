@@ -187,22 +187,40 @@ class Cluster:
             return
         os.makedirs(os.path.dirname(self.topology_path) or ".", exist_ok=True)
         with open(self.topology_path, "w") as f:
-            json.dump([n.to_dict() for n in self.nodes], f)
+            # placement parameters persist with the topology: an
+            # adopted replicaN must survive a restart, or the node
+            # reverts to its misconfigured local value and recreates
+            # the ownership divergence adoption exists to close
+            json.dump(
+                {
+                    "nodes": [n.to_dict() for n in self.nodes],
+                    "replicaN": self.replica_n,
+                    "partitionN": self.partition_n,
+                },
+                f,
+            )
 
     def _load_topology(self) -> None:
         if not self.topology_path:
             return
         try:
             with open(self.topology_path) as f:
-                saved = [Node.from_dict(d) for d in json.load(f)]
+                raw = json.load(f)
         except FileNotFoundError:
             return
+        if isinstance(raw, list):  # legacy format: bare node list
+            raw = {"nodes": raw}
+        saved = [Node.from_dict(d) for d in raw.get("nodes", [])]
         with self.mu:
             by_id = {n.id: n for n in self.nodes}
             for n in saved:
                 if n.id not in by_id:
                     self.nodes.append(n)
             self._sort_nodes()
+            if raw.get("replicaN"):
+                self.replica_n = int(raw["replicaN"])
+            if raw.get("partitionN"):
+                self.partition_n = int(raw["partitionN"])
 
     # -- membership (HTTP control plane replacing gossip) --------------------
 
@@ -355,6 +373,18 @@ class Cluster:
             self.nodes = [Node.from_dict(d) for d in msg["nodes"]]
             self._sort_nodes()
             self.state = msg["state"]
+            # adopt the cluster's placement parameters (see
+            # _status_message): every node MUST agree on these or
+            # ownership math diverges
+            for key, attr in (("replicaN", "replica_n"), ("partitionN", "partition_n")):
+                v = msg.get(key)
+                if v and v != getattr(self, attr):
+                    if self.logger:
+                        self.logger.printf(
+                            "adopting cluster %s=%s (local config had %s)",
+                            attr, v, getattr(self, attr),
+                        )
+                    setattr(self, attr, int(v))
             self._save_topology()
         self._apply_remote_holder_state(msg)
         if any(n.id == self.node_id for n in self.nodes) and self.state == STATE_NORMAL:
@@ -382,6 +412,15 @@ class Cluster:
                 if holder
                 else {}
             ),
+            # placement parameters are CLUSTER-wide semantics, not
+            # per-node config: a joiner with a different replicas=
+            # setting would compute different shard ownership than the
+            # rest of the cluster — its holder-clean then deletes
+            # fragments the others think it owns (observed data loss).
+            # The coordinator's values ride every status broadcast and
+            # peers adopt them.
+            "replicaN": self.replica_n,
+            "partitionN": self.partition_n,
         }
 
     # -- broadcaster (reference broadcast.go / server.go:520-547) ------------
@@ -675,7 +714,28 @@ class Cluster:
             self.state = STATE_RESIZING
         self.send_async(self._status_message())
 
-        sources = self._frag_sources(old_nodes, new_nodes)
+        try:
+            # inventory of the node being removed is best-effort (a DEAD
+            # node can't answer, and removal is the documented recovery
+            # for one); every other old node must answer or the plan
+            # would miss fragments — abort + rollback beats data loss
+            optional = {remove_node.id} if remove_node is not None else set()
+            sources = self._frag_sources(old_nodes, new_nodes, optional)
+        except Exception as e:  # ANY planning failure must roll back —
+            # the state is already RESIZING and the watchdog isn't
+            # running yet, so an escape here would wedge the cluster
+            if self.logger:
+                self.logger.printf("resize planning failed, rolling back: %s", e)
+            with self.mu:
+                job.state = ResizeJob.FAILED
+                job.error = f"planning failed: {e}"
+                job.done.set()
+                if self.state == STATE_RESIZING:
+                    self.state = STATE_NORMAL
+            self._broadcast_status()
+            with self.mu:
+                self._schedule_next_resize_locked()
+            return
         schema = self.server.holder.schema() if self.server else []
         for node in new_nodes:
             instr = {
@@ -756,10 +816,26 @@ class Cluster:
         job = self._resize_job
         return job.to_dict() if job is not None else None
 
-    def _frag_sources(self, old_nodes: list[Node], new_nodes: list[Node]) -> dict:
-        """node_id -> [{index, field, view, shard, from_uri}] for each
-        fragment the node gains in the new shape (reference fragSources:689-773)."""
-        holder = self.server.holder
+    def _frag_sources(
+        self,
+        old_nodes: list[Node],
+        new_nodes: list[Node],
+        optional_ids: Optional[set] = None,
+    ) -> dict:
+        """node_id -> [{index, field, view, shard, from_uris}] for each
+        fragment the node gains in the new shape (reference
+        fragSources:689-773).
+
+        The COORDINATOR's local fragments are not the cluster's — a
+        shard living only on other nodes must still move when ownership
+        changes, or holder-clean deletes the last copy. So the plan is
+        computed over the UNION of every old node's fragment inventory
+        (one request per node, the availableShards-bitmap analog), and
+        each gained fragment carries every old holder as a candidate
+        source: the receiver falls through 404s to the next holder, so
+        one replica missing a write can never silently drop a transfer.
+        An unreachable old node fails the resize (abort + rollback)
+        rather than risk planning without its fragments."""
         out: dict[str, list[dict]] = {}
 
         def owners(nodes, index, shard):
@@ -770,32 +846,53 @@ class Cluster:
             rep = min(self.replica_n, n)
             return [nodes[(idx + i) % n] for i in range(rep)]
 
-        # Balance streaming load over source replicas: cycle through each
-        # fragment's old owners instead of always hammering the first one
-        # (reference fragSources spreads sources the same way,
-        # cluster.go:689-773).
+        # cluster-wide inventory: (index, field, view, shard) -> holder
+        # uris. Remote fetches fan out concurrently — planning runs
+        # with the cluster gated in RESIZING, so it must be bounded by
+        # the slowest node, not the sum of all of them.
+        def fetch(node):
+            if node.id == self.node_id:
+                return node, self.server.api.fragment_inventory()
+            try:
+                return node, self.client.fragment_inventory(node.uri)
+            except ClientError:
+                if optional_ids and node.id in optional_ids:
+                    # the node being removed may be dead — that is
+                    # exactly why it is being removed; its replicas
+                    # hold the surviving copies
+                    if self.logger:
+                        self.logger.printf(
+                            "inventory from removed node %s unavailable; "
+                            "planning from the remaining nodes", node.id
+                        )
+                    return node, []
+                raise
+
+        holders: dict[tuple, list[str]] = {}
+        for node, inv in self._pool.map(fetch, old_nodes):
+            for e in inv:
+                key = (e["index"], e["field"], e["view"], e["shard"])
+                holders.setdefault(key, []).append(node.uri)
+
+        # Balance streaming load over source replicas: rotate each
+        # fragment's candidate list so the first choice cycles
+        # (reference fragSources spreads sources the same way).
         rr = itertools.count()
-        for iname, idx in holder.indexes.items():
-            for fname, fld in idx.fields.items():
-                for vname, view in fld.views.items():
-                    for shard in view.fragments:
-                        old_owners = owners(old_nodes, iname, shard)
-                        old_owner_ids = {n.id for n in old_owners}
-                        for node in owners(new_nodes, iname, shard):
-                            if node.id in old_owner_ids:
-                                continue
-                            if not old_owners:
-                                continue
-                            src = old_owners[next(rr) % len(old_owners)]
-                            out.setdefault(node.id, []).append(
-                                {
-                                    "index": iname,
-                                    "field": fname,
-                                    "view": vname,
-                                    "shard": shard,
-                                    "from_uri": src.uri,
-                                }
-                            )
+        for (iname, fname, vname, shard), holder_uris in sorted(holders.items()):
+            old_owner_ids = {n.id for n in owners(old_nodes, iname, shard)}
+            for node in owners(new_nodes, iname, shard):
+                if node.id in old_owner_ids:
+                    continue
+                k = next(rr) % len(holder_uris)
+                out.setdefault(node.id, []).append(
+                    {
+                        "index": iname,
+                        "field": fname,
+                        "view": vname,
+                        "shard": shard,
+                        "from_uris": holder_uris[k:] + holder_uris[:k],
+                    }
+                )
         return out
 
     def _follow_resize_instruction(self, msg: dict) -> None:
@@ -806,9 +903,32 @@ class Cluster:
             for src in msg.get("sources", []):
                 if self._resize_abort.is_set():
                     return
-                data = self.client.retrieve_fragment(
-                    src["from_uri"], src["index"], src["field"], src["view"], src["shard"]
-                )
+                uris = src.get("from_uris") or [src["from_uri"]]
+                data = None
+                hard: Optional[ClientError] = None
+                for uri in uris:
+                    try:
+                        data = self.client.retrieve_fragment(
+                            uri, src["index"], src["field"], src["view"], src["shard"]
+                        )
+                        break
+                    except ClientError as e:
+                        # fall through to the next candidate holder on
+                        # ANY failure — a holder that died mid-resize
+                        # must not fail the transfer while healthy
+                        # replicas remain. 404 = fragment genuinely
+                        # absent there; other errors are remembered and
+                        # re-raised only if NO candidate delivers.
+                        if e.status != 404:
+                            hard = e
+                        continue
+                if data is None:
+                    if hard is not None:
+                        raise hard
+                    # every listed holder 404'd: the fragment was
+                    # deleted cluster-wide since planning (e.g. a
+                    # concurrent index drop) — nothing to move
+                    continue
                 self.server.api.unmarshal_fragment(
                     src["index"], src["field"], src["view"], src["shard"], data
                 )

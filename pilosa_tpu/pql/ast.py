@@ -229,8 +229,10 @@ def format_value(v: Any) -> str:
     if isinstance(v, float):
         # positional notation only: the PQL number grammar has no
         # exponent form, so str(1e-07) would re-parse as the STRING
-        # '1e-07' on the remote leg — a silent type change
+        # '1e-07' on the remote leg — a silent type change. Keep a
+        # decimal point so integral floats (1e22) don't re-parse as int.
         from decimal import Decimal
 
-        return format(Decimal(repr(v)), "f")
+        s = format(Decimal(repr(v)), "f")
+        return s if "." in s else s + ".0"
     return str(v)

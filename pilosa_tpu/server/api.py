@@ -180,6 +180,27 @@ class API:
         self._validate("schema")
         return self.holder.schema()
 
+    def fragment_inventory(self) -> list[dict]:
+        """Every (index, field, view, shard) this node holds — the
+        resize coordinator unions these across old owners so fragment
+        moves enumerate what EXISTS, not the whole shard space (the
+        reference's availableShards bitmaps serve the same purpose,
+        cluster.go:689-773)."""
+        out = []
+        for iname, idx in self.holder.indexes.items():
+            for fname, fld in idx.fields.items():
+                for vname, view in fld.views.items():
+                    for shard in sorted(view.fragments):
+                        out.append(
+                            {
+                                "index": iname,
+                                "field": fname,
+                                "view": vname,
+                                "shard": shard,
+                            }
+                        )
+        return out
+
     def views(self, index: str, field: str) -> list[str]:
         self._validate("views")
         f = self.holder.field(index, field)

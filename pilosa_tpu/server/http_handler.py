@@ -125,6 +125,7 @@ class Handler:
             Route("GET", r"/internal/fragment/data", self.get_fragment_data),
             Route("POST", r"/internal/fragment/data", self.post_fragment_data),
             Route("GET", r"/internal/shards/max", lambda req: {"standard": a.max_shards()}),
+            Route("GET", r"/internal/fragments", lambda req: a.fragment_inventory()),
             Route("GET", r"/internal/translate/data", self.get_translate_data),
             Route(
                 "POST",

@@ -366,6 +366,9 @@ def _enc_cluster_status(msg: dict) -> bytes:
         _write_bytes(out, 15, _enc_schema(msg["schema"]))
     if msg.get("maxShards"):
         _write_bytes(out, 16, _enc_max_shards(msg["maxShards"]))
+    # cluster-wide placement parameters (extension; peers adopt them)
+    _write_uint(out, 17, int(msg.get("replicaN", 0)))
+    _write_uint(out, 18, int(msg.get("partitionN", 0)))
     return bytes(out)
 
 
@@ -383,6 +386,12 @@ def _dec_cluster_status(data: bytes) -> dict:
     cid = _str(f, 1)
     if cid:
         out["clusterID"] = cid
+    rep = int(_first(f, 17, 0))
+    if rep:
+        out["replicaN"] = rep
+    part = int(_first(f, 18, 0))
+    if part:
+        out["partitionN"] = part
     return out
 
 
@@ -394,11 +403,19 @@ def _enc_resize_instruction(msg: dict) -> bytes:
     _write_bytes(out, 3, _enc_node({"uri": msg.get("coordinator", "")}))
     for src in msg.get("sources", []):
         sb = bytearray()
-        _write_bytes(sb, 1, _enc_node({"uri": src.get("from_uri", "")}))
+        uris = src.get("from_uris") or (
+            [src["from_uri"]] if src.get("from_uri") else []
+        )
+        # reference slot carries the first candidate; the full fallback
+        # list rides extension field 6 (repeated URI — unknown to a
+        # reference decoder, which uses the single Node)
+        _write_bytes(sb, 1, _enc_node({"uri": uris[0] if uris else ""}))
         _write_str(sb, 2, src["index"])
         _write_str(sb, 3, src["field"])
         _write_str(sb, 4, src["view"])
         _write_uint(sb, 5, int(src["shard"]))
+        for u in uris:
+            _write_bytes(sb, 6, _enc_uri_str(u))
         _write_bytes(out, 4, bytes(sb))
     _write_bytes(out, 5, _enc_schema(msg.get("schema", [])))
     # reference field 6 is a full ClusterStatus; the rebuild's
@@ -416,15 +433,17 @@ def _dec_resize_instruction(data: bytes) -> dict:
     for sb in f.get(4, []):
         s = _decode_multi(sb)
         node = _first(s, 1)
-        sources.append(
-            {
-                "index": _str(s, 2),
-                "field": _str(s, 3),
-                "view": _str(s, 4),
-                "shard": int(_first(s, 5, 0)),
-                "from_uri": _dec_node(node)["uri"] if node else "",
-            }
-        )
+        uris = [_dec_uri_str(b) for b in s.get(6, [])]
+        src = {
+            "index": _str(s, 2),
+            "field": _str(s, 3),
+            "view": _str(s, 4),
+            "shard": int(_first(s, 5, 0)),
+            "from_uri": _dec_node(node)["uri"] if node else "",
+        }
+        if uris:
+            src["from_uris"] = uris
+        sources.append(src)
     node = _first(f, 2)
     coord = _first(f, 3)
     schema = _first(f, 5)

@@ -825,6 +825,204 @@ class TestClusterEquivalenceFuzz:
                 s.close()
 
 
+class TestPlacementParamAdoption:
+    def test_joiner_with_mismatched_replicas_adopts_cluster_value(self, tmp_path):
+        """replicas= is cluster-wide semantics: a joiner configured
+        with a different value used to compute different ownership than
+        everyone else, and its holder-clean deleted fragments the rest
+        of the cluster had just transferred to it (observed data loss).
+        The coordinator's placement parameters ride every status
+        broadcast and the joiner adopts them."""
+        import time as _time
+
+        servers = boot_static_cluster(tmp_path, n=3, replicas=2)
+        try:
+            s0 = servers[0]
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            for sh in range(6):
+                req(
+                    s0.uri,
+                    "POST",
+                    "/index/i/query",
+                    f"Set({sh * SHARD_WIDTH + 9}, f=2)".encode(),
+                )
+            # joiner deliberately misconfigured with replicas=1
+            ports = free_ports(1)
+            cfg = Config(
+                data_dir=str(tmp_path / "n3"),
+                bind=f"127.0.0.1:{ports[0]}",
+                device_policy="never",
+                metric="none",
+                cluster=ClusterConfig(
+                    disabled=False,
+                    coordinator=False,
+                    coordinator_host=s0.uri,
+                    replicas=1,
+                ),
+            )
+            s3 = Server(cfg)
+            s3.open()
+            servers.append(s3)
+            assert s3.cluster.replica_n == 2  # adopted from the cluster
+            deadline = _time.time() + 15
+            while _time.time() < deadline:
+                if all(
+                    req(s.uri, "GET", "/status")[1]["state"] == "NORMAL"
+                    for s in servers
+                ):
+                    break
+                _time.sleep(0.2)
+            _time.sleep(0.5)
+            # every shard the joiner owns must actually be present on it
+            v = s3.holder.view("i", "f", "standard")
+            frags = set(v.fragments) if v else set()
+            owned = {
+                sh for sh in range(6) if s3.cluster.owns_shard("i", sh)
+            }
+            assert owned <= frags, (owned, frags)
+            for s in servers:
+                st, body = req(s.uri, "POST", "/index/i/query", b"Count(Row(f=2))")
+                assert st == 200 and body["results"][0] == 6, (s.uri, body)
+        finally:
+            for s in servers:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+
+
+class TestRemoveDeadNode:
+    def test_remove_node_that_died(self, tmp_path):
+        """The documented recovery for a dead node is operator removal;
+        planning must tolerate the removed node being unreachable and
+        answers must survive on the remaining replicas."""
+        import time as _time
+
+        servers = boot_static_cluster(tmp_path, n=3, replicas=2)
+        try:
+            s0, s1, s2 = servers
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            cols = [sh * SHARD_WIDTH + 5 for sh in range(6)]
+            for c in cols:
+                req(s0.uri, "POST", "/index/i/query", f"Set({c}, f=3)".encode())
+            dead_id = s2.cluster.node_id
+            s2.close()  # node dies
+            st, _ = req(
+                s0.uri, "POST", "/cluster/resize/remove-node", {"id": dead_id}
+            )
+            assert st == 200
+            deadline = _time.time() + 20
+            ok = False
+            while _time.time() < deadline:
+                st, body = req(s0.uri, "GET", "/status")
+                if body["state"] == "NORMAL" and len(body["nodes"]) == 2:
+                    ok = True
+                    break
+                _time.sleep(0.2)
+            assert ok, body
+            for s in (s0, s1):
+                st, body = req(s.uri, "POST", "/index/i/query", b"Count(Row(f=3))")
+                assert st == 200 and body["results"][0] == 6, (s.uri, body)
+        finally:
+            for s in servers:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+
+
+class TestResizeEquivalence:
+    def test_answers_invariant_across_node_join(self, tmp_path):
+        """Query answers must be identical before a resize, after the
+        fragment moves complete, and from EVERY node — the fuzz form of
+        the reference's resize tests (placement changed, data didn't)."""
+        import time as _time
+
+        import numpy as np
+
+        rng = np.random.default_rng(41)
+        ports = free_ports(3)
+        servers = []
+        for i in range(2):
+            cfg = Config(
+                data_dir=str(tmp_path / f"n{i}"),
+                bind=f"127.0.0.1:{ports[i]}",
+                device_policy="never",
+                metric="none",
+                cluster=ClusterConfig(
+                    disabled=False,
+                    coordinator=(i == 0),
+                    coordinator_host="" if i == 0 else f"http://127.0.0.1:{ports[0]}",
+                ),
+            )
+            s = Server(cfg)
+            s.open()
+            servers.append(s)
+        try:
+            s0 = servers[0]
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            rows = rng.integers(0, 12, size=1500)
+            cols = rng.integers(0, 5 * SHARD_WIDTH, size=1500)
+            st, _ = req(
+                s0.uri,
+                "POST",
+                "/index/i/field/f/import",
+                {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()},
+            )
+            assert st == 200
+            req(s0.uri, "POST", "/recalculate-caches", {})
+
+            queries = []
+            for _ in range(15):
+                a, b = int(rng.integers(0, 12)), int(rng.integers(0, 12))
+                queries += [
+                    f"Count(Row(f={a}))",
+                    f"Count(Intersect(Row(f={a}), Row(f={b})))",
+                    f"TopN(f, Row(f={a}), n=4)",
+                ]
+            before = {}
+            for q in queries:
+                st, body = req(s0.uri, "POST", "/index/i/query", q.encode())
+                assert st == 200, (q, body)
+                before[q] = body
+
+            # join a third node: triggers a resize job + fragment moves
+            cfg2 = Config(
+                data_dir=str(tmp_path / "n2"),
+                bind=f"127.0.0.1:{ports[2]}",
+                device_policy="never",
+                metric="none",
+                cluster=ClusterConfig(
+                    disabled=False,
+                    coordinator=False,
+                    coordinator_host=s0.uri,
+                ),
+            )
+            s2 = Server(cfg2)
+            s2.open()  # blocks until the cluster is NORMAL again
+            servers.append(s2)
+            deadline = _time.time() + 20
+            while _time.time() < deadline:
+                sts = [req(s.uri, "GET", "/status")[1]["state"] for s in servers]
+                if all(s == "NORMAL" for s in sts):
+                    break
+                _time.sleep(0.2)
+
+            for s in servers:
+                for q in queries:
+                    st, body = req(s.uri, "POST", "/index/i/query", q.encode())
+                    assert st == 200 and body == before[q], (q, s.uri, body, before[q])
+        finally:
+            for s in servers:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+
+
 class TestAsyncResize:
     def test_resize_job_async_and_status(self, tmp_path):
         """The coordinator's join handling must not block: the job runs
@@ -919,10 +1117,13 @@ class TestAsyncResize:
             sources = s0.cluster._frag_sources(old_nodes, new_nodes)
             ghost_srcs = sources.get("zzzghost", [])
             assert ghost_srcs, "ghost node should gain fragments"
-            from_uris = {src["from_uri"] for src in ghost_srcs}
-            # with replicas=2 both old nodes hold every fragment; a
-            # balanced picker uses both as sources
-            assert len(from_uris) == 2, from_uris
+            # every source now carries the FULL candidate list (404
+            # fall-through), rotated for balance: with replicas=2 both
+            # old nodes hold every fragment, so each entry lists both
+            # and the first choice alternates between them
+            firsts = {src["from_uris"][0] for src in ghost_srcs}
+            assert len(firsts) == 2, firsts
+            assert all(len(src["from_uris"]) == 2 for src in ghost_srcs)
         finally:
             for s in servers:
                 s.close()

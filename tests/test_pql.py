@@ -232,3 +232,11 @@ class TestStringRoundtrip:
         # exactness: the re-parsed float equals the original bit-for-bit
         c = one("SetColumnAttrs(7, score=0.0000001)")
         assert one(str(c)).args["score"] == 1e-07
+        # integral floats must stay floats (1e22 has no '.' in its
+        # positional rendering without the explicit suffix)
+        from pilosa_tpu.pql.ast import Call, format_value
+
+        assert format_value(1e22) == "10000000000000000000000.0"
+        c = Call("SetColumnAttrs", {"_col": 7, "big": 1e22})
+        back = one(str(c)).args["big"]
+        assert isinstance(back, float) and back == 1e22

@@ -70,6 +70,8 @@ MESSAGES = [
         "nodes": NODES,
         "schema": SCHEMA,
         "maxShards": {"idx": 63, "other": 0},
+        "replicaN": 2,
+        "partitionN": 256,
     },
     {
         "type": "resize-instruction",
@@ -83,6 +85,10 @@ MESSAGES = [
                 "view": "standard",
                 "shard": 5,
                 "from_uri": "http://127.0.0.1:10102",
+                "from_uris": [
+                    "http://127.0.0.1:10102",
+                    "http://127.0.0.1:10103",
+                ],
             }
         ],
         "node": NODES[1],
