@@ -177,7 +177,12 @@ class Fragment:
         counts = self.row_counts_for(np.asarray(ids, dtype=np.uint64))
         for row_id, cnt in zip(ids, counts):
             self.cache.bulk_add(row_id, int(cnt))
-        self.cache.invalidate()
+        # recalculate UNCONDITIONALLY: a debounced invalidate() can be
+        # silently skipped when something touched this cache before the
+        # lazy open (e.g. /recalculate-caches sweeping unopened
+        # fragments stamps the debounce clock with empty rankings) —
+        # the restore is authoritative and must rebuild the rankings
+        self.cache.recalculate()
 
     def _row_key_spans(
         self, row_ids: np.ndarray

@@ -150,10 +150,17 @@ class Cluster:
             self.state = STATE_NORMAL
             self._save_topology()
         elif self.is_coordinator:
-            self._load_topology()
+            # the coordinator's CONFIG is operator intent: load the
+            # node list but never let persisted placement params shadow
+            # a deliberate config change (it re-broadcasts its values)
+            self._load_topology(adopt_params=False)
             self.state = STATE_NORMAL
             self._save_topology()
         else:
+            # followers load persisted params so a restart doesn't run
+            # with misconfigured local values before the first status
+            # broadcast re-teaches them
+            self._load_topology(adopt_params=True)
             self._join()
 
     def close(self) -> None:
@@ -200,7 +207,7 @@ class Cluster:
                 f,
             )
 
-    def _load_topology(self) -> None:
+    def _load_topology(self, adopt_params: bool = True) -> None:
         if not self.topology_path:
             return
         try:
@@ -217,10 +224,20 @@ class Cluster:
                 if n.id not in by_id:
                     self.nodes.append(n)
             self._sort_nodes()
-            if raw.get("replicaN"):
-                self.replica_n = int(raw["replicaN"])
-            if raw.get("partitionN"):
-                self.partition_n = int(raw["partitionN"])
+            if adopt_params:
+                for key, attr in (
+                    ("replicaN", "replica_n"),
+                    ("partitionN", "partition_n"),
+                ):
+                    v = raw.get(key)
+                    if v and int(v) != getattr(self, attr):
+                        if self.logger:
+                            self.logger.printf(
+                                "restoring cluster %s=%s from topology "
+                                "(local config had %s)",
+                                attr, v, getattr(self, attr),
+                            )
+                        setattr(self, attr, int(v))
 
     # -- membership (HTTP control plane replacing gossip) --------------------
 
@@ -375,16 +392,22 @@ class Cluster:
             self.state = msg["state"]
             # adopt the cluster's placement parameters (see
             # _status_message): every node MUST agree on these or
-            # ownership math diverges
-            for key, attr in (("replicaN", "replica_n"), ("partitionN", "partition_n")):
-                v = msg.get(key)
-                if v and v != getattr(self, attr):
-                    if self.logger:
-                        self.logger.printf(
-                            "adopting cluster %s=%s (local config had %s)",
-                            attr, v, getattr(self, attr),
-                        )
-                    setattr(self, attr, int(v))
+            # ownership math diverges. Only the COORDINATOR's values
+            # are authoritative — a follower's broadcast carries its
+            # own (possibly misconfigured) copy.
+            if msg.get("fromCoordinator"):
+                for key, attr in (
+                    ("replicaN", "replica_n"),
+                    ("partitionN", "partition_n"),
+                ):
+                    v = msg.get(key)
+                    if v and v != getattr(self, attr):
+                        if self.logger:
+                            self.logger.printf(
+                                "adopting cluster %s=%s (local config had %s)",
+                                attr, v, getattr(self, attr),
+                            )
+                        setattr(self, attr, int(v))
             self._save_topology()
         self._apply_remote_holder_state(msg)
         if any(n.id == self.node_id for n in self.nodes) and self.state == STATE_NORMAL:
@@ -418,9 +441,12 @@ class Cluster:
             # rest of the cluster — its holder-clean then deletes
             # fragments the others think it owns (observed data loss).
             # The coordinator's values ride every status broadcast and
-            # peers adopt them.
+            # peers adopt them; fromCoordinator gates adoption so a
+            # follower's own broadcast (e.g. a local abort) can never
+            # overwrite the cluster's parameters with its misconfig.
             "replicaN": self.replica_n,
             "partitionN": self.partition_n,
+            "fromCoordinator": self.is_coordinator,
         }
 
     # -- broadcaster (reference broadcast.go / server.go:520-547) ------------
@@ -879,9 +905,14 @@ class Cluster:
         # (reference fragSources spreads sources the same way).
         rr = itertools.count()
         for (iname, fname, vname, shard), holder_uris in sorted(holders.items()):
-            old_owner_ids = {n.id for n in owners(old_nodes, iname, shard)}
+            holder_set = set(holder_uris)
             for node in owners(new_nodes, iname, shard):
-                if node.id in old_owner_ids:
+                # skip only destinations that PHYSICALLY hold the
+                # fragment — placement-owner math can disagree with
+                # reality after prior divergence, and an owner missing
+                # its copy must still receive one or holder-clean
+                # deletes the last replica
+                if node.uri in holder_set:
                     continue
                 k = next(rr) % len(holder_uris)
                 out.setdefault(node.id, []).append(

@@ -366,9 +366,11 @@ def _enc_cluster_status(msg: dict) -> bytes:
         _write_bytes(out, 15, _enc_schema(msg["schema"]))
     if msg.get("maxShards"):
         _write_bytes(out, 16, _enc_max_shards(msg["maxShards"]))
-    # cluster-wide placement parameters (extension; peers adopt them)
+    # cluster-wide placement parameters (extension; peers adopt them
+    # only when the broadcast came from the coordinator)
     _write_uint(out, 17, int(msg.get("replicaN", 0)))
     _write_uint(out, 18, int(msg.get("partitionN", 0)))
+    _write_bool(out, 19, bool(msg.get("fromCoordinator")))
     return bytes(out)
 
 
@@ -392,6 +394,8 @@ def _dec_cluster_status(data: bytes) -> dict:
     part = int(_first(f, 18, 0))
     if part:
         out["partitionN"] = part
+    if _first(f, 19, 0):
+        out["fromCoordinator"] = True
     return out
 
 

@@ -72,6 +72,7 @@ MESSAGES = [
         "maxShards": {"idx": 63, "other": 0},
         "replicaN": 2,
         "partitionN": 256,
+        "fromCoordinator": True,
     },
     {
         "type": "resize-instruction",

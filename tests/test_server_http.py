@@ -7,6 +7,7 @@ import urllib.request
 
 import pytest
 
+from pilosa_tpu import SHARD_WIDTH
 from pilosa_tpu.server import Config, Server
 
 
@@ -230,6 +231,72 @@ def test_persistence_across_restart(tmp_path):
         assert s2.node_id == node_id
         st, body = req(s2, "POST", "/index/i/query", b"Row(f=1)")
         assert body["results"][0]["columns"] == [7]
+    finally:
+        s2.close()
+
+
+def test_restart_durability_fuzz(tmp_path):
+    """Randomized write mix (sets, clears, int values, timestamps,
+    attrs, op-log tails past snapshot boundaries) — a restart must
+    answer every query identically to the pre-restart server."""
+    import numpy as np
+
+    rng = np.random.default_rng(12345)
+    cfg = Config(data_dir=str(tmp_path / "d"), bind="127.0.0.1:0", device_policy="never")
+    s = Server(cfg)
+    s.open()
+    req(s, "POST", "/index/i", {})
+    req(s, "POST", "/index/i/field/f", {})
+    req(s, "POST", "/index/i/field/t", {"options": {"type": "time", "timeQuantum": "YMD"}})
+    req(s, "POST", "/index/i/field/v", {"options": {"type": "int", "min": -100, "max": 900}})
+    days = ["2021-03-05T08:00", "2021-03-17T20:00", "2021-06-01T00:00"]
+    batch = []
+    for _ in range(1200):
+        kind = rng.random()
+        col = int(rng.integers(0, 3 * SHARD_WIDTH))
+        row = int(rng.integers(0, 20))
+        if kind < 0.55:
+            batch.append(f"Set({col}, f={row})")
+        elif kind < 0.65:
+            batch.append(f"Clear({col}, f={row})")
+        elif kind < 0.80:
+            batch.append(f"Set({col}, t={row}, {days[rng.integers(0, 3)]})")
+        elif kind < 0.95:
+            batch.append(f"SetValue(col={col}, v={int(rng.integers(-100, 901))})")
+        else:
+            batch.append(f'SetRowAttrs(f, {row}, tag="r{row}", w={int(rng.integers(0, 9))})')
+    for i in range(0, len(batch), 300):
+        st, _ = req(s, "POST", "/index/i/query", " ".join(batch[i : i + 300]).encode())
+        assert st == 200
+
+    queries = []
+    for r in range(0, 20, 3):
+        queries += [
+            f"Count(Row(f={r}))",
+            f"Row(f={r})",
+            f"TopN(f, Row(f={r}), n=5)",
+            f"Count(Range(t={r}, 2021-03-01T00:00, 2021-04-01T00:00))",
+        ]
+    queries += ["Sum(field=v)", "Min(field=v)", "Max(field=v)",
+                "Count(Range(v > 250))", "Count(Range(v >< [-50, 500]))"]
+    req(s, "POST", "/recalculate-caches")
+    before = {}
+    for q in queries:
+        st, body = req(s, "POST", "/index/i/query", q.encode())
+        assert st == 200, (q, body)
+        before[q] = body
+    s.close()
+
+    s2 = Server(Config(data_dir=str(tmp_path / "d"), bind="127.0.0.1:0", device_policy="never"))
+    s2.open()
+    try:
+        req(s2, "POST", "/recalculate-caches")
+        for q in queries:
+            st, body = req(s2, "POST", "/index/i/query", q.encode())
+            assert st == 200 and body == before[q], (q, body, before[q])
+        # attrs survive too
+        st, body = req(s2, "POST", "/index/i/query", b"Row(f=3)")
+        assert st == 200
     finally:
         s2.close()
 
