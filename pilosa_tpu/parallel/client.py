@@ -190,6 +190,16 @@ class InternalClient:
 
     # -- shard streaming for resize (reference RetrieveShardFromURI:544) --
 
+    def translate_keys(self, uri: str, index: str, field: str, keys: list) -> list:
+        """Mint ids for keys on the translate primary."""
+        resp = self._request(
+            "POST",
+            uri,
+            "/internal/translate/keys",
+            body=json.dumps({"index": index, "field": field, "keys": list(keys)}).encode(),
+        )
+        return resp.get("ids", [])
+
     def fragment_inventory(self, uri: str) -> list[dict]:
         """Every (index, field, view, shard) the node holds."""
         return self._request("GET", uri, "/internal/fragments")

@@ -592,6 +592,15 @@ class API:
         data, _ = ts.read_from(offset)
         return data
 
+    def translate_keys(self, index: str, field: str, keys: list) -> list:
+        """Mint (or look up) ids for keys — the follower-forward target;
+        this node must be the translate primary. Mints LOCALLY
+        unconditionally (never re-forwards — see TranslateStore.mint)."""
+        ts = self.executor.translate_store
+        if ts is None:
+            raise APIError("translate store not configured")
+        return ts.mint(index, field, [str(k) for k in keys])
+
 
 def _parse_timestamps(timestamps):
     if not timestamps or not any(t for t in timestamps):

@@ -127,6 +127,7 @@ class Handler:
             Route("GET", r"/internal/shards/max", lambda req: {"standard": a.max_shards()}),
             Route("GET", r"/internal/fragments", lambda req: a.fragment_inventory()),
             Route("GET", r"/internal/translate/data", self.get_translate_data),
+            Route("POST", r"/internal/translate/keys", self.post_translate_keys),
             Route(
                 "POST",
                 r"/internal/index/(?P<index>[^/]+)/attr/diff",
@@ -396,6 +397,15 @@ class Handler:
         q = req.query
         data = self.api.get_translate_data(int(q.get("offset", ["0"])[0]))
         return RawResponse(data, "application/octet-stream")
+
+    def post_translate_keys(self, req) -> dict:
+        """Primary-side key minting for follower forwards: one id space
+        per cluster (reference TranslateFile primary semantics)."""
+        body = json.loads(req.body or b"{}")
+        ids = self.api.translate_keys(
+            body["index"], body.get("field", ""), body.get("keys", [])
+        )
+        return {"ids": ids}
 
     def post_column_attr_diff(self, req) -> dict:
         body = json.loads(req.body or b"{}")

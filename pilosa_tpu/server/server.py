@@ -185,7 +185,46 @@ class Server:
             self.executor.cluster = self.cluster
             self.api.cluster = self.cluster
             self.cluster.attach_server(self)
+        self._wire_translate_primary()
         self._start_background_loops()
+
+    def translate_primary(self) -> str:
+        """URI of the cluster's ONE id-minting translate store — this
+        node replicates from (and forwards new keys to) it unless it IS
+        it. Resolution: explicit translate-primary-url > the coordinator
+        (join mode) > the first static host. Deterministic across nodes,
+        so every node agrees without extra config. Empty = self is
+        primary (or no cluster)."""
+        explicit = self.config.translate_primary_url
+        if explicit:
+            p = explicit if explicit.startswith("http") else f"{self.scheme}://{explicit}"
+            return "" if p == self.uri else p
+        cc = self.config.cluster
+        if self.cluster is None or cc.disabled:
+            return ""
+        if cc.hosts:
+            h = cc.hosts[0]
+            p = h if h.startswith("http") else f"{self.scheme}://{h}"
+            return "" if p == self.uri else p
+        if cc.coordinator:
+            return ""
+        ch = cc.coordinator_host
+        if ch:
+            return ch if ch.startswith("http") else f"{self.scheme}://{ch}"
+        return ""
+
+    def _wire_translate_primary(self) -> None:
+        primary = self.translate_primary()
+        if not primary:
+            return
+        from pilosa_tpu.parallel.client import InternalClient
+
+        client = InternalClient(ssl_context=self.client_ssl_context())
+
+        def forward(index, field, keys):
+            return client.translate_keys(primary, index, field, keys)
+
+        self.translate_store.forward = forward
 
     def _set_file_limit(self) -> None:
         """Raise RLIMIT_NOFILE toward the reference's 262,144 target
@@ -272,19 +311,18 @@ class Server:
                 self.diagnostics.flush()
 
         def translate_replication_loop():
-            primary = self.config.translate_primary_url
+            primary = self.translate_primary()
             if not primary:
                 return
             from pilosa_tpu.parallel.client import ClientError, InternalClient
 
-            client = InternalClient()
+            client = InternalClient(ssl_context=self.client_ssl_context())
             while not self._closed.wait(1.0):
                 try:
-                    data = client.translate_data(
-                        primary, self.translate_store.offset()
-                    )
+                    ts = self.translate_store
+                    data = client.translate_data(primary, ts.replica_offset)
                     if data:
-                        self.translate_store.apply_log(data)
+                        ts.replica_offset += ts.apply_log(data)
                 except ClientError:
                     pass
 

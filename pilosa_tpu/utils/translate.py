@@ -19,6 +19,17 @@ class TranslateStore:
     def __init__(self, path: Optional[str] = None) -> None:
         self.path = path
         self.mu = threading.RLock()
+        # Cluster mode: exactly ONE node may mint ids (the translate
+        # primary) — independent minting on every node assigns the same
+        # id to different keys (observed split-brain: Row(likes="pizza")
+        # returning a different user per node). Followers set this to a
+        # callable forwarding (index, field, missing_keys) -> ids to the
+        # primary; minted pairs also arrive via WAL replication, and
+        # _assign by key is idempotent for that overlap.
+        self.forward = None
+        # read position in the PRIMARY's WAL stream (replica pull);
+        # distinct from _offset, which indexes this store's own file
+        self.replica_offset = 0
         # (index, field) -> {key: id}; field "" = column keys
         self._fwd: dict[tuple[str, str], dict[str, int]] = {}
         self._rev: dict[tuple[str, str], dict[int, str]] = {}
@@ -54,7 +65,36 @@ class TranslateStore:
         fwd[key] = id_
         rev[id_] = key
 
-    def _translate(self, index: str, field: str, keys: Sequence[str], create: bool) -> list[Optional[int]]:
+    def _translate(
+        self,
+        index: str,
+        field: str,
+        keys: Sequence[str],
+        create: bool,
+        allow_forward: bool = True,
+    ) -> list[Optional[int]]:
+        forward = self.forward if allow_forward else None
+        if create and forward is not None:
+            with self.mu:
+                fwd = self._fwd.setdefault((index, field), {})
+                missing = [k for k in keys if k not in fwd]
+            if missing:
+                # network call OUTSIDE the lock; the primary mints ids
+                minted = forward(index, field, missing)
+                if len(minted) != len(missing):
+                    # a short/empty answer must fail the write loudly,
+                    # not silently leave keys unminted
+                    raise ValueError(
+                        f"translate primary answered {len(minted)} ids "
+                        f"for {len(missing)} keys"
+                    )
+                with self.mu:
+                    for key, id_ in zip(missing, minted):
+                        if self._fwd.get((index, field), {}).get(key) is None:
+                            self._assign_logged(index, field, key, int(id_))
+            with self.mu:
+                fwd = self._fwd.setdefault((index, field), {})
+                return [fwd.get(k) for k in keys]
         with self.mu:
             k = (index, field)
             fwd = self._fwd.setdefault(k, {})
@@ -66,16 +106,19 @@ class TranslateStore:
                         out.append(None)
                         continue
                     id_ = len(fwd) + 1  # ids start at 1 (reference semantics)
-                    self._assign(index, field, key, id_)
-                    if self._log:
-                        line = json.dumps(
-                            {"index": index, "field": field, "key": key, "id": id_}
-                        )
-                        self._log.write(line + "\n")
-                        self._log.flush()
-                        self._offset += len(line) + 1
+                    self._assign_logged(index, field, key, id_)
                 out.append(id_)
             return out
+
+    def _assign_logged(self, index: str, field: str, key: str, id_: int) -> None:
+        self._assign(index, field, key, id_)
+        if self._log:
+            line = json.dumps(
+                {"index": index, "field": field, "key": key, "id": id_}
+            )
+            self._log.write(line + "\n")
+            self._log.flush()
+            self._offset += len(line) + 1
 
     # -- interface (reference translate.go:38-48) --
 
@@ -88,6 +131,13 @@ class TranslateStore:
 
     def translate_rows_to_ids(self, index: str, field: str, keys: Sequence[str], create: bool = True):
         return self._translate(index, field, keys, create)
+
+    def mint(self, index: str, field: str, keys: Sequence[str]) -> list:
+        """Authoritative local minting — NEVER forwards. The primary's
+        /internal/translate/keys endpoint must use this: a node whose
+        bind address doesn't string-match its advertised URI would
+        otherwise forward the request back to itself forever."""
+        return self._translate(index, field, keys, create=True, allow_forward=False)
 
     def translate_row_to_string(self, index: str, field: str, id_: int) -> Optional[str]:
         with self.mu:
@@ -107,17 +157,31 @@ class TranslateStore:
             data = f.read()
         return data, offset + len(data)
 
-    def apply_log(self, data: bytes) -> None:
-        """Apply WAL bytes pulled from a primary."""
+    def apply_log(self, data: bytes) -> int:
+        """Apply WAL bytes pulled from a primary; returns the number of
+        bytes CONSUMED (complete lines only — a partial trailing line is
+        left for the next pull). The replica stream has its own offset
+        (``replica_offset``): the primary's file and this store's local
+        WAL are different files, so the local write offset must never
+        index into the primary's. Assignments are by-key idempotent, so
+        re-applying entries (restart re-pulls from 0; forwarded mints
+        arrive again via the stream) is harmless."""
+        consumed = data.rfind(b"\n")  # BYTES: the caller seeks the
+        if consumed < 0:  # primary's file by byte offset, and UTF-8
+            return 0  # keys make chars != bytes
+        consumed += 1
         with self.mu:
-            for line in data.decode().splitlines():
+            for line in data[:consumed].decode(errors="ignore").splitlines():
                 line = line.strip()
                 if not line:
                     continue
-                e = json.loads(line)
-                self._assign(e["index"], e.get("field", ""), e["key"], e["id"])
-                if self._log:
-                    self._log.write(line + "\n")
-            if self._log:
-                self._log.flush()
-            self._offset += len(data)
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue  # torn line from a mid-write read
+                k = (e["index"], e.get("field", ""))
+                if self._fwd.get(k, {}).get(e["key"]) is None:
+                    # persist locally too: replicated mappings must
+                    # survive a restart even when the primary is down
+                    self._assign_logged(e["index"], k[1], e["key"], e["id"])
+        return consumed

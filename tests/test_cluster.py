@@ -646,6 +646,61 @@ class TestAttrSync:
                 s.close()
 
 
+class TestClusterKeyTranslation:
+    def test_keyed_writes_on_any_node_share_one_id_space(self, tmp_path):
+        """Every node used to mint ids independently, so the same id
+        meant DIFFERENT keys per node (Row(likes="pizza") returned a
+        different user depending on which node answered). Followers now
+        forward minting to the deterministic translate primary and
+        stream its WAL, so keyed writes landing on any node converge."""
+        import time as _time
+
+        servers = boot_static_cluster(tmp_path, n=3, replicas=1)
+        try:
+            s0, s1, s2 = servers
+            req(s0.uri, "POST", "/index/k", {"options": {"keys": True}})
+            req(s0.uri, "POST", "/index/k/field/likes", {"options": {"keys": True}})
+            # writes spread over all three nodes
+            for i, (who, what) in enumerate(
+                [("alice", "pizza"), ("bob", "pizza"), ("carol", "sushi"),
+                 ("dave", "pizza"), ("erin", "sushi")]
+            ):
+                st, body = req(
+                    servers[i % 3].uri,
+                    "POST",
+                    "/index/k/query",
+                    f'Set("{who}", likes="{what}")'.encode(),
+                )
+                assert st == 200 and body["results"] == [True], (who, body)
+            # replication tick (1s loop) + settle
+            deadline = _time.time() + 10
+            want_pizza = ["alice", "bob", "dave"]
+
+            def converged(a):
+                # a not-yet-replicated reverse mapping shows up as None
+                return a is not None and None not in a and sorted(a) == want_pizza
+
+            while _time.time() < deadline:
+                answers = [
+                    req(s.uri, "POST", "/index/k/query", b'Row(likes="pizza")')[1][
+                        "results"
+                    ][0]["keys"]
+                    for s in servers
+                ]
+                if all(converged(a) for a in answers):
+                    break
+                _time.sleep(0.2)
+            assert all(converged(a) for a in answers), answers
+            for s in servers:
+                st, body = req(
+                    s.uri, "POST", "/index/k/query", b'Count(Row(likes="sushi"))'
+                )
+                assert body["results"][0] == 2, (s.uri, body)
+        finally:
+            for s in servers:
+                s.close()
+
+
 class TestTranslateReplication:
     def test_replica_pulls_key_log(self, tmp_path):
         from pilosa_tpu.server import ClusterConfig, Config, Server
