@@ -275,6 +275,14 @@ class Executor:
             col_key = "col"
             field_name = c.args.get("field") or ""
             row_key = "row"
+        from pilosa_tpu.pql.ast import WRITE_CALLS
+
+        # Writes mint ids; reads look up only (create=False) — minting
+        # on reads would durably pollute the cluster WAL with typo'd
+        # keys and make read availability depend on the translate
+        # primary being up. An unknown key on a read resolves to id 0,
+        # which is never minted (ids start at 1) and so matches nothing.
+        create = c.name in WRITE_CALLS
         ts = self.translate_store
         if idx.keys:
             v = c.args.get(col_key)
@@ -283,7 +291,8 @@ class Executor:
                     "column value must be a string when index 'keys' option enabled"
                 )
             if isinstance(v, str) and v:
-                c.args[col_key] = ts.translate_columns_to_ids(index, [v])[0]
+                tid = ts.translate_columns_to_ids(index, [v], create=create)[0]
+                c.args[col_key] = tid if tid is not None else 0
         else:
             if isinstance(c.args.get(col_key), str):
                 raise ValueError(
@@ -300,9 +309,10 @@ class Executor:
                         "row value must be a string when field 'keys' option enabled"
                     )
                 if isinstance(v, str) and v:
-                    c.args[row_key] = ts.translate_rows_to_ids(
-                        index, field_name, [v]
+                    tid = ts.translate_rows_to_ids(
+                        index, field_name, [v], create=create
                     )[0]
+                    c.args[row_key] = tid if tid is not None else 0
             else:
                 if isinstance(c.args.get(row_key), str):
                     raise ValueError(

@@ -599,6 +599,17 @@ class API:
         ts = self.executor.translate_store
         if ts is None:
             raise APIError("translate store not configured")
+        if self.server is not None:
+            p = self.server.translate_primary()
+            if p and self.server.logger is not None:
+                # visibility for split-primary misconfiguration: this
+                # node is minting while ITS resolution names another
+                # primary (legitimate only for a bind/advertise
+                # mismatch forwarding to its own address)
+                self.server.logger.printf(
+                    "minting translate keys while resolving primary=%s "
+                    "(check translate-primary-url consistency)", p
+                )
         return ts.mint(index, field, [str(k) for k in keys])
 
 

@@ -172,6 +172,10 @@ class Server:
                 os.path.expanduser(self.config.tls.certificate_key_path),
             )
             self.httpd.socket = ctx.wrap_socket(self.httpd.socket, server_side=True)
+        # wire key-translation forwarding BEFORE serving: a keyed write
+        # arriving in the startup window would otherwise mint locally
+        # and permanently diverge the cluster id space
+        self._wire_translate_primary()
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True
         )
@@ -185,32 +189,34 @@ class Server:
             self.executor.cluster = self.cluster
             self.api.cluster = self.cluster
             self.cluster.attach_server(self)
-        self._wire_translate_primary()
         self._start_background_loops()
+
+    def _normalize_host_uri(self, h: str) -> str:
+        """host[:port] or URI → full URI with this server's scheme."""
+        return h if h.startswith("http") else f"{self.scheme}://{h}"
 
     def translate_primary(self) -> str:
         """URI of the cluster's ONE id-minting translate store — this
         node replicates from (and forwards new keys to) it unless it IS
         it. Resolution: explicit translate-primary-url > the coordinator
-        (join mode) > the first static host. Deterministic across nodes,
-        so every node agrees without extra config. Empty = self is
-        primary (or no cluster)."""
+        (join mode) > the first static host. Config-only, so it resolves
+        before the listener starts. Deterministic across nodes — every
+        node agrees without extra config. Empty = self is primary (or
+        no cluster)."""
         explicit = self.config.translate_primary_url
         if explicit:
-            p = explicit if explicit.startswith("http") else f"{self.scheme}://{explicit}"
+            p = self._normalize_host_uri(explicit)
             return "" if p == self.uri else p
         cc = self.config.cluster
-        if self.cluster is None or cc.disabled:
+        if cc.disabled:
             return ""
         if cc.hosts:
-            h = cc.hosts[0]
-            p = h if h.startswith("http") else f"{self.scheme}://{h}"
+            p = self._normalize_host_uri(cc.hosts[0])
             return "" if p == self.uri else p
         if cc.coordinator:
             return ""
-        ch = cc.coordinator_host
-        if ch:
-            return ch if ch.startswith("http") else f"{self.scheme}://{ch}"
+        if cc.coordinator_host:
+            return self._normalize_host_uri(cc.coordinator_host)
         return ""
 
     def _wire_translate_primary(self) -> None:
@@ -397,8 +403,7 @@ class Server:
                 ssl_context=ssl_ctx,
             )
             cluster.set_nodes(
-                [Node(id=h if h.startswith("http") else f"{scheme}://{h}",
-                      uri=h if h.startswith("http") else f"{scheme}://{h}")
+                [Node(id=self._normalize_host_uri(h), uri=self._normalize_host_uri(h))
                  for h in cc.hosts]
             )
             return cluster
@@ -409,13 +414,9 @@ class Server:
             static=False,
             coordinator=cc.coordinator,
             coordinator_uri=(
-                cc.coordinator_host
-                if cc.coordinator_host.startswith("http")
-                else (
-                    f"{scheme}://{cc.coordinator_host}"
-                    if cc.coordinator_host
-                    else None
-                )
+                self._normalize_host_uri(cc.coordinator_host)
+                if cc.coordinator_host
+                else None
             ),
             topology_path=topology_path,
             logger=self.logger,

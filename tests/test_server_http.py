@@ -301,6 +301,69 @@ def test_restart_durability_fuzz(tmp_path):
         s2.close()
 
 
+def test_backup_restore_full_index(tmp_path):
+    """Disaster recovery drill: tar every fragment off a populated
+    server, restore into a FRESH server (new data dir), and answer
+    identically — the reference's fragment archive workflow end to end."""
+    import numpy as np
+
+    rng = np.random.default_rng(31337)
+    src = Server(Config(data_dir=str(tmp_path / "src"), bind="127.0.0.1:0", device_policy="never"))
+    src.open()
+    req(src, "POST", "/index/b", {})
+    req(src, "POST", "/index/b/field/f", {})
+    req(src, "POST", "/index/b/field/v", {"options": {"type": "int", "min": 0, "max": 99}})
+    rows = rng.integers(0, 10, size=800)
+    cols = rng.integers(0, 2 * SHARD_WIDTH, size=800)
+    st, _ = req(src, "POST", "/index/b/field/f/import",
+                {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()})
+    assert st == 200
+    vcols = rng.choice(2 * SHARD_WIDTH, size=120, replace=False)
+    st, _ = req(src, "POST", "/index/b/field/v/import-value",
+                {"columnIDs": vcols.tolist(), "values": rng.integers(0, 100, size=120).tolist()})
+    assert st == 200
+    req(src, "POST", "/recalculate-caches")
+    queries = [f"Count(Row(f={r}))" for r in range(10)] + [
+        "TopN(f, n=5)", "Sum(field=v)", "Count(Range(v >= 50))"]
+    before = {q: req(src, "POST", "/index/b/query", q.encode())[1] for q in queries}
+
+    # tar every (field, view, shard) off the source
+    archives = []
+    for field in ("f", "v"):
+        st, views = req(src, "GET", f"/index/b/field/{field}/views")
+        for view in views["views"]:
+            for shard in (0, 1):
+                st, data = req(
+                    src, "GET",
+                    f"/internal/fragment/data?index=b&field={field}&view={view}&shard={shard}",
+                    raw=True,
+                )
+                if st == 200:
+                    archives.append((field, view, shard, data))
+    src.close()
+    assert archives
+
+    dst = Server(Config(data_dir=str(tmp_path / "dst"), bind="127.0.0.1:0", device_policy="never"))
+    dst.open()
+    try:
+        req(dst, "POST", "/index/b", {})
+        req(dst, "POST", "/index/b/field/f", {})
+        req(dst, "POST", "/index/b/field/v", {"options": {"type": "int", "min": 0, "max": 99}})
+        for field, view, shard, data in archives:
+            st, _ = req(
+                dst, "POST",
+                f"/internal/fragment/data?index=b&field={field}&view={view}&shard={shard}",
+                data, raw=True,
+            )
+            assert st == 200, (field, view, shard)
+        req(dst, "POST", "/recalculate-caches")
+        for q in queries:
+            st, body = req(dst, "POST", "/index/b/query", q.encode())
+            assert st == 200 and body == before[q], (q, body, before[q])
+    finally:
+        dst.close()
+
+
 def test_debug_vars_and_recalculate(server):
     req(server, "POST", "/index/i", {})
     req(server, "POST", "/index/i/field/f", {})
