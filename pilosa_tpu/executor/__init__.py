@@ -4,9 +4,18 @@ from pilosa_tpu.executor.batcher import BatchedScorer
 from pilosa_tpu.executor.executor import (
     ExecOptions,
     Executor,
+    NotFoundError,
     ValCount,
     pairs_add,
 )
 from pilosa_tpu.executor.stager import DeviceStager
 
-__all__ = ["BatchedScorer", "DeviceStager", "ExecOptions", "Executor", "ValCount", "pairs_add"]
+__all__ = [
+    "BatchedScorer",
+    "DeviceStager",
+    "ExecOptions",
+    "Executor",
+    "NotFoundError",
+    "ValCount",
+    "pairs_add",
+]

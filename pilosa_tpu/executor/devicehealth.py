@@ -57,6 +57,7 @@ class DeviceHealth:
     def __init__(
         self,
         timeout_s: float = 120.0,
+        admission_timeout_s: float = 5.0,
         probe_interval_s: float = 15.0,
         probe_timeout_s: float = 20.0,
         probe_fn: Optional[Callable[[], None]] = None,
@@ -65,6 +66,7 @@ class DeviceHealth:
         logger=None,
     ) -> None:
         self.timeout_s = timeout_s
+        self.admission_timeout_s = admission_timeout_s
         self.probe_interval_s = probe_interval_s
         self.probe_timeout_s = probe_timeout_s
         self._probe_fn = probe_fn or _default_probe
@@ -130,12 +132,15 @@ class DeviceHealth:
         # a concurrent _trip may cancel us while queued — wake the
         # started wait immediately instead of sleeping out the deadline
         fut.add_done_callback(lambda f: started.set())
-        # queue wait is not runtime. A pool that can't start work within
-        # a full deadline is EITHER saturated with hung workers (dead
-        # device) or merely carrying a burst of long CPU-side reads —
-        # the probe distinguishes: only a failed probe condemns the
-        # device; a healthy one degrades just this call to CPU.
-        if not started.wait(timeout=timeout):
+        # queue wait is not runtime — and it gets its OWN, much shorter
+        # deadline: a pool that can't ADMIT work within a few seconds is
+        # either saturated with hung workers (dead device) or carrying a
+        # burst of slow-but-healthy reads. Waiting the full call timeout
+        # here would put a 2-minute latency cliff in front of every read
+        # during a burst; the probe distinguishes the two cases cheaply:
+        # only a failed probe condemns the device, a healthy one degrades
+        # just this call to CPU.
+        if not started.wait(timeout=min(timeout, self.admission_timeout_s)):
             fut.cancel()
             self.saturations += 1
             if self._probe_once():

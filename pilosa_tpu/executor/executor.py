@@ -46,6 +46,11 @@ _W32 = SHARD_WIDTH // 32
 AUTO_DEVICE_MIN_CONTAINERS = 64
 
 
+# re-export: one canonical not-found type framework-wide (the HTTP
+# layer maps it to 404 by type; any plain KeyError stays a 500)
+from pilosa_tpu.utils.errors import NotFoundError  # noqa: E402
+
+
 @dataclass
 class ValCount:
     """reference executor.go:1762."""
@@ -215,7 +220,7 @@ class Executor:
         opt = opt or ExecOptions()
         idx = self.holder.index(index_name)
         if idx is None:
-            raise KeyError(f"index not found: {index_name}")
+            raise NotFoundError(f"index not found: {index_name}")
         if (
             self.max_writes_per_request
             and query.write_call_n() > self.max_writes_per_request
@@ -301,7 +306,7 @@ class Executor:
         if field_name:
             fld = idx.field(field_name)
             if fld is None:
-                raise KeyError(f"field not found: {field_name}")
+                raise NotFoundError(f"field not found: {field_name}")
             if fld.options.keys:
                 v = c.args.get(row_key)
                 if v is not None and not isinstance(v, str):
@@ -495,7 +500,7 @@ class Executor:
         field_name = c.field_arg()
         f = self.holder.field(index, field_name)
         if f is None:
-            raise KeyError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         row_id, ok = c.uint_arg(field_name)
         if not ok:
             raise ValueError(f"Row() must specify {field_name}")
@@ -522,7 +527,7 @@ class Executor:
         field_name = c.field_arg()
         f = self.holder.field(index, field_name)
         if f is None:
-            raise KeyError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         row_id, ok = c.uint_arg(field_name)
         if not ok:
             raise ValueError("Range() must specify row")
@@ -555,10 +560,10 @@ class Executor:
             raise ValueError(f"Range(): expected condition argument, got {cond!r}")
         f = self.holder.field(index, field_name)
         if f is None:
-            raise KeyError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         bsig = f.bsi_group(field_name)
         if bsig is None:
-            raise KeyError(f"bsiGroup not found: {field_name}")
+            raise NotFoundError(f"bsiGroup not found: {field_name}")
         frag = self.holder.fragment(
             index, field_name, VIEW_BSI_GROUP_PREFIX + field_name, shard
         )
@@ -654,7 +659,7 @@ class Executor:
             field_name = c.field_arg()
             f = self.holder.field(index, field_name)
             if f is None:
-                raise KeyError(f"field not found: {field_name}")
+                raise NotFoundError(f"field not found: {field_name}")
             row_id, ok = c.uint_arg(field_name)
             if not ok:
                 raise ValueError(f"Row() must specify {field_name}")
@@ -689,7 +694,7 @@ class Executor:
             field_name = c.field_arg()
             f = self.holder.field(index, field_name)
             if f is None:
-                raise KeyError(f"field not found: {field_name}")
+                raise NotFoundError(f"field not found: {field_name}")
             row_id, ok = c.uint_arg(field_name)
             start_str, ok1 = c.string_arg("_start")
             end_str, ok2 = c.string_arg("_end")
@@ -712,10 +717,10 @@ class Executor:
         ((field_name, cond),) = c.args.items()
         f = self.holder.field(index, field_name)
         if f is None:
-            raise KeyError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         bsig = f.bsi_group(field_name)
         if bsig is None:
-            raise KeyError(f"bsiGroup not found: {field_name}")
+            raise NotFoundError(f"bsiGroup not found: {field_name}")
         frag = self.holder.fragment(
             index, field_name, VIEW_BSI_GROUP_PREFIX + field_name, shard
         )
@@ -841,7 +846,7 @@ class Executor:
             field_name = c.field_arg()
             f = self.holder.field(index, field_name)
             if f is None:
-                raise KeyError(f"field not found: {field_name}")
+                raise NotFoundError(f"field not found: {field_name}")
             row_id, ok = c.uint_arg(field_name)
             if not ok:
                 raise ValueError(f"Row() must specify {field_name}")
@@ -879,7 +884,7 @@ class Executor:
             field_name = c.field_arg()
             f = self.holder.field(index, field_name)
             if f is None:
-                raise KeyError(f"field not found: {field_name}")
+                raise NotFoundError(f"field not found: {field_name}")
             row_id, ok = c.uint_arg(field_name)
             start_str, ok1 = c.string_arg("_start")
             end_str, ok2 = c.string_arg("_end")
@@ -904,10 +909,10 @@ class Executor:
         ((field_name, cond),) = c.args.items()
         f = self.holder.field(index, field_name)
         if f is None:
-            raise KeyError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         bsig = f.bsi_group(field_name)
         if bsig is None:
-            raise KeyError(f"bsiGroup not found: {field_name}")
+            raise NotFoundError(f"bsiGroup not found: {field_name}")
         depth = bsig.bit_depth()
         frags = tuple(
             self.holder.fragment(
@@ -1417,7 +1422,7 @@ class Executor:
         field_name = c.field_arg()
         f = self.holder.field(index, field_name)
         if f is None:
-            raise KeyError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         row_id, ok = c.uint_arg(field_name)
         if not ok:
             raise ValueError("Set() row argument required")
@@ -1436,7 +1441,7 @@ class Executor:
         field_name = c.field_arg()
         f = self.holder.field(index, field_name)
         if f is None:
-            raise KeyError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         row_id, ok = c.uint_arg(field_name)
         if not ok:
             raise ValueError("Clear() row argument required")
@@ -1455,7 +1460,7 @@ class Executor:
         for name, value in args.items():
             f = self.holder.field(index, name)
             if f is None:
-                raise KeyError(f"field not found: {name}")
+                raise NotFoundError(f"field not found: {name}")
             if isinstance(value, bool) or not isinstance(value, int):
                 raise ValueError("invalid BSI group value type")
             f.set_value(col_id, value)
@@ -1468,7 +1473,7 @@ class Executor:
             raise ValueError("SetRowAttrs() field required")
         f = self.holder.field(index, field_name)
         if f is None:
-            raise KeyError(f"field not found: {field_name}")
+            raise NotFoundError(f"field not found: {field_name}")
         row_id, ok = c.uint_arg("_row")
         if not ok:
             raise ValueError("SetRowAttrs() row required")

@@ -28,6 +28,7 @@ from typing import Callable, Optional
 from pilosa_tpu.parallel.client import ClientError, InternalClient
 from pilosa_tpu.parallel.hashing import DEFAULT_PARTITION_N, Jmphasher, partition
 from pilosa_tpu.parallel.node import Node
+from pilosa_tpu.utils.errors import NotFoundError
 from pilosa_tpu.parallel.wire import (
     decode_shard_result,
     encode_shard_result,
@@ -360,6 +361,8 @@ class Cluster:
             self._mark_resize_complete(msg)
         elif typ == "holder-clean":
             self._holder_clean()
+        elif typ == "set-coordinator":
+            self._apply_set_coordinator(msg["node"]["id"])
         elif typ == "node-leave":
             pass  # deliberate: no automatic removal (reference cluster.go:1629)
         else:
@@ -387,15 +390,17 @@ class Cluster:
 
     def _apply_cluster_status(self, msg: dict) -> None:
         with self.mu:
-            self.nodes = [Node.from_dict(d) for d in msg["nodes"]]
-            self._sort_nodes()
-            self.state = msg["state"]
-            # adopt the cluster's placement parameters (see
-            # _status_message): every node MUST agree on these or
-            # ownership math diverges. Only the COORDINATOR's values
-            # are authoritative — a follower's broadcast carries its
-            # own (possibly misconfigured) copy.
+            # the whole status payload — node list, cluster state,
+            # placement parameters — is authoritative only from the
+            # COORDINATOR: a follower's broadcast carries its own
+            # (possibly stale or misconfigured) copy, and adopting a
+            # stale node list cluster-wide is an outage. A follower's
+            # status still counts as liveness + schema evidence
+            # (handled by the caller / _apply_remote_holder_state).
             if msg.get("fromCoordinator"):
+                self.nodes = [Node.from_dict(d) for d in msg["nodes"]]
+                self._sort_nodes()
+                self.state = msg["state"]
                 for key, attr in (
                     ("replicaN", "replica_n"),
                     ("partitionN", "partition_n"),
@@ -648,12 +653,34 @@ class Cluster:
     # -- resize (reference cluster.go:1080-1423) -----------------------------
 
     def set_coordinator(self, node_id: str) -> None:
+        """Operator-initiated coordinator transfer. Propagated by a
+        DEDICATED message every node applies directly (reference
+        SetCoordinatorMessage, api.go:746) — NOT by a cluster-status
+        broadcast, whose adoption is gated on fromCoordinator and
+        would be ignored when the operator posted to a follower."""
+        with self.mu:
+            target = next((n for n in self.nodes if n.id == node_id), None)
+        if target is None:
+            # an unknown id must fail loudly BEFORE any state changes:
+            # applying it would demote every coordinator flag
+            # cluster-wide (and persist the coordinator-less topology)
+            raise NotFoundError(f"node not found: {node_id}")
+        self._apply_set_coordinator(node_id)
+        # wire shape = reference SetCoordinatorMessage{New Node}
+        # (internal/private.proto:160; utils/privateproto.py)
+        self.send_async(
+            {
+                "type": "set-coordinator",
+                "node": target.to_dict() if target else {"id": node_id},
+            }
+        )
+
+    def _apply_set_coordinator(self, node_id: str) -> None:
         with self.mu:
             for n in self.nodes:
                 n.is_coordinator = n.id == node_id
             self.is_coordinator = self.node_id == node_id
             self._save_topology()
-        self.send_async(self._status_message())
 
     def remove_node(self, node_id: str) -> None:
         """Operator-initiated removal (reference api.RemoveNode:776)."""
@@ -661,7 +688,7 @@ class Cluster:
             raise ValueError("removeNode can only be called on the coordinator")
         target = next((n for n in self.nodes if n.id == node_id), None)
         if target is None:
-            raise KeyError(f"node not found: {node_id}")
+            raise NotFoundError(f"node not found: {node_id}")
         if self.server is not None and self.server.holder.has_data():
             self._start_resize(remove_node=target)
         else:
@@ -671,6 +698,12 @@ class Cluster:
             self._broadcast_status()
 
     def resize_abort(self) -> None:
+        # only the coordinator owns the job + cluster state; a
+        # follower-side abort would broadcast a status nobody should
+        # adopt (reference completeCurrentJob: ErrNodeNotCoordinator,
+        # cluster.go:1164-1176)
+        if not self.is_coordinator:
+            raise ValueError("resize abort can only be called on the coordinator")
         self._resize_abort.set()
         with self.mu:
             # the operator is stopping the resize PROCESS: queued

@@ -1,83 +1,13 @@
-"""Cluster node identity + URI (reference pilosa.Node / uri.go)."""
+"""Cluster node identity (reference pilosa.Node); URI lives in
+utils/uri.py and is re-exported here for back-compat."""
 
 from __future__ import annotations
 
-import re
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
+from pilosa_tpu.utils.uri import URI
 
-# Validation shapes follow reference uri.go:28-30: scheme is lowercase
-# letters plus '+', host is hostname chars or a bracketed IPv6 literal.
-_SCHEME_RE = re.compile(r"^[+a-z]+$")
-_HOST_RE = re.compile(r"^[0-9a-z.-]+$|^\[[:0-9a-fA-F]+\]$")
-_ADDRESS_RE = re.compile(
-    r"^(?:(?P<scheme>[+a-z]+)://)?"
-    r"(?P<host>[0-9a-z.-]+|\[[:0-9a-fA-F]+\])?"
-    r"(?::(?P<port>[0-9]+))?$"
-)
-
-
-@dataclass
-class URI:
-    """Scheme/host/port triple (reference uri.go:45-264).
-
-    All parts optional when parsing: ``http://localhost:10101``,
-    ``localhost``, and ``:10101`` are equivalent spellings.
-    """
-
-    scheme: str = "http"
-    host: str = "localhost"
-    port: int = 10101
-
-    @classmethod
-    def from_address(cls, addr: str) -> "URI":
-        m = _ADDRESS_RE.fullmatch(addr.strip())
-        if m is None or (not m.group("host") and m.group("port") is None and not m.group("scheme")):
-            raise ValueError(f"invalid address: {addr!r}")
-        port = int(m.group("port") or 10101)
-        if port > 0xFFFF:
-            raise ValueError(f"invalid address: {addr!r} (port out of range)")
-        return cls(
-            scheme=m.group("scheme") or "http",
-            host=m.group("host") or "localhost",
-            port=port,
-        )
-
-    def set_scheme(self, scheme: str) -> None:
-        if not _SCHEME_RE.fullmatch(scheme):
-            raise ValueError(f"invalid scheme: {scheme!r}")
-        self.scheme = scheme
-
-    def set_host(self, host: str) -> None:
-        if not _HOST_RE.fullmatch(host):
-            raise ValueError(f"invalid host: {host!r}")
-        self.host = host
-
-    def __str__(self) -> str:
-        return f"{self.scheme}://{self.host}:{self.port}"
-
-    def host_port(self) -> str:
-        return f"{self.host}:{self.port}"
-
-    def normalize(self) -> str:
-        """Address usable by an HTTP client: a ``+``-qualified scheme
-        (e.g. ``https+pb``) drops its qualifier (reference uri.go:135-142)."""
-        scheme = self.scheme.split("+", 1)[0]
-        return f"{scheme}://{self.host}:{self.port}"
-
-    def path(self, p: str) -> str:
-        return f"{self.normalize()}{p}"
-
-    def to_dict(self) -> dict:
-        return {"scheme": self.scheme, "host": self.host, "port": self.port}
-
-    @classmethod
-    def from_dict(cls, d: dict) -> "URI":
-        return cls(
-            scheme=d.get("scheme", "http"),
-            host=d.get("host", "localhost"),
-            port=int(d.get("port", 10101)),
-        )
+__all__ = ["Node", "URI"]
 
 
 @dataclass

@@ -43,9 +43,16 @@ class APIError(Exception):
         self.status = status
 
 
-class NotFoundError(APIError):
+from pilosa_tpu.utils.errors import NotFoundError as _SharedNotFound  # noqa: E402
+
+
+class NotFoundError(APIError, _SharedNotFound):
+    """API-level 404. Subclasses BOTH APIError (carries the status for
+    the HTTP layer) and the shared utils.errors.NotFoundError, so
+    ``except`` on either type catches it — no same-named-type trap."""
+
     def __init__(self, message: str) -> None:
-        super().__init__(message, status=404)
+        APIError.__init__(self, message, status=404)
 
 
 class API:
@@ -595,20 +602,28 @@ class API:
     def translate_keys(self, index: str, field: str, keys: list) -> list:
         """Mint (or look up) ids for keys — the follower-forward target;
         this node must be the translate primary. Mints LOCALLY
-        unconditionally (never re-forwards — see TranslateStore.mint)."""
+        unconditionally (never re-forwards — see TranslateStore.mint).
+
+        When this node's OWN resolution names a different primary, the
+        request is rejected with 409: minting here would permanently
+        fork the cluster's id space (each mint is durable in the local
+        WAL). The bind-vs-advertise case — the primary's advertised
+        name differing from its bind address — is handled inside
+        ``translate_primary`` via URI equivalence + DNS resolution
+        (``Server._is_self``), NOT via anything request-controlled: a
+        client-supplied header must never be able to open the mint
+        gate on a follower."""
         ts = self.executor.translate_store
         if ts is None:
             raise APIError("translate store not configured")
         if self.server is not None:
             p = self.server.translate_primary()
-            if p and self.server.logger is not None:
-                # visibility for split-primary misconfiguration: this
-                # node is minting while ITS resolution names another
-                # primary (legitimate only for a bind/advertise
-                # mismatch forwarding to its own address)
-                self.server.logger.printf(
-                    "minting translate keys while resolving primary=%s "
-                    "(check translate-primary-url consistency)", p
+            if p:
+                raise APIError(
+                    f"not the translate primary (primary={p}); minting "
+                    "here would fork the cluster id space — post to the "
+                    "primary or fix translate-primary-url",
+                    status=409,
                 )
         return ts.mint(index, field, [str(k) for k in keys])
 

@@ -19,8 +19,27 @@ from urllib.parse import parse_qs, urlparse
 from pilosa_tpu.core import Row
 from pilosa_tpu.executor import ValCount
 from pilosa_tpu.server.api import API, APIError
+from pilosa_tpu.utils.errors import NotFoundError as ExecNotFound
 from pilosa_tpu.utils import privateproto, publicproto
 from pilosa_tpu.utils.stats import NOP_STATS
+
+
+def _require(body: dict, *keys: str) -> None:
+    """400 on missing request-body fields — a malformed client body
+    must never surface as an internal KeyError."""
+    missing = [k for k in keys if k not in body]
+    if missing:
+        raise APIError(
+            f"missing required field(s): {', '.join(missing)}", status=400
+        )
+
+
+def _qreq(q: dict, key: str) -> str:
+    """Required query parameter, 400 when absent."""
+    try:
+        return q[key][0]
+    except (KeyError, IndexError):
+        raise APIError(f"missing required query param: {key}", status=400)
 
 
 def _decode_proto(fn, body: Optional[bytes]):
@@ -164,7 +183,7 @@ class Handler:
             body = req.body.decode() if req.body else ""
             shards = None
             if "shards" in q:
-                shards = [int(s) for s in q["shards"][0].split(",") if s != ""]
+                shards = [int(s) for s in _qreq(q, "shards").split(",") if s != ""]
             remote = q.get("remote", ["false"])[0] == "true"
             exclude_row_attrs = q.get("excludeRowAttrs", ["false"])[0] == "true"
             exclude_columns = q.get("excludeColumns", ["false"])[0] == "true"
@@ -295,7 +314,7 @@ class Handler:
     def get_export(self, req):
         q = req.query
         csv_text = self.api.export_csv(
-            q["index"][0], q["field"][0], int(q["shard"][0])
+            _qreq(q, "index"), _qreq(q, "field"), int(_qreq(q, "shard"))
         )
         return RawResponse(csv_text.encode(), "text/csv")
 
@@ -333,15 +352,15 @@ class Handler:
 
     def get_fragment_nodes(self, req) -> list:
         q = req.query
-        return self.api.shard_nodes(q["index"][0], int(q["shard"][0]))
+        return self.api.shard_nodes(_qreq(q, "index"), int(_qreq(q, "shard")))
 
     def get_fragment_blocks(self, req) -> dict:
         q = req.query
         return {
             "blocks": self.api.fragment_blocks(
-                q["index"][0],
-                q["field"][0],
-                int(q["shard"][0]),
+                _qreq(q, "index"),
+                _qreq(q, "field"),
+                int(_qreq(q, "shard")),
                 view=q.get("view", ["standard"])[0],
             )
         }
@@ -350,6 +369,7 @@ class Handler:
         """Anti-entropy view-aware block-merge push (see
         api.apply_block_fixes)."""
         body = json.loads(req.body or b"{}")
+        _require(body, "index", "field", "shard")
         self.api.apply_block_fixes(
             body["index"],
             body["field"],
@@ -365,30 +385,30 @@ class Handler:
     def get_block_data(self, req) -> dict:
         q = req.query
         return self.api.fragment_block_data(
-            q["index"][0],
-            q["field"][0],
+            _qreq(q, "index"),
+            _qreq(q, "field"),
             q.get("view", ["standard"])[0],
-            int(q["shard"][0]),
-            int(q["block"][0]),
+            int(_qreq(q, "shard")),
+            int(_qreq(q, "block")),
         )
 
     def get_fragment_data(self, req):
         q = req.query
         data = self.api.marshal_fragment(
-            q["index"][0],
-            q["field"][0],
+            _qreq(q, "index"),
+            _qreq(q, "field"),
             q.get("view", ["standard"])[0],
-            int(q["shard"][0]),
+            int(_qreq(q, "shard")),
         )
         return RawResponse(data, "application/octet-stream")
 
     def post_fragment_data(self, req) -> dict:
         q = req.query
         self.api.unmarshal_fragment(
-            q["index"][0],
-            q["field"][0],
+            _qreq(q, "index"),
+            _qreq(q, "field"),
             q.get("view", ["standard"])[0],
-            int(q["shard"][0]),
+            int(_qreq(q, "shard")),
             req.body,
         )
         return {}
@@ -402,6 +422,7 @@ class Handler:
         """Primary-side key minting for follower forwards: one id space
         per cluster (reference TranslateFile primary semantics)."""
         body = json.loads(req.body or b"{}")
+        _require(body, "index")
         ids = self.api.translate_keys(
             body["index"], body.get("field", ""), body.get("keys", [])
         )
@@ -532,20 +553,21 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
             except APIError as e:
                 payload, ctype = self._error_payload(str(e))
                 self.send_response(e.status)
+            except ExecNotFound as e:
+                # the executor's typed missing-index/field/bsiGroup
+                # error — the reference maps exactly those to 404
+                # (successResponse.check, http/handler.go:285-310)
+                payload, ctype = self._error_payload(str(e).strip("'\""))
+                self.send_response(404)
             except KeyError as e:
-                # executor lookups raise KeyError("index/field not
-                # found: ...") — the reference maps exactly those to
-                # 404 (successResponse.check, http/handler.go:285-310).
-                # Any OTHER KeyError is an internal bug and must stay a
-                # logged 500, not an invisible not-found.
-                msg = str(e).strip("'\"")
-                if "not found" not in msg:
-                    traceback.print_exc()
-                    payload, ctype = self._error_payload(f"internal error: {msg}")
-                    self.send_response(500)
-                else:
-                    payload, ctype = self._error_payload(msg)
-                    self.send_response(404)
+                # any untyped KeyError is an internal bug (or a missing
+                # request field that slipped past _require): a logged
+                # 500, never an invisible not-found
+                traceback.print_exc()
+                payload, ctype = self._error_payload(
+                    f"internal error: {str(e).strip(chr(39))}"
+                )
+                self.send_response(500)
             except ValueError as e:
                 # bad user input (parse-adjacent arg errors, malformed
                 # bodies) — 400, like the reference's BadRequest family.

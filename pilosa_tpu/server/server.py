@@ -28,6 +28,37 @@ from pilosa_tpu.utils.stats import ExpvarStatsClient, NOP_STATS, StatsDClient
 from pilosa_tpu.utils.translate import TranslateStore
 
 
+def _host_resolves_to_local(host: str, bind_host: str) -> bool:
+    """True when ``host`` DNS-resolves to the address this server is
+    bound to. With a specific bind IP the check is exact; with a
+    wildcard bind (0.0.0.0 / ::) the host must resolve to one of this
+    machine's own addresses. Resolution failures are False — an
+    unresolvable advertised name can't be proven to be us."""
+    import socket
+
+    host = host.strip("[]")
+    try:
+        remote = {ai[4][0] for ai in socket.getaddrinfo(host, None)}
+    except OSError:
+        return False
+    bind_host = bind_host.strip("[]")
+    if bind_host not in ("", "0.0.0.0", "::"):
+        try:
+            local = {ai[4][0] for ai in socket.getaddrinfo(bind_host, None)}
+        except OSError:
+            local = {bind_host}
+        return bool(remote & local)
+    # wildcard bind: gather this machine's interface addresses
+    local = {"127.0.0.1", "::1"}
+    try:
+        local.update(
+            ai[4][0] for ai in socket.getaddrinfo(socket.gethostname(), None)
+        )
+    except OSError:
+        pass
+    return bool(remote & local)
+
+
 class Server:
     def __init__(self, config: Optional[Config] = None, cluster=None) -> None:
         # entry point for every serving deployment: make JAX_PLATFORMS
@@ -192,8 +223,47 @@ class Server:
         self._start_background_loops()
 
     def _normalize_host_uri(self, h: str) -> str:
-        """host[:port] or URI → full URI with this server's scheme."""
-        return h if h.startswith("http") else f"{self.scheme}://{h}"
+        """host[:port] or URI → canonical URI string: missing scheme
+        defaults to this server's, missing port to the reference's
+        10101 (utils/uri.py; reference uri.go:82-264). Canonicalizing
+        here kills the bind-vs-advertise bug class where equivalent
+        spellings fail string comparison. An address the strict parser
+        rejects (uppercase/underscore hostnames the reference's
+        hostRegexp also rejects) falls back to the legacy
+        scheme-prefix form with a warning — a weird-but-working
+        config must not become a boot crash."""
+        from pilosa_tpu.utils.uri import URI, URIError
+
+        try:
+            return URI.from_address(h, default_scheme=self.scheme).normalize()
+        except URIError:
+            self.logger.printf(
+                "address %r does not parse as a URI (reference uri.go "
+                "host rules); using it verbatim", h
+            )
+            return h if h.startswith("http") else f"{self.scheme}://{h}"
+
+    def _is_self(self, uri_str: str) -> bool:
+        """Does this address name this server's listener? Compares
+        scheme/host/port through URI equivalence (localhost spellings,
+        default ports), then — for a bind-vs-advertise hostname/IP
+        mismatch — through DNS: same port and the advertised host
+        resolves to this server's bound IP (or to any local interface
+        when bound to a wildcard). DNS results are config-controlled,
+        unlike a request's Host header, so this cannot be spoofed by
+        a client."""
+        from pilosa_tpu.utils.uri import URI, URIError, same_endpoint
+
+        if same_endpoint(uri_str, self.uri, default_scheme=self.scheme):
+            return True
+        try:
+            other = URI.from_address(uri_str, default_scheme=self.scheme)
+        except URIError:
+            return False
+        host, port = self.address()
+        if other.port != port:
+            return False
+        return _host_resolves_to_local(other.host, bind_host=host)
 
     def translate_primary(self) -> str:
         """URI of the cluster's ONE id-minting translate store — this
@@ -206,13 +276,13 @@ class Server:
         explicit = self.config.translate_primary_url
         if explicit:
             p = self._normalize_host_uri(explicit)
-            return "" if p == self.uri else p
+            return "" if self._is_self(p) else p
         cc = self.config.cluster
         if cc.disabled:
             return ""
         if cc.hosts:
             p = self._normalize_host_uri(cc.hosts[0])
-            return "" if p == self.uri else p
+            return "" if self._is_self(p) else p
         if cc.coordinator:
             return ""
         if cc.coordinator_host:

@@ -3,6 +3,7 @@ reference server/cluster_test.go + cluster_internal_test.go)."""
 
 import json
 import socket
+import time
 import urllib.request
 
 import pytest
@@ -1253,6 +1254,99 @@ class TestAsyncResize:
             firsts = {src["from_uris"][0] for src in ghost_srcs}
             assert len(firsts) == 2, firsts
             assert all(len(src["from_uris"]) == 2 for src in ghost_srcs)
+        finally:
+            for s in servers:
+                s.close()
+
+
+class TestStatusAuthority:
+    """Round-4 advisor fixes: only the coordinator's cluster-status is
+    adopted; mints on non-primaries are rejected; resize abort is
+    coordinator-only; set-coordinator rides a dedicated message."""
+
+    def test_follower_status_broadcast_is_not_adopted(self, tmp_path):
+        servers = boot_static_cluster(tmp_path, n=3)
+        try:
+            s0, s1, s2 = servers
+            good_ids = sorted(n.id for n in s0.cluster.nodes)
+            # a follower broadcasts a status carrying a STALE node list
+            # (missing node 2) — e.g. a node that wedged mid-join
+            stale = s1.cluster._status_message()
+            assert not stale["fromCoordinator"]
+            stale["nodes"] = [n.to_dict() for n in s1.cluster.nodes[:2]]
+            stale["replicaN"] = 3  # and a misconfigured placement param
+            s0.cluster.receive_message(stale)
+            s2.cluster.receive_message(stale)
+            assert sorted(n.id for n in s0.cluster.nodes) == good_ids
+            assert sorted(n.id for n in s2.cluster.nodes) == good_ids
+            assert s0.cluster.replica_n == 1
+            # the coordinator's broadcast IS adopted
+            fresh = s0.cluster._status_message()
+            assert fresh["fromCoordinator"]
+            s1.cluster.receive_message(fresh)
+            assert sorted(n.id for n in s1.cluster.nodes) == good_ids
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_mint_on_non_primary_is_409(self, tmp_path):
+        servers = boot_static_cluster(tmp_path, n=2)
+        try:
+            s0, s1 = servers
+            req(s0.uri, "POST", "/index/i", {"options": {"keys": True}})
+            # minting on the primary (node 0) works
+            st, body = req(
+                s0.uri, "POST", "/internal/translate/keys",
+                {"index": "i", "keys": ["a", "b"]},
+            )
+            assert st == 200 and body["ids"] == [1, 2], body
+            # posting the same internal mint to a NON-primary must be
+            # rejected, not silently minted into a forked id space
+            st, body = req(
+                s1.uri, "POST", "/internal/translate/keys",
+                {"index": "i", "keys": ["c"]},
+            )
+            assert st == 409, body
+            assert "primary" in body.get("error", str(body))
+            # and a missing body field is a 400, not a 500
+            st, body = req(s0.uri, "POST", "/internal/translate/keys", {})
+            assert st == 400, body
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_resize_abort_rejected_on_follower(self, tmp_path):
+        servers = boot_static_cluster(tmp_path, n=2)
+        try:
+            s0, s1 = servers
+            st, _ = req(s1.uri, "POST", "/cluster/resize/abort", {})
+            assert st == 400
+            st, _ = req(s0.uri, "POST", "/cluster/resize/abort", {})
+            assert st == 200
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_set_coordinator_propagates_from_any_node(self, tmp_path):
+        servers = boot_static_cluster(tmp_path, n=3)
+        try:
+            s0, s1, s2 = servers
+            new_id = s2.cluster.node_id
+            # operator posts to a FOLLOWER naming a new coordinator
+            st, _ = req(
+                s1.uri, "POST", "/cluster/resize/set-coordinator",
+                {"id": new_id},
+            )
+            assert st == 200
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if all(s.cluster.is_coordinator == (s is s2) for s in servers):
+                    break
+                time.sleep(0.05)
+            for s in servers:
+                assert s.cluster.is_coordinator == (s is s2), s.uri
+                coord = [n.id for n in s.cluster.nodes if n.is_coordinator]
+                assert coord == [new_id], (s.uri, coord)
         finally:
             for s in servers:
                 s.close()

@@ -1,0 +1,74 @@
+"""URI abstraction tests (reference uri_test.go semantics for
+uri.go:45-264: optional parts, defaults, normalize, equivalence)."""
+
+import pytest
+
+from pilosa_tpu.utils.uri import URI, URIError, same_endpoint
+
+
+class TestParse:
+    def test_full(self):
+        u = URI.from_address("https://node1.example.com:3333")
+        assert (u.scheme, u.host, u.port) == ("https", "node1.example.com", 3333)
+
+    def test_equivalent_spellings_all_default(self):
+        # reference uri.go:38-44: these are all the same address
+        expect = URI(scheme="http", host="localhost", port=10101)
+        for spelling in (
+            "http://localhost:10101",
+            "http://localhost",
+            "localhost:10101",
+            "localhost",
+            ":10101",
+        ):
+            assert URI.from_address(spelling) == expect, spelling
+
+    def test_host_only(self):
+        u = URI.from_address("index1.pilosa.com")
+        assert (u.scheme, u.host, u.port) == ("http", "index1.pilosa.com", 10101)
+
+    def test_port_only(self):
+        assert URI.from_address(":65000").port == 65000
+
+    def test_ipv6(self):
+        u = URI.from_address("[::1]:9999")
+        assert (u.host, u.port) == ("[::1]", 9999)
+
+    def test_scheme_plus(self):
+        u = URI.from_address("http+protobuf://h:1")
+        assert u.scheme == "http+protobuf"
+        assert u.normalize() == "http://h:1"
+
+    def test_invalid(self):
+        for bad in ("foo:bar", "http://host:port", "a b", "HTTP://x:1"):
+            with pytest.raises(URIError):
+                URI.from_address(bad)
+
+    def test_default_scheme_override(self):
+        assert URI.from_address("h:1", default_scheme="https").scheme == "https"
+
+
+class TestViews:
+    def test_host_port_and_str(self):
+        u = URI(scheme="http", host="h", port=101)
+        assert u.host_port() == "h:101"
+        assert str(u) == "http://h:101"
+        assert u.path("/schema") == "http://h:101/schema"
+
+
+class TestEquivalence:
+    def test_loopback_spellings(self):
+        assert same_endpoint("http://localhost:5001", "http://127.0.0.1:5001")
+        assert same_endpoint("127.0.0.1:5001", "localhost:5001")
+        assert not same_endpoint("localhost:5001", "localhost:5002")
+        assert not same_endpoint("http://a:1", "http://b:1")
+
+    def test_scheme_plus_equivalent(self):
+        assert same_endpoint("http+x://h:1", "http://h:1")
+
+    def test_default_port_fill(self):
+        assert same_endpoint("http://h:10101", "h")
+
+    def test_unparseable_falls_back_to_string_eq(self):
+        assert same_endpoint("!!", "!!")
+        assert not same_endpoint("!!", "http://h:1")
