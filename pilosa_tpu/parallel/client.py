@@ -268,6 +268,19 @@ class InternalClient:
     def status(self, uri: str) -> dict:
         return self._request("GET", uri, "/status")
 
+    def probe_indirect(self, via_uri: str, target_uri: str) -> bool:
+        """SWIM ping-req: ask ``via_uri`` to probe ``target_uri`` on our
+        behalf (reference memberlist indirect probing, the
+        gossip/gossip.go:431-494 tunables). Returns the peer's verdict;
+        an unreachable RELAY answers False (no verdict ≠ alive)."""
+        out = self._request(
+            "POST",
+            via_uri,
+            "/internal/probe",
+            body=json.dumps({"uri": target_uri}).encode(),
+        )
+        return bool(out.get("alive"))
+
     def schema(self, uri: str) -> list[dict]:
         return self._request("GET", uri, "/schema").get("indexes", [])
 

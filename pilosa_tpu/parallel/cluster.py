@@ -134,6 +134,7 @@ class Cluster:
         # node; down_after failures → DOWN, any failure → SUSPECT
         self.down_after = down_after
         self._fail_counts: dict[str, int] = {}
+        self.probe_timeout = probe_timeout
         self._probe_client = InternalClient(
             timeout=probe_timeout, ssl_context=ssl_context
         )
@@ -263,26 +264,82 @@ class Cluster:
     # -- liveness (reference memberlist SWIM probing + NodeStatus
     #    push/pull, gossip/gossip.go:431-494, server.go:565-630) ------------
 
+    # peers asked to confirm a suspect before it can be marked down
+    # (reference memberlist IndirectChecks default = 3; we use 2 —
+    # clusters here are typically small)
+    INDIRECT_PROBES = 2
+
     def probe_nodes(self) -> None:
-        """One liveness sweep: short-timeout /status probe of every peer.
-        A failure moves the node to SUSPECT; down_after consecutive
-        failures to DOWN (skipped by query planning but kept in the
-        topology — removal stays operator-initiated, reference
-        cluster.go:1629-1631). A successful probe restores READY.
-        Probes fan out through the pool so one sweep costs one probe
-        timeout, not O(dead peers) of them."""
+        """One liveness sweep: short-timeout /status probe of every peer,
+        with SWIM-style INDIRECT confirmation — a direct failure is
+        re-tried through up to INDIRECT_PROBES healthy third nodes
+        (/internal/probe ping-req) before it counts, so one partitioned
+        link cannot mark a healthy node DOWN (reference memberlist
+        probing, gossip/gossip.go:431-494). A failure moves the node to
+        SUSPECT; down_after consecutive failures to DOWN (skipped by
+        query planning but kept in the topology — removal stays
+        operator-initiated, reference cluster.go:1629-1631). A
+        successful probe restores READY. Probes fan out through the
+        pool, so a sweep costs one WORST-CASE peer verdict — direct
+        probe timeout plus up to INDIRECT_PROBES serial relay
+        round-trips for a dead peer (each relay blocks its own probe
+        timeout before answering alive=false) — not O(dead peers) of
+        them; the wait below is deadlined so one wedged relay
+        connection cannot stall liveness forever."""
 
         def probe(node):
             try:
                 self._probe_client.status(node.uri)
                 alive = True
             except (ClientError, OSError):
-                alive = False
+                alive = self._probe_via_peers(node)
             self._note_probe(node, alive)
 
         futures = [self._pool.submit(probe, n) for n in self._other_nodes()]
+        # worst case per peer: direct timeout + INDIRECT_PROBES relays,
+        # each costing a request timeout that already includes the
+        # relay's own probe; generous margin, but never unbounded
+        deadline = time.monotonic() + self.probe_timeout * (
+            2 + 2 * self.INDIRECT_PROBES
+        )
         for f in futures:
-            f.result()
+            try:
+                f.result(timeout=max(0.1, deadline - time.monotonic()))
+            except TimeoutError:
+                continue  # verdict lands via _note_probe when it finishes
+
+    def _probe_via_peers(self, target: Node) -> bool:
+        """Ask up to INDIRECT_PROBES healthy peers to probe ``target``;
+        alive if ANY confirms. Relays are chosen RANDOMLY per probe
+        (like memberlist's k-random member selection) — a fixed choice
+        would let one bad relay pair permanently defeat indirect
+        confirmation — excluding self, the target, and already-DOWN
+        nodes. With no eligible relay (2-node cluster) the direct
+        verdict stands."""
+        import random
+
+        with self.mu:
+            eligible = [
+                n
+                for n in self.nodes
+                if n.id not in (self.node_id, target.id)
+                and n.state != NODE_DOWN
+            ]
+        relays = random.sample(
+            eligible, min(self.INDIRECT_PROBES, len(eligible))
+        )
+        for relay in relays:
+            try:
+                if self._probe_client.probe_indirect(relay.uri, target.uri):
+                    if self.logger:
+                        self.logger.printf(
+                            "indirect probe: %s reached %s (direct path failed)",
+                            relay.id, target.id,
+                        )
+                    return True
+            except (ClientError, OSError):
+                continue
+        return False
 
     def _note_probe(self, node: Node, alive: bool) -> None:
         with self.mu:
@@ -668,12 +725,7 @@ class Cluster:
         self._apply_set_coordinator(node_id)
         # wire shape = reference SetCoordinatorMessage{New Node}
         # (internal/private.proto:160; utils/privateproto.py)
-        self.send_async(
-            {
-                "type": "set-coordinator",
-                "node": target.to_dict() if target else {"id": node_id},
-            }
-        )
+        self.send_async({"type": "set-coordinator", "node": target.to_dict()})
 
     def _apply_set_coordinator(self, node_id: str) -> None:
         with self.mu:

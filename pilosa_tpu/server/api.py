@@ -592,6 +592,17 @@ class API:
                 out.update(store.block_data(bid))
         return {str(k): v for k, v in out.items()}
 
+    def probe_node(self, uri: str) -> bool:
+        """Probe ``uri``'s /status with the cluster's short probe
+        timeout; the relay half of SWIM indirect probing."""
+        if self.cluster is None:
+            return False
+        try:
+            self.cluster._probe_client.status(uri)
+            return True
+        except Exception:
+            return False
+
     def get_translate_data(self, offset: int) -> bytes:
         ts = self.executor.translate_store
         if ts is None:

@@ -145,6 +145,7 @@ class Handler:
             Route("POST", r"/internal/fragment/data", self.post_fragment_data),
             Route("GET", r"/internal/shards/max", lambda req: {"standard": a.max_shards()}),
             Route("GET", r"/internal/fragments", lambda req: a.fragment_inventory()),
+            Route("POST", r"/internal/probe", self.post_probe),
             Route("GET", r"/internal/translate/data", self.get_translate_data),
             Route("POST", r"/internal/translate/keys", self.post_translate_keys),
             Route(
@@ -412,6 +413,14 @@ class Handler:
             req.body,
         )
         return {}
+
+    def post_probe(self, req) -> dict:
+        """SWIM ping-req relay: probe the named node on the caller's
+        behalf and report whether it answered (indirect liveness;
+        reference memberlist IndirectChecks)."""
+        body = json.loads(req.body or b"{}")
+        _require(body, "uri")
+        return {"alive": self.api.probe_node(body["uri"])}
 
     def get_translate_data(self, req):
         q = req.query

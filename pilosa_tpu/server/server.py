@@ -138,6 +138,8 @@ class Server:
         self._serve_thread: Optional[threading.Thread] = None
         self.node_id: str = ""
         self._closed = threading.Event()
+        # memoized translate-primary resolution (see translate_primary)
+        self._translate_primary_cache: Optional[str] = None
 
     def _build_mesh(self):
         """Resolve config.mesh_devices into a jax Mesh over the shard
@@ -272,7 +274,22 @@ class Server:
         (join mode) > the first static host. Config-only, so it resolves
         before the listener starts. Deterministic across nodes — every
         node agrees without extra config. Empty = self is primary (or
-        no cluster)."""
+        no cluster).
+
+        The answer is MEMOIZED after the listener is bound: resolution
+        can consult DNS (``_is_self``), and re-resolving on every
+        forwarded mint would put blocking getaddrinfo calls — and
+        resolver blips turning into spurious 409s — on the keyed-write
+        hot path."""
+        cached = self._translate_primary_cache
+        if cached is not None:
+            return cached
+        out = self._resolve_translate_primary()
+        if self.httpd is not None:  # port known → answer is final
+            self._translate_primary_cache = out
+        return out
+
+    def _resolve_translate_primary(self) -> str:
         explicit = self.config.translate_primary_url
         if explicit:
             p = self._normalize_host_uri(explicit)

@@ -1350,3 +1350,44 @@ class TestStatusAuthority:
         finally:
             for s in servers:
                 s.close()
+
+
+class TestIndirectProbing:
+    """SWIM ping-req: a partitioned direct link must not mark a healthy
+    node DOWN — a suspect is confirmed through third nodes first
+    (reference memberlist IndirectChecks, gossip/gossip.go:431-494)."""
+
+    def test_partitioned_link_does_not_mark_healthy_node_down(self, tmp_path):
+        servers = boot_static_cluster(tmp_path, n=3, down_after=1)
+        try:
+            s0, s1, s2 = servers
+            target_uri = s2.uri
+            real_status = s0.cluster._probe_client.status
+
+            def broken_link(uri):
+                if uri == target_uri:
+                    raise OSError("simulated partitioned link")
+                return real_status(uri)
+
+            s0.cluster._probe_client.status = broken_link
+            for _ in range(3):
+                s0.cluster.probe_nodes()
+            n2 = next(n for n in s0.cluster.nodes if n.uri == target_uri)
+            # node1's relay confirmed node2 alive despite the dead link
+            assert n2.state == "READY", n2.state
+        finally:
+            for s in servers:
+                s.close()
+
+    def test_actually_dead_node_still_goes_down(self, tmp_path):
+        servers = boot_static_cluster(tmp_path, n=3, down_after=1)
+        try:
+            s0, s1, s2 = servers
+            dead_uri = s2.uri
+            s2.close()
+            s0.cluster.probe_nodes()
+            n2 = next(n for n in s0.cluster.nodes if n.uri == dead_uri)
+            assert n2.state == "DOWN", n2.state
+        finally:
+            for s in servers[:2]:
+                s.close()
