@@ -1,0 +1,308 @@
+"""Translate store unit tests: binary WAL round-trip, reopen/replay,
+replication streaming, JSONL migration, and the memory-scalability
+contract (reference translate.go: LogEntry format 548-723, mmapped
+index economics 733-899)."""
+
+import json
+import os
+
+import pytest
+
+from pilosa_tpu.utils.translate import (
+    LOG_ENTRY_INSERT_COLUMN,
+    LOG_ENTRY_INSERT_ROW,
+    TranslateStore,
+)
+
+
+class TestBasics:
+    def test_mint_lookup_reverse_in_memory(self):
+        ts = TranslateStore()
+        ids = ts.translate_columns_to_ids("i", ["alice", "bob", "alice"])
+        assert ids == [1, 2, 1]
+        rids = ts.translate_rows_to_ids("i", "f", ["x", "y"])
+        assert rids == [1, 2]  # per-space id sequences
+        assert ts.translate_column_to_string("i", 1) == "alice"
+        assert ts.translate_column_to_string("i", 2) == "bob"
+        assert ts.translate_row_to_string("i", "f", 2) == "y"
+        assert ts.translate_column_to_string("i", 99) is None
+        # create=False leaves unknown keys unminted
+        assert ts.translate_columns_to_ids("i", ["zed"], create=False) == [None]
+        assert ts.translate_columns_to_ids("i", ["zed"]) == [3]
+
+    def test_unicode_and_binaryish_keys(self):
+        ts = TranslateStore()
+        keys = ["héllo", "ключ", "日本語", 'quo"te', "a\tb"]
+        ids = ts.translate_columns_to_ids("i", keys)
+        assert ids == [1, 2, 3, 4, 5]
+        for k, i in zip(keys, ids):
+            assert ts.translate_column_to_string("i", i) == k
+
+    def test_reopen_replays_wal(self, tmp_path):
+        p = str(tmp_path / ".keys")
+        ts = TranslateStore(p)
+        ids = ts.translate_columns_to_ids("i", [f"k{j}" for j in range(100)])
+        ts.translate_rows_to_ids("i", "f", ["r1", "r2"])
+        ts.close()
+        ts2 = TranslateStore(p)
+        assert ts2.translate_columns_to_ids(
+            "i", [f"k{j}" for j in range(100)], create=False
+        ) == ids
+        assert ts2.translate_row_to_string("i", "f", 1) == "r1"
+        # sequence continues, no id reuse
+        assert ts2.translate_columns_to_ids("i", ["new"]) == [101]
+        ts2.close()
+
+    def test_torn_tail_is_truncated(self, tmp_path):
+        p = str(tmp_path / ".keys")
+        ts = TranslateStore(p)
+        ts.translate_columns_to_ids("i", ["a", "b"])
+        ts.close()
+        good = os.path.getsize(p)
+        with open(p, "ab") as f:
+            f.write(b"\x50\x01")  # half an entry
+        ts2 = TranslateStore(p)
+        assert os.path.getsize(p) == good
+        assert ts2.translate_columns_to_ids("i", ["a"], create=False) == [1]
+        assert ts2.offset() == good
+        ts2.close()
+
+
+class TestReplication:
+    def test_stream_apply_and_idempotence(self, tmp_path):
+        primary = TranslateStore(str(tmp_path / "p.keys"))
+        replica = TranslateStore(str(tmp_path / "r.keys"))
+        primary.translate_columns_to_ids("i", ["a", "b", "c"])
+        primary.translate_rows_to_ids("i", "f", ["r"])
+        data, _ = primary.read_from(0)
+        consumed = replica.apply_log(data)
+        assert consumed == len(data) == primary.offset()
+        assert replica.translate_columns_to_ids(
+            "i", ["a", "b", "c"], create=False
+        ) == [1, 2, 3]
+        assert replica.translate_row_to_string("i", "f", 1) == "r"
+        # re-applying the same stream is harmless (restart re-pull)
+        assert replica.apply_log(data) == len(data)
+        assert replica.translate_columns_to_ids("i", ["a"], create=False) == [1]
+        # a partial trailing entry is left for the next pull
+        primary.translate_columns_to_ids("i", ["d"])
+        data2, _ = primary.read_from(consumed)
+        cut = len(data2) - 3
+        assert replica.apply_log(data2[:cut]) == 0
+        assert replica.apply_log(data2) == len(data2)
+        assert replica.translate_columns_to_ids("i", ["d"], create=False) == [4]
+        # replicated mappings survive a replica restart (local WAL)
+        replica.close()
+        r2 = TranslateStore(str(tmp_path / "r.keys"))
+        assert r2.translate_columns_to_ids("i", ["d"], create=False) == [4]
+        r2.close()
+        primary.close()
+
+    def test_forward_path_minting(self):
+        primary = TranslateStore()
+        follower = TranslateStore()
+        follower.forward = lambda index, field, keys: primary.mint(
+            index, field, keys
+        )
+        ids = follower.translate_columns_to_ids("i", ["x", "y", "x"])
+        assert ids == [1, 2, 1]
+        assert primary.translate_columns_to_ids("i", ["x"], create=False) == [1]
+        # short answer fails loudly
+        follower.forward = lambda index, field, keys: []
+        with pytest.raises(ValueError):
+            follower.translate_columns_to_ids("i", ["zz"])
+
+
+class TestMigration:
+    def test_jsonl_wal_upgrades_in_place(self, tmp_path):
+        p = str(tmp_path / ".keys")
+        with open(p, "w") as f:
+            for rec in (
+                {"index": "i", "field": "", "key": "alice", "id": 1},
+                {"index": "i", "field": "", "key": "bob", "id": 2},
+                {"index": "i", "field": "likes", "key": "pizza", "id": 1},
+            ):
+                f.write(json.dumps(rec) + "\n")
+        ts = TranslateStore(p)
+        assert ts.translate_columns_to_ids("i", ["alice", "bob"], create=False) == [1, 2]
+        assert ts.translate_row_to_string("i", "likes", 1) == "pizza"
+        assert ts.translate_columns_to_ids("i", ["carol"]) == [3]
+        ts.close()
+        with open(p, "rb") as f:
+            assert f.read(1) != b"{"  # now binary
+
+
+class TestWireFormat:
+    def test_entry_round_trip(self):
+        blob = TranslateStore.encode_entry(
+            LOG_ENTRY_INSERT_ROW, "idx", "frame", [7, 300], [b"k1", b"key-two"]
+        )
+        end, index, field, pairs = TranslateStore.decode_entry(blob, 0)
+        assert end == len(blob)
+        assert (index, field) == ("idx", "frame")
+        assert [(i, k) for i, k, _ in pairs] == [(7, b"k1"), (300, b"key-two")]
+        # column entries ignore the field name (reference applyEntry)
+        blob = TranslateStore.encode_entry(
+            LOG_ENTRY_INSERT_COLUMN, "idx", "", [1], [b"c"]
+        )
+        _, _, field, _ = TranslateStore.decode_entry(blob, 0)
+        assert field == ""
+
+    def test_incomplete_and_corrupt(self):
+        blob = TranslateStore.encode_entry(LOG_ENTRY_INSERT_COLUMN, "i", "", [1], [b"k"])
+        assert TranslateStore.decode_entry(blob[:-1], 0) is None
+        with pytest.raises(ValueError):
+            # declared length covers the bytes, but the key length
+            # inside runs past the entry
+            bad = bytearray(blob)
+            bad[-2] = 0xF0
+            TranslateStore.decode_entry(bytes(bad), 0)
+
+
+class TestScalability:
+    N = 200_000
+
+    def test_bounded_memory_per_key(self, tmp_path):
+        """The memory contract: tables are numpy open-addressing over
+        WAL offsets — tens of bytes per key, NOT Python dicts of
+        strings (hundreds of bytes per key). 200k keys must fit in
+        < 50 B/key of table residency; correctness spot-checked."""
+        p = str(tmp_path / ".keys")
+        ts = TranslateStore(p)
+        batch = 10_000
+        for start in range(0, self.N, batch):
+            keys = [f"user:{j:012d}" for j in range(start, start + batch)]
+            ids = ts.translate_columns_to_ids("i", keys)
+            assert ids[0] == start + 1
+        per_key = ts.rss_bytes() / self.N
+        assert per_key < 50, f"{per_key:.1f} B/key resident"
+        # random membership + reverse lookups
+        assert ts.translate_columns_to_ids(
+            "i", ["user:%012d" % 123456, "user:%012d" % 7], create=False
+        ) == [123457, 8]
+        assert ts.translate_column_to_string("i", 199999) == "user:%012d" % 199998
+        ts.close()
+        # reopen replays the binary WAL into the same tables
+        ts2 = TranslateStore(p)
+        assert ts2.translate_columns_to_ids(
+            "i", ["user:%012d" % 54321], create=False
+        ) == [54322]
+        assert ts2.translate_columns_to_ids("i", ["fresh"]) == [self.N + 1]
+        ts2.close()
+
+    def test_no_python_key_dicts(self):
+        """Structural guard: spaces are __slots__ numpy holders — no
+        attribute can silently grow a per-key Python dict again."""
+        ts = TranslateStore()
+        ts.translate_columns_to_ids("i", ["a"])
+        sp = ts._spaces[("i", "")]
+        assert not hasattr(sp, "__dict__")
+        for attr in sp.__slots__:
+            v = getattr(sp, attr)
+            assert not isinstance(v, dict), attr
+
+
+class TestCheckpoint:
+    def test_open_uses_checkpoint_and_replays_tail(self, tmp_path):
+        p = str(tmp_path / ".keys")
+        ts = TranslateStore(p)
+        ts.translate_columns_to_ids("i", [f"k{j}" for j in range(5000)])
+        ts.close()  # writes .ckpt
+        assert os.path.exists(p + ".ckpt")
+        ts2 = TranslateStore(p)
+        assert ts2.translate_columns_to_ids("i", ["k42"], create=False) == [43]
+        # mint a tail, then simulate a crash (no checkpoint refresh)
+        ts2.translate_columns_to_ids("i", ["tail1", "tail2"])
+        ts2._log.close(); ts2._log = None
+        os.close(ts2._read_fd); ts2._read_fd = None
+        ts3 = TranslateStore(p)
+        assert ts3.translate_columns_to_ids("i", ["tail2"], create=False) == [5002]
+        assert ts3.translate_columns_to_ids("i", ["k0"], create=False) == [1]
+        ts3.close()
+
+    def test_stale_checkpoint_falls_back_to_full_replay(self, tmp_path):
+        p = str(tmp_path / ".keys")
+        ts = TranslateStore(p)
+        for j in range(100):  # one WAL entry per key
+            ts.translate_columns_to_ids("i", [f"k{j}"])
+        ts.close()
+        # WAL shrinks behind the checkpoint (e.g. restored from backup):
+        # the checkpoint must be distrusted and the surviving complete
+        # entries replayed from scratch
+        size = os.path.getsize(p)
+        with open(p, "r+b") as f:
+            f.truncate(size - 1)  # tears only the LAST entry
+        ts2 = TranslateStore(p)
+        assert ts2.translate_columns_to_ids("i", ["k0"], create=False) == [1]
+        assert ts2.translate_columns_to_ids("i", ["k98"], create=False) == [99]
+        assert ts2.translate_columns_to_ids("i", ["k99"], create=False) == [None]
+        ts2.close()
+
+    def test_corrupt_checkpoint_is_ignored(self, tmp_path):
+        p = str(tmp_path / ".keys")
+        ts = TranslateStore(p)
+        ts.translate_columns_to_ids("i", ["a", "b"])
+        ts.close()
+        with open(p + ".ckpt", "wb") as f:
+            f.write(b"garbage")
+        ts2 = TranslateStore(p)
+        assert ts2.translate_columns_to_ids("i", ["b"], create=False) == [2]
+        ts2.close()
+
+
+class TestReviewRegressions:
+    """Round-4 review findings: sentinel aliasing, batch-hash memory,
+    replica WAL growth, dense-id skip, hash parity."""
+
+    def test_reverse_lookup_of_unassigned_id_is_none(self):
+        # follower adopts a sparse primary-minted subset: seq jumps to
+        # 500 with ids 1..499 unassigned locally; reverse lookups of
+        # those must be None, not bytes read from WAL offset 0
+        primary = TranslateStore()
+        for j in range(499):
+            primary.translate_columns_to_ids("i", [f"k{j}"])
+        follower = TranslateStore()
+        follower.forward = lambda index, field, keys: primary.mint(index, field, keys)
+        assert follower.translate_columns_to_ids("i", ["k499"]) == [500]
+        assert follower.translate_column_to_string("i", 500) == "k499"
+        for probe in (1, 3, 250, 499):
+            assert follower.translate_column_to_string("i", probe) is None
+
+    def test_one_huge_key_in_batch_does_not_blow_memory(self):
+        ts = TranslateStore()
+        keys = [f"k{j}" for j in range(1000)] + ["X" * 1_000_000]
+        ids = ts.translate_columns_to_ids("i", keys)
+        assert ids[-1] == 1001
+        assert ts.translate_columns_to_ids("i", ["X" * 1_000_000], create=False) == [1001]
+
+    def test_replica_repull_does_not_grow_wal(self, tmp_path):
+        primary = TranslateStore(str(tmp_path / "p.keys"))
+        replica = TranslateStore(str(tmp_path / "r.keys"))
+        primary.translate_columns_to_ids("i", [f"k{j}" for j in range(100)])
+        data, _ = primary.read_from(0)
+        replica.apply_log(data)
+        size1 = replica.offset()
+        for _ in range(3):  # restart re-pulls from 0
+            replica.apply_log(data)
+        assert replica.offset() == size1, "re-pull must not re-append"
+
+    def test_overlapping_mint_does_not_skip_ids(self):
+        # the stale-miss-list race: ids are assigned AFTER the
+        # under-lock absence re-check, so an overlap cannot burn an id
+        ts = TranslateStore()
+        assert ts.translate_columns_to_ids("i", ["a", "b"]) == [1, 2]
+        with ts.mu:
+            resolved = ts._adopt("i", "", ["b", "c"], None)  # stale miss list
+        assert resolved == {"b": 2, "c": 3}
+        assert ts.translate_columns_to_ids("i", ["d"]) == [4]
+        # dense invariant: every id 1..4 reverse-resolves
+        assert [ts.translate_column_to_string("i", j) for j in (1, 2, 3, 4)] == [
+            "a", "b", "c", "d",
+        ]
+
+    def test_hash_parity_scalar_vs_vector(self):
+        from pilosa_tpu.utils.translate import _hash_key, _hash_keys
+
+        keys = [b"", b"a", b"user:000000000123", "日本語".encode(), b"Z" * 300,
+                b"y" * 257, b"x" * 256]
+        assert [_hash_key(k) for k in keys] == [int(v) for v in _hash_keys(keys)]
