@@ -1,9 +1,16 @@
 """Attribute store — arbitrary metadata k/v per row/column id.
 
-The reference stores attrs in BoltDB with an in-memory cache and
-100-id block checksums for anti-entropy diffing (reference attr.go,
-boltdb/attrstore.go). Here: an in-memory dict with an append-only JSONL
-log for durability and the same block-checksum diff protocol.
+The reference stores attrs in BoltDB (a disk B-tree) with an in-memory
+cache and 100-id block checksums for anti-entropy diffing (reference
+attr.go:34-43, boltdb/attrstore.go:82, attr.go:90-120). This build uses
+the same shape: a **SQLite B-tree on disk** (WAL mode) as the resident
+source of truth plus a **bounded LRU cache** of decoded attr maps — an
+attr set much larger than RAM stays on disk and only the working set
+is resident. Block checksums stream the table in id order, never
+materializing the full set.
+
+Round-3 stores wrote an append-only JSONL log replayed into a dict;
+those files migrate into SQLite in place on first open.
 """
 
 from __future__ import annotations
@@ -11,101 +18,201 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import sqlite3
 import threading
+from collections import OrderedDict
 from typing import Optional
 
 ATTR_BLOCK_SIZE = 100  # reference attrBlockSize (boltdb/attrstore.go)
+DEFAULT_CACHE_SIZE = 65536  # decoded attr maps kept hot (reference AttrCache)
+
+_SQLITE_MAGIC = b"SQLite format 3\x00"
 
 
 class AttrStore:
-    def __init__(self, path: Optional[str] = None) -> None:
+    def __init__(
+        self, path: Optional[str] = None, cache_size: int = DEFAULT_CACHE_SIZE
+    ) -> None:
         self.path = path
-        self._attrs: dict[int, dict] = {}
         self.mu = threading.RLock()
-        self._log = None
+        self._cache: OrderedDict[int, dict] = OrderedDict()
+        self._cache_size = cache_size
         if path:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            self._replay()
-            self._log = open(path, "a")
+            self._maybe_migrate_jsonl()
+            self._db = sqlite3.connect(path, check_same_thread=False)
+        else:
+            self._db = sqlite3.connect(":memory:", check_same_thread=False)
+        with self.mu:
+            self._db.execute(
+                "CREATE TABLE IF NOT EXISTS attrs"
+                " (id INTEGER PRIMARY KEY, data TEXT NOT NULL)"
+            )
+            if path:
+                # WAL keeps readers unblocked during writes and makes
+                # commits one fsync; NORMAL sync is the boltdb-like
+                # durability point (power loss may lose the last tx,
+                # never corrupt the tree)
+                self._db.execute("PRAGMA journal_mode=WAL")
+                self._db.execute("PRAGMA synchronous=NORMAL")
+            self._db.commit()
 
-    def _replay(self) -> None:
+    def _maybe_migrate_jsonl(self) -> None:
+        """A round-3 JSONL log at this path is replayed once into a
+        fresh SQLite file, atomically."""
         try:
-            with open(self.path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
+            with open(self.path, "rb") as f:
+                head = f.read(16)
+        except FileNotFoundError:
+            return
+        if not head or head == _SQLITE_MAGIC:
+            return
+        merged: dict[int, dict] = {}
+        with open(self.path) as src:
+            for line in src:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
                     entry = json.loads(line)
-                    self._merge(int(entry["id"]), entry["attrs"])
+                except ValueError:
+                    continue
+                cur = merged.setdefault(int(entry["id"]), {})
+                for k, v in entry["attrs"].items():
+                    if v is None:
+                        cur.pop(k, None)
+                    else:
+                        cur[k] = v
+        tmp = self.path + ".migrate"
+        try:
+            os.unlink(tmp)
         except FileNotFoundError:
             pass
+        db = sqlite3.connect(tmp)
+        db.execute(
+            "CREATE TABLE attrs (id INTEGER PRIMARY KEY, data TEXT NOT NULL)"
+        )
+        db.executemany(
+            "INSERT INTO attrs (id, data) VALUES (?, ?)",
+            (
+                (id_, json.dumps(a, sort_keys=True))
+                for id_, a in merged.items()
+                if a
+            ),
+        )
+        db.commit()
+        db.close()
+        os.replace(tmp, self.path)
 
     def close(self) -> None:
-        if self._log:
-            self._log.close()
-            self._log = None
+        with self.mu:
+            self._db.close()
 
-    def _merge(self, id_: int, new_attrs: dict) -> dict:
-        cur = self._attrs.get(id_, {}).copy()
+    # -- cache ----------------------------------------------------------
+
+    def _cache_put(self, id_: int, attrs: dict) -> None:
+        c = self._cache
+        c[id_] = attrs
+        c.move_to_end(id_)
+        while len(c) > self._cache_size:
+            c.popitem(last=False)
+
+    # -- interface (reference attr.go:34-43) -----------------------------
+
+    def attrs(self, id_: int) -> dict:
+        with self.mu:
+            hit = self._cache.get(id_)
+            if hit is not None:
+                self._cache.move_to_end(id_)
+                return dict(hit)
+            row = self._db.execute(
+                "SELECT data FROM attrs WHERE id = ?", (id_,)
+            ).fetchone()
+            out = json.loads(row[0]) if row else {}
+            self._cache_put(id_, out)
+            return dict(out)
+
+    def set_attrs(self, id_: int, attrs: dict) -> None:
+        with self.mu:
+            self._merge_locked(id_, attrs)
+            self._db.commit()
+
+    def set_bulk_attrs(self, attrs_by_id: dict[int, dict]) -> None:
+        with self.mu:
+            for id_, attrs in attrs_by_id.items():
+                self._merge_locked(int(id_), attrs)
+            self._db.commit()
+
+    def _merge_locked(self, id_: int, new_attrs: dict) -> None:
+        cur = self._cache.get(id_)
+        if cur is None:
+            row = self._db.execute(
+                "SELECT data FROM attrs WHERE id = ?", (id_,)
+            ).fetchone()
+            cur = json.loads(row[0]) if row else {}
+        else:
+            cur = dict(cur)
         for k, v in new_attrs.items():
             if v is None:
                 cur.pop(k, None)
             else:
                 cur[k] = v
-        self._attrs[id_] = cur
-        return cur
-
-    # -- interface (reference attr.go:34-43) --
-
-    def attrs(self, id_: int) -> dict:
-        with self.mu:
-            return self._attrs.get(id_, {})
-
-    def set_attrs(self, id_: int, attrs: dict) -> None:
-        with self.mu:
-            self._merge(id_, attrs)
-            if self._log:
-                self._log.write(json.dumps({"id": id_, "attrs": attrs}) + "\n")
-                self._log.flush()
-
-    def set_bulk_attrs(self, attrs_by_id: dict[int, dict]) -> None:
-        with self.mu:
-            for id_, attrs in attrs_by_id.items():
-                self._merge(id_, attrs)
-                if self._log:
-                    self._log.write(json.dumps({"id": id_, "attrs": attrs}) + "\n")
-            if self._log:
-                self._log.flush()
+        if cur:
+            self._db.execute(
+                "INSERT INTO attrs (id, data) VALUES (?, ?)"
+                " ON CONFLICT(id) DO UPDATE SET data = excluded.data",
+                (id_, json.dumps(cur, sort_keys=True)),
+            )
+        else:
+            self._db.execute("DELETE FROM attrs WHERE id = ?", (id_,))
+        self._cache_put(id_, cur)
 
     def ids(self) -> list[int]:
         with self.mu:
-            return sorted(self._attrs)
+            return [
+                r[0]
+                for r in self._db.execute("SELECT id FROM attrs ORDER BY id")
+            ]
+
+    def cache_len(self) -> int:
+        with self.mu:
+            return len(self._cache)
 
     # -- anti-entropy blocks (reference AttrBlocks / Diff, attr.go:90-120) --
 
     def blocks(self) -> list[tuple[int, bytes]]:
+        """100-id block checksums, STREAMED from the B-tree in id order
+        — O(cache) resident regardless of attr-set size."""
         with self.mu:
-            by_block: dict[int, hashlib.blake2b] = {}
-            for id_ in sorted(self._attrs):
+            out: list[tuple[int, bytes]] = []
+            h: Optional[hashlib.blake2b] = None
+            cur_block = None
+            for id_, data in self._db.execute(
+                "SELECT id, data FROM attrs ORDER BY id"
+            ):
                 block = id_ // ATTR_BLOCK_SIZE
-                h = by_block.get(block)
-                if h is None:
+                if block != cur_block:
+                    if h is not None:
+                        out.append((cur_block, h.digest()))
                     h = hashlib.blake2b(digest_size=16)
-                    by_block[block] = h
-                h.update(id_.to_bytes(8, "little"))
-                h.update(
-                    json.dumps(self._attrs[id_], sort_keys=True).encode()
-                )
-            return [(b, by_block[b].digest()) for b in sorted(by_block)]
+                    cur_block = block
+                h.update(int(id_).to_bytes(8, "little"))
+                # data is stored as sorted-keys JSON, so hashing the
+                # stored text is identical to re-encoding the dict
+                h.update(data.encode())
+            if h is not None:
+                out.append((cur_block, h.digest()))
+            return out
 
     def block_data(self, block_id: int) -> dict[int, dict]:
         with self.mu:
             lo = block_id * ATTR_BLOCK_SIZE
-            hi = lo + ATTR_BLOCK_SIZE
             return {
-                id_: attrs.copy()
-                for id_, attrs in self._attrs.items()
-                if lo <= id_ < hi
+                id_: json.loads(data)
+                for id_, data in self._db.execute(
+                    "SELECT id, data FROM attrs WHERE id >= ? AND id < ?",
+                    (lo, lo + ATTR_BLOCK_SIZE),
+                )
             }
 
     @staticmethod

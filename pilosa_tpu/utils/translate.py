@@ -44,15 +44,9 @@ _LOAD_FACTOR = 85  # percent, reference defaultLoadFactor=90 (translate.go:730)
 _EMPTY = np.uint64(0)
 
 
-def _uvarint(buf: bytearray, v: int) -> None:
-    while True:
-        b = v & 0x7F
-        v >>= 7
-        if v:
-            buf.append(b | 0x80)
-        else:
-            buf.append(b)
-            return
+# one uvarint writer for the whole codebase (protometa's; same codec
+# the reference's binary.PutUvarint produces)
+from pilosa_tpu.utils.protometa import _write_varint as _uvarint  # noqa: E402
 
 
 def _read_uvarint(data: bytes, i: int) -> tuple[int, int]:
@@ -68,14 +62,13 @@ def _read_uvarint(data: bytes, i: int) -> tuple[int, int]:
 
 
 def _hash_key(key: bytes) -> int:
-    """FNV-1a 64 (matching parallel/hashing.py's function family),
-    forced nonzero — 0 marks an empty slot (reference hashKey,
-    translate.go:885-891 does the same with xxhash)."""
-    h = 0xCBF29CE484222325
-    for b in key:
-        h ^= b
-        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
-    return h or 1
+    """FNV-1a 64 (THE fnv64a from parallel/hashing.py — one
+    implementation repo-wide), forced nonzero: 0 marks an empty slot
+    (reference hashKey, translate.go:885-891 does the same with
+    xxhash)."""
+    from pilosa_tpu.parallel.hashing import fnv64a
+
+    return fnv64a(key) or 1
 
 
 # keys longer than this hash via the scalar loop; the vector path pads
@@ -142,17 +135,22 @@ class _Space:
     # -- lookups ---------------------------------------------------------
 
     def find_batch(
-        self, keys: Sequence[bytes], read_key: Callable[[int], bytes]
+        self,
+        keys: Sequence[bytes],
+        read_key: Callable[[int], bytes],
+        h: Optional[np.ndarray] = None,
     ) -> np.ndarray:
         """ids for keys (0 = absent), probing the whole batch in
         lockstep: each round compares every still-unresolved key's
         current slot vectorized; only hash-equal candidates pay a
-        byte-compare."""
+        byte-compare. Pass precomputed hashes ``h`` to skip rehashing
+        (callers on the mint/replication paths hash once per batch)."""
         nk = len(keys)
         out = np.zeros(nk, dtype=np.uint64)
         if nk == 0 or self.n == 0:
             return out
-        h = _hash_keys(keys)
+        if h is None:
+            h = _hash_keys(keys)
         pos = h & np.uint64(self.mask)
         alive = np.arange(nk)
         while alive.size:
@@ -381,18 +379,18 @@ class TranslateStore:
             if key not in first:
                 first[key] = (id_, wal_base + rel)
         keys = list(first.keys())
-        present = space.find_batch(keys, self._read_key)
+        h = _hash_keys(keys)  # once; sliced for the insert below
+        present = space.find_batch(keys, self._read_key, h=h)
         take = [i for i, v in enumerate(present) if v == 0]
         if not take:
             return
-        h = _hash_keys([keys[i] for i in take])
         off = np.fromiter(
             (first[keys[i]][1] for i in take), dtype=np.int64, count=len(take)
         )
         ids = np.fromiter(
             (first[keys[i]][0] for i in take), dtype=np.uint64, count=len(take)
         )
-        space.insert_batch(h, off, ids)
+        space.insert_batch(h[take], off, ids)
 
     def _space(self, index: str, field: str) -> _Space:
         k = (index, field)
@@ -513,12 +511,23 @@ class TranslateStore:
         LogEntry format once, atomically."""
         try:
             with open(self.path, "rb") as f:
-                head = f.read(1)
+                head = f.readline(1 << 20)
         except FileNotFoundError:
             return
-        if head != b"{":
+        if not head.startswith(b"{"):
             return
+        # '{' alone is not proof: a BINARY WAL whose first entry-length
+        # uvarint happens to be 0x7B ('{') would be destroyed by a
+        # mistaken migration. Only migrate when the first line actually
+        # parses as a round-3 JSONL record.
         import json
+
+        try:
+            rec = json.loads(head.decode())
+            if not (isinstance(rec, dict) and "id" in rec and "key" in rec):
+                return
+        except (ValueError, UnicodeDecodeError):
+            return
 
         tmp = self.path + ".migrate"
         with open(self.path) as src, open(tmp, "wb") as dst:
@@ -562,20 +571,25 @@ class TranslateStore:
         allow_forward: bool = True,
     ) -> List[Optional[int]]:
         kb = [k.encode() for k in keys]
+        h_all = _hash_keys(kb)  # hashed ONCE per call, threaded through
         with self.mu:
             space = self._space(index, field)
-            found = space.find_batch(kb, self._read_key)
+            found = space.find_batch(kb, self._read_key, h=h_all)
         if not create:
             return [int(v) if v else None for v in found]
-        # de-dup the misses, preserving order
+        # de-dup the misses, preserving order (keeping each first
+        # occurrence's index so hashes can be sliced, not recomputed)
         miss_keys: list[str] = []
+        miss_idx: list[int] = []
         seen = set()
         for i, v in enumerate(found):
             if v == 0 and keys[i] not in seen:
                 seen.add(keys[i])
                 miss_keys.append(keys[i])
+                miss_idx.append(i)
         if not miss_keys:
             return [int(v) for v in found]
+        h_miss = h_all[miss_idx]
         forward = self.forward if allow_forward else None
         if forward is not None:
             # network call OUTSIDE the lock; the primary mints
@@ -589,11 +603,11 @@ class TranslateStore:
                 )
             with self.mu:
                 resolved = self._adopt(
-                    index, field, miss_keys, [int(m) for m in minted]
+                    index, field, miss_keys, [int(m) for m in minted], h=h_miss
                 )
         else:
             with self.mu:
-                resolved = self._adopt(index, field, miss_keys, None)
+                resolved = self._adopt(index, field, miss_keys, None, h=h_miss)
         out: List[Optional[int]] = []
         for i, v in enumerate(found):
             out.append(int(v) if v else resolved[keys[i]])
@@ -605,6 +619,7 @@ class TranslateStore:
         field: str,
         keys: Sequence[str],
         ids: Optional[Sequence[int]],
+        h: Optional[np.ndarray] = None,
     ) -> dict[str, int]:
         """Record (key, id) pairs under the caller-held lock; returns
         key → id for every input key. ``ids=None`` mints dense ids —
@@ -616,7 +631,9 @@ class TranslateStore:
         idempotent."""
         space = self._space(index, field)
         kb = [k.encode() for k in keys]
-        fresh = space.find_batch(kb, self._read_key)
+        if h is None:
+            h = _hash_keys(kb)
+        fresh = space.find_batch(kb, self._read_key, h=h)
         resolved = {
             keys[i]: int(v) for i, v in enumerate(fresh) if v != 0
         }
@@ -632,12 +649,13 @@ class TranslateStore:
         blob = self.encode_entry(typ, index, field, new_ids, new_kb)
         at = self._append(blob)
         # insert directly: the keys are distinct and known-absent, so
-        # no second membership probe. Offsets come from the shared
-        # decoder — one source of truth for key-offset arithmetic with
-        # the replay/replication paths.
+        # no second membership probe; hashes are sliced from the batch
+        # hash, not recomputed. Offsets come from the shared decoder —
+        # one source of truth for key-offset arithmetic with the
+        # replay/replication paths.
         _, _, _, pairs = self.decode_entry(blob, 0)
         space.insert_batch(
-            _hash_keys(new_kb),
+            h[take],
             np.fromiter((at + rel for _, _, rel in pairs), dtype=np.int64,
                         count=len(pairs)),
             np.asarray(new_ids, dtype=np.uint64),
@@ -725,16 +743,29 @@ class TranslateStore:
                 # append ONLY when the entry carries something new: a
                 # replica restart re-pulls from offset 0 (replica_offset
                 # is in-memory), and unconditionally re-appending would
-                # grow the local WAL by a full primary copy per restart
+                # grow the local WAL by a full primary copy per restart.
+                # One hash + one probe decides both the append and the
+                # insert (no second membership pass).
                 space = self._space(index, field)
-                keys = [k for _, k, _ in pairs]
-                present = space.find_batch(keys, self._read_key)
-                if int(np.count_nonzero(present == 0)) > 0:
+                first: dict[bytes, tuple[int, int]] = {}
+                for id_, key, rel in pairs:
+                    if key not in first:
+                        first[key] = (id_, rel - at)
+                keys = list(first.keys())
+                h = _hash_keys(keys)
+                present = space.find_batch(keys, self._read_key, h=h)
+                take = [i for i, v in enumerate(present) if v == 0]
+                if take:
                     blob = bytes(data[at:end])
                     local_at = self._append(blob)
-                    # pairs' rel offsets are relative to data[0];
-                    # rebase to the local append position
-                    rebased = [(i_, k, r - at) for (i_, k, r) in pairs]
-                    self._insert_pairs(index, field, rebased, local_at)
+                    off = np.fromiter(
+                        (local_at + first[keys[i]][1] for i in take),
+                        dtype=np.int64, count=len(take),
+                    )
+                    ids = np.fromiter(
+                        (first[keys[i]][0] for i in take),
+                        dtype=np.uint64, count=len(take),
+                    )
+                    space.insert_batch(h[take], off, ids)
                 at = end
         return at

@@ -306,3 +306,20 @@ class TestReviewRegressions:
         keys = [b"", b"a", b"user:000000000123", "日本語".encode(), b"Z" * 300,
                 b"y" * 257, b"x" * 256]
         assert [_hash_key(k) for k in keys] == [int(v) for v in _hash_keys(keys)]
+
+
+class TestMigrationSniff:
+    def test_binary_wal_starting_with_0x7b_survives_reopen(self, tmp_path):
+        # an entry whose length uvarint is 0x7B ('{') must not be
+        # mistaken for round-3 JSONL and destroyed
+        p = str(tmp_path / ".keys")
+        ts = TranslateStore(p)
+        key = "K" * 116  # entry body = 123 = 0x7B bytes
+        assert ts.translate_columns_to_ids("i", [key]) == [1]
+        ts.close()
+        os.unlink(p + ".ckpt")  # force a raw-WAL replay path
+        with open(p, "rb") as f:
+            assert f.read(1) == b"{"
+        ts2 = TranslateStore(p)
+        assert ts2.translate_columns_to_ids("i", [key], create=False) == [1]
+        ts2.close()
