@@ -185,6 +185,15 @@ def run(deadline_s: float = 1e9) -> dict:
     h = Holder(_effective_cache_dir(rows_per_shard))
     t_open = time.monotonic()
     h.open()
+    # eager-open every fragment, like the reference's startup walk
+    # (holder.Open → fragment.Open incl. cache restore,
+    # fragment.go:167-266): open_warm_s is THAT cost — storage open +
+    # occupancy sidecar mmap + cache restore — not device staging,
+    # which warms below under its own clock (device_warm_s)
+    view = h.view("tall", "f", "standard")
+    for s in sorted(view.fragments):
+        view.fragments[s].ensure_open()
+    out["open_warm_s"] = round(time.monotonic() - t_open, 2)
     dev = Executor(h, device_policy="always")
     cpu = Executor(h, device_policy="never")
     topn, chains = _queries()
@@ -220,7 +229,9 @@ def run(deadline_s: float = 1e9) -> dict:
             if time.monotonic() - t_warm > warm_budget or remaining() < 25:
                 break
             dev.execute("tall", q)
-        out["open_warm_s"] = round(time.monotonic() - t_open, 1)
+        # device-side warm cost (first-touch HBM staging + compiles),
+        # reported separately from the storage open above
+        out["device_warm_s"] = round(time.monotonic() - t_warm, 1)
 
         budget = max(min(remaining() - 20, 60), 6)
         topn_qps, topn_p50 = _measure(

@@ -31,8 +31,21 @@ def sort_pairs(pairs: list[tuple[int, int]]) -> list[tuple[int, int]]:
     The reference uses Go's unstable sort with count-only comparison
     (cache.go:342); ties are therefore unspecified there — we pin them
     to ascending id for determinism.
+
+    Vectorized for big inputs: recalculate() sorts 50k entries per
+    fragment on the open path (64 fragments at the 1B scale), and a
+    per-element key lambda was the single largest line in the warm-open
+    profile. lexsort(ids asc, then counts desc stable) = the same
+    (-count, id) order.
     """
-    return sorted(pairs, key=lambda p: (-p[1], p[0]))
+    if len(pairs) < 1024:
+        return sorted(pairs, key=lambda p: (-p[1], p[0]))
+    import numpy as np
+
+    ids = np.fromiter((p[0] for p in pairs), dtype=np.int64, count=len(pairs))
+    counts = np.fromiter((p[1] for p in pairs), dtype=np.int64, count=len(pairs))
+    order = np.lexsort((ids, -counts))
+    return list(zip(ids[order].tolist(), counts[order].tolist()))
 
 
 class Rankings(list):
@@ -89,6 +102,15 @@ class RankCache:
     def ids(self) -> list[int]:
         return sorted(self.entries)
 
+    def restore(self, ids, counts) -> None:
+        """Bulk-load (id, count) pairs at open — C-speed dict build +
+        one recalculate instead of 50k bulk_add calls (the open path
+        at 64 fragments × 50k cached rows)."""
+        ids = ids.tolist() if hasattr(ids, "tolist") else ids
+        counts = counts.tolist() if hasattr(counts, "tolist") else counts
+        self.entries.update(zip(map(int, ids), map(int, counts)))
+        self.recalculate()
+
     def invalidate(self) -> None:
         if time.monotonic() - self._update_time < INVALIDATE_DEBOUNCE_SECONDS:
             return
@@ -135,6 +157,10 @@ class LRUCache:
 
     bulk_add = add
 
+    def restore(self, ids, counts) -> None:
+        for i, c in zip(ids, counts):
+            self.add(int(i), int(c))
+
     def get(self, id_: int) -> int:
         n = self._lru.get(id_)
         if n is None:
@@ -171,6 +197,9 @@ class NopCache:
         pass
 
     bulk_add = add
+
+    def restore(self, ids, counts) -> None:
+        pass
 
     def get(self, id_: int) -> int:
         return 0
@@ -243,6 +272,30 @@ def read_cache(path: str) -> Optional[list[int]]:
         return None
 
 
+def _decode_packed_varints(payload: bytes) -> list[int]:
+    """Vectorized decode of concatenated uvarints: one masked
+    shift-or round per varint BYTE POSITION (≤10) instead of a Python
+    loop per byte — the .cache open path decodes 50k ids in ~1 ms."""
+    import numpy as np
+
+    b = np.frombuffer(payload, dtype=np.uint8)
+    if b.size == 0:
+        return []
+    ends = np.nonzero((b & 0x80) == 0)[0]
+    if ends.size == 0 or ends[-1] != b.size - 1:
+        raise ValueError("cache file: packed ids overrun field")
+    starts = np.empty_like(ends)
+    starts[0] = 0
+    starts[1:] = ends[:-1] + 1
+    lens = ends - starts + 1
+    vals = np.zeros(ends.size, dtype=np.uint64)
+    for j in range(int(lens.max())):
+        take = lens > j
+        byte = b[starts[take] + j].astype(np.uint64) & np.uint64(0x7F)
+        vals[take] |= byte << np.uint64(7 * j)
+    return vals.tolist()
+
+
 def decode_cache(data: bytes) -> list[int]:
     """Decode .cache bytes: reference protobuf, or the JSON this
     framework wrote before adopting the reference format."""
@@ -261,11 +314,7 @@ def decode_cache(data: bytes) -> list[int]:
             ln, i = _read_varint(data, i)
             end = i + ln
             if field_no == 1:
-                while i < end:
-                    v, i = _read_varint(data, i)
-                    ids.append(v)
-                if i != end:
-                    raise ValueError("cache file: packed ids overrun field")
+                ids.extend(_decode_packed_varints(data[i:end]))
             i = end  # skip unknown length-delimited fields
         elif wire == 0:
             v, i = _read_varint(data, i)

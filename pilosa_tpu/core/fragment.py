@@ -175,14 +175,13 @@ class Fragment:
         if not ids:
             return
         counts = self.row_counts_for(np.asarray(ids, dtype=np.uint64))
-        for row_id, cnt in zip(ids, counts):
-            self.cache.bulk_add(row_id, int(cnt))
-        # recalculate UNCONDITIONALLY: a debounced invalidate() can be
-        # silently skipped when something touched this cache before the
-        # lazy open (e.g. /recalculate-caches sweeping unopened
-        # fragments stamps the debounce clock with empty rankings) —
-        # the restore is authoritative and must rebuild the rankings
-        self.cache.recalculate()
+        # restore() recalculates UNCONDITIONALLY: a debounced
+        # invalidate() can be silently skipped when something touched
+        # this cache before the lazy open (e.g. /recalculate-caches
+        # sweeping unopened fragments stamps the debounce clock with
+        # empty rankings) — the restore is authoritative and must
+        # rebuild the rankings
+        self.cache.restore(ids, counts)
 
     def _row_key_spans(
         self, row_ids: np.ndarray
@@ -661,6 +660,14 @@ class Fragment:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
+            # the base just changed: the occupancy sidecar is stale by
+            # construction (its stamp may even collide — equal size +
+            # container count after a balanced clear/set pair), so
+            # remove it; the next occupancy() regenerates it
+            try:
+                os.unlink(self.path + ".occ")
+            except OSError:
+                pass
             if self.storage.is_mmap_backed():
                 # Re-map the fresh snapshot so the overlay drains back
                 # into the frozen base (reference snapshot re-mmaps,

@@ -765,7 +765,14 @@ class Bitmap:
             return cls()
         with open(path, "rb") as f:
             mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
-        return cls.unmarshal_mmap(mm)
+        b = cls.unmarshal_mmap(mm)
+        # knowing the backing path enables the .occ occupancy sidecar
+        # (mmapstore.occupancy) — first touch becomes a page-in
+        from pilosa_tpu.roaring.mmapstore import MmapContainers
+
+        if isinstance(b.containers, MmapContainers):
+            b.containers.path = path
+        return b
 
     @classmethod
     def unmarshal_mmap(cls, buf) -> "Bitmap":

@@ -195,6 +195,16 @@ def build_fragment_file(
 
     keys, ns = write_roaring_file(frag_path, chunks)
     stats = {"containers": int(keys.size), "bits": int(ns.sum())}
+    # the keys/cardinalities are in hand: emit the .occ occupancy
+    # sidecar now so the FIRST open mmaps it instead of paying the
+    # copy+cumsum pass (mmapstore.occupancy)
+    from pilosa_tpu.roaring.mmapstore import occ_arrays, write_occ_sidecar
+
+    okeys, ocs = occ_arrays(keys.astype(np.uint64), ns.astype(np.uint32))
+    write_occ_sidecar(
+        frag_path + ".occ", okeys, ocs, int(keys.size),
+        os.path.getsize(frag_path),
+    )
     rows = (keys // np.uint64(shard_width_containers)).astype(np.uint64)
     if rows.size:
         row_idx = np.nonzero(np.concatenate(([True], np.diff(rows) > 0)))[0]

@@ -222,6 +222,20 @@ class Server:
             self.executor.cluster = self.cluster
             self.api.cluster = self.cluster
             self.cluster.attach_server(self)
+        # measure the device-policy crossover for THIS deployment
+        # (dispatch RTT / per-container CPU cost) unless the operator
+        # pinned one via config or env — measured beats guessed
+        # (AUTOTUNE.json; executor/autotune.py). Non-blocking: serving
+        # starts on the default and adopts the measurement when it
+        # lands; a wedged tunnel can't stall startup.
+        if (
+            self.config.device_policy == "auto"
+            and self.config.auto_device_min_containers <= 0
+            and not os.environ.get("PILOSA_AUTO_DEVICE_MIN_CONTAINERS")
+        ):
+            from pilosa_tpu.executor.autotune import autotune_executor
+
+            autotune_executor(self.executor, logger=self.logger)
         self._start_background_loops()
 
     def _normalize_host_uri(self, h: str) -> str:

@@ -333,3 +333,92 @@ class TestConcurrentMmapFragment:
         for tid in range(4):
             assert f2.row(1000 + tid).count() == len({i * 7 % SHARD_WIDTH for i in range(300)})
         f2.close()
+
+
+class TestOccupancySidecar:
+    """.occ sidecar: mmapped base occupancy so a 64-fragment 1B index
+    opens in O(page-in), not O(copy+cumsum per fragment)."""
+
+    def _write_frag(self, tmp_path, name="0"):
+        from pilosa_tpu.roaring.writer import build_fragment_file
+
+        rng = np.random.default_rng(3)
+        pos = np.unique(rng.integers(0, 1 << 24, size=20_000, dtype=np.uint64))
+        p = str(tmp_path / name)
+        build_fragment_file(p, [pos])
+        return p, pos
+
+    def test_builder_emits_sidecar_and_open_uses_it(self, tmp_path):
+        p, _ = self._write_frag(tmp_path)
+        assert os.path.exists(p + ".occ")
+        b = Bitmap.open_mmap_file(p)
+        # the load path must actually be taken — a silently rejected
+        # stamp would fall back to computing and still pass the oracle
+        assert b.containers._occ_sidecar_load() is not None
+        keys_sc, cs_sc = b.containers.occupancy()
+        # oracle: force a from-scratch computation (no sidecar)
+        os.unlink(p + ".occ")
+        b2 = Bitmap.open_mmap_file(p)
+        keys, cs = b2.containers.occupancy()
+        assert keys_sc.dtype == keys.dtype and cs_sc.dtype == cs.dtype
+        assert np.array_equal(np.asarray(keys_sc), keys)
+        assert np.array_equal(np.asarray(cs_sc), cs)
+        # ...and the from-scratch pass regenerated the sidecar
+        assert os.path.exists(p + ".occ")
+
+    def test_stale_sidecar_rejected_after_snapshot(self, tmp_path):
+        p, _ = self._write_frag(tmp_path)
+        frag = Fragment(p, "i", "f", "standard", 0)
+        frag.ensure_open()
+        before = frag.storage.containers.occupancy()
+        frag.set_bit(999, 12345)  # overlay mutation
+        frag.snapshot()  # rewrites the base; old .occ is now stale
+        b = Bitmap.open_mmap_file(p)
+        keys, cs = b.containers.occupancy()
+        # the new bit's container must be visible in the fresh index
+        assert int(cs[-1]) == int(before[1][-1]) + 1
+        frag.close()
+
+    def test_corrupt_sidecar_falls_back(self, tmp_path):
+        p, _ = self._write_frag(tmp_path)
+        with open(p + ".occ", "wb") as f:
+            f.write(b"junk")
+        b = Bitmap.open_mmap_file(p)
+        keys, cs = b.containers.occupancy()
+        assert keys.size > 0 and int(cs[-1]) > 0
+
+    def test_mutated_store_does_not_save_or_use_sidecar(self, tmp_path):
+        p, _ = self._write_frag(tmp_path)
+        os.unlink(p + ".occ")
+        b = Bitmap.open_mmap_file(p)
+        b.add(77 << 16)  # overlay (new container)
+        keys, cs = b.containers.occupancy()
+        assert not os.path.exists(p + ".occ")  # impure: no sidecar write
+        assert np.uint64(77) in np.asarray(keys).astype(np.uint64)
+
+    def test_balanced_mutation_snapshot_cannot_serve_stale_sidecar(self, tmp_path):
+        """Snapshot collision: clear one bit in container A and set one
+        in existing container B — container count AND payload bytes are
+        unchanged, so (base_n, ops_offset) match the old sidecar. Only
+        the mtime/size stamp (plus snapshot's unlink) detects it."""
+        from pilosa_tpu.roaring.writer import build_fragment_file
+
+        pos = np.concatenate([
+            np.arange(100, dtype=np.uint64),                 # container 0
+            np.arange(100, dtype=np.uint64) + (1 << 16),     # container 1
+        ])
+        p = str(tmp_path / "bal")
+        build_fragment_file(p, [np.sort(pos)])
+        frag = Fragment(p, "i", "f", "standard", 0)
+        frag.ensure_open()
+        old_keys, old_cs = frag.storage.containers.occupancy()
+        stale = (np.asarray(old_keys).copy(), np.asarray(old_cs).copy())
+        frag.clear_bit(0, 99)          # -1 bit in container 0
+        frag.set_bit(1, 100)           # +1 bit in container 1
+        frag.snapshot()
+        b = Bitmap.open_mmap_file(p)
+        keys, cs = b.containers.occupancy()
+        assert int(cs[-1]) == int(stale[1][-1])  # same total (balanced)
+        # but the PER-container sums differ from the stale sidecar
+        assert not np.array_equal(np.asarray(cs), stale[1])
+        frag.close()
