@@ -39,6 +39,72 @@ import numpy as np
 # main() (after the jax import) would silently eat the guard margin
 _T_PROC_START = time.monotonic()
 
+# ---- sub-result checkpointing -------------------------------------------
+# Each completed sub-bench (tall full-path, kernel microbench) persists
+# to disk the moment it finishes, tagged with the git revision it
+# measured. A tunnel wedge mid-run then costs only the unfinished
+# parts: the next attempt (same invocation or a retry) reuses fresh
+# same-revision parts instead of replaying a whole prior round
+# (BENCH_r03's failure mode). Parts from a DIFFERENT revision are never
+# reused — stale-replay remains the explicitly-labeled last resort.
+
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+PARTS_PATH = os.path.join(_REPO_DIR, ".bench_cache", "bench_parts.json")
+PART_MAX_AGE_S = 3 * 3600.0
+
+
+def _git_rev() -> str:
+    import subprocess
+
+    try:
+        return subprocess.run(
+            ["git", "-C", _REPO_DIR, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def save_part(name: str, obj: dict) -> None:
+    try:
+        os.makedirs(os.path.dirname(PARTS_PATH), exist_ok=True)
+        try:
+            with open(PARTS_PATH) as f:
+                parts = json.load(f)
+        except (OSError, ValueError):
+            parts = {}
+        parts[name] = {
+            "data": obj,
+            "ts": time.time(),
+            "rev": _git_rev(),
+        }
+        tmp = PARTS_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(parts, f)
+        os.replace(tmp, PARTS_PATH)
+    except OSError as e:
+        print(f"could not checkpoint part {name}: {e}", file=sys.stderr)
+
+
+def load_part(name: str):
+    """A fresh part measured on THIS code revision, or None."""
+    try:
+        with open(PARTS_PATH) as f:
+            parts = json.load(f)
+        p = parts.get(name)
+        if not p:
+            return None
+        if p.get("rev") != _git_rev():
+            return None
+        age = time.time() - p.get("ts", 0)
+        if age > PART_MAX_AGE_S:
+            return None
+        data = dict(p["data"])
+        data["checkpointed_age_s"] = round(age, 1)
+        return data
+    except (OSError, ValueError):
+        return None
+
 
 def main():
     import os
@@ -109,14 +175,34 @@ def main():
         try:
             import bench_tall
 
-            spent = time.monotonic() - _T_PROC_START
-            # the full-path number is what matters: it gets the budget
-            # minus a small reserve; the kernel microbench below only
-            # runs if time is left (its numbers also live in BENCH_r*
-            # history)
-            tall_deadline = child_budget - spent - 70
-            if tall_deadline > 75:
-                tall = bench_tall.run(deadline_s=tall_deadline)
+            # resume: a complete same-revision tall part from an attempt
+            # wedged later in ITS run (or an earlier attempt of this
+            # invocation) is this round's measurement — reuse it instead
+            # of burning the budget again
+            cached = load_part("tall")
+            if cached and cached.get("topn_qps") and cached.get(
+                "platform"
+            ) == result["platform"]:
+                tall = cached
+                # top-level marker: the headline below comes from a
+                # same-revision checkpoint of an earlier attempt, not
+                # a measurement taken by THIS invocation
+                result["tall_checkpointed"] = True
+                result["tall_checkpoint_age_s"] = cached.get(
+                    "checkpointed_age_s"
+                )
+            else:
+                spent = time.monotonic() - _T_PROC_START
+                # the full-path number is what matters: it gets the
+                # budget minus a small reserve; the kernel microbench
+                # below only runs if time is left (its numbers also
+                # live in BENCH_r* history)
+                tall_deadline = child_budget - spent - 70
+                if tall_deadline > 75:
+                    tall = bench_tall.run(deadline_s=tall_deadline)
+                    if tall.get("topn_qps") and not tall.get("error"):
+                        save_part("tall", tall)
+            if tall is not None:
                 result["tall"] = tall
                 if tall.get("topn_qps"):
                     rows = tall["build"]["rows"]
@@ -159,12 +245,35 @@ def main():
     except Exception as e:  # any malformed baseline file — keep the JSON flowing
         print(f"native baseline unavailable: {type(e).__name__}: {e}", file=sys.stderr)
 
-    if child_budget - (time.monotonic() - _T_PROC_START) < 210:
+    # a fresh same-revision checkpointed kernel is free — use it even
+    # when the remaining budget couldn't afford a fresh measurement
+    cached_kernel = load_part("kernel")
+    if not (
+        cached_kernel and cached_kernel.get("platform") == result["platform"]
+    ) and child_budget - (time.monotonic() - _T_PROC_START) < 210:
         # Not enough room for the kernel microbench (measured ~160 s
         # warm: matrix build + compile + three paths) — ship the
         # complete tall headline rather than risk the deadline guard
         # marking the whole line partial over the secondary numbers.
         result["kernel_bench"] = "skipped (budget)"
+        emit(final=True)
+        return
+
+    if cached_kernel and cached_kernel.get("platform") == result["platform"]:
+        result.update(
+            {k: v for k, v in cached_kernel.items() if k != "platform"}
+        )
+        result["kernel_checkpointed"] = True
+        if not (tall and tall.get("topn_qps")) and cached_kernel.get("kernel_qps"):
+            result.update(
+                {
+                    "metric": "TopN queries/sec (kernel microbench, single chip)",
+                    "value": cached_kernel["kernel_qps"],
+                    "vs_baseline": cached_kernel.get("kernel_vs_baseline"),
+                    "p50_ms": cached_kernel.get("kernel_p50_ms"),
+                    "baseline_cpu_qps": cached_kernel.get("kernel_cpu_qps"),
+                }
+            )
         emit(final=True)
         return
 
@@ -325,6 +434,14 @@ def main():
     cpu_query_s = per_row * R
     cpu_qps = 1.0 / cpu_query_s
 
+    # Roofline: each query's score pass reads the full packed matrix
+    # (R x 16384 u64 words) as operands. Effective operand traffic =
+    # qps x matrix bytes; compared against v5e HBM peak (~819 GB/s) it
+    # shows WHERE the kernel sits — above peak means the staged tiles
+    # are reused on-chip across the batch's sources (compute-bound),
+    # below means HBM-bound.
+    matrix_bytes = R * W64 * 8
+    v5e_hbm_peak = 819e9
     kernel_fields = {
         "xla_qps": round(tpu_qps, 2),
         "pallas_qps": round(pallas_qps, 2),
@@ -334,8 +451,24 @@ def main():
         "kernel_cpu_qps": round(cpu_qps, 3),
         "kernel_vs_baseline": round(best_qps / cpu_qps, 2),
         "kernel_p50_ms": round(p50, 3),
+        "roofline": {
+            "operand_bytes_per_query": matrix_bytes,
+            "effective_operand_traffic_GBps": round(
+                best_qps * matrix_bytes / 1e9, 1
+            ),
+            "v5e_hbm_peak_GBps": round(v5e_hbm_peak / 1e9),
+            "fraction_of_hbm_peak": round(
+                best_qps * matrix_bytes / v5e_hbm_peak, 2
+            ),
+            "arithmetic": (
+                f"{R} rows x {W64} u64 words x 8 B = "
+                f"{matrix_bytes / 1e6:.0f} MB operands/query; "
+                "traffic = qps x that"
+            ),
+        },
     }
     result.update(kernel_fields)
+    save_part("kernel", {**kernel_fields, "platform": result["platform"]})
     # the kernel microbench is the headline only when the full-path
     # north-star config didn't produce one
     if not (tall and tall.get("topn_qps")):
@@ -424,8 +557,7 @@ def _guarded_main():
     # the whole run in an outer `timeout` that would kill us mid-write.
     budget_s = _env_float("PILOSA_BENCH_TIMEOUT", 520)
     deadline = _time.monotonic() + budget_s
-    probe_timeout = _env_float("PILOSA_BENCH_PROBE_TIMEOUT", 75)
-    attempts = max(1, int(_env_float("PILOSA_BENCH_ATTEMPTS", 3)))
+    attempts = max(1, int(_env_float("PILOSA_BENCH_ATTEMPTS", 4)))
     me = os.path.abspath(__file__)
 
     def remaining(margin=10.0):
@@ -444,10 +576,17 @@ def _guarded_main():
         except subprocess.TimeoutExpired:
             return None
 
+    # Probes are short and ADAPTIVE (20s, 40s, 60s, ...): a healthy
+    # backend answers a tiny round-trip in a few seconds even with a
+    # cold init, so burning 75s per probe (the round-3 default) just
+    # starves the measurement budget when the tunnel is merely slow to
+    # come up. Backoff between attempts gives a wedged tunnel a chance
+    # to recover without spending the whole budget waiting.
+    probe_base = _env_float("PILOSA_BENCH_PROBE_TIMEOUT", 20)
     reason = "device probe never ran"
     alive = False
     for i in range(attempts):
-        t = min(probe_timeout, remaining())
+        t = min(probe_base * (i + 1), remaining())
         if t <= 5:
             reason = "budget exhausted before device answered"
             break
@@ -462,12 +601,18 @@ def _guarded_main():
         )
         print(f"attempt {i + 1}/{attempts}: {reason}", file=sys.stderr)
         if i + 1 < attempts and remaining() > 30:
-            _time.sleep(min(10 * (i + 1), 30))
+            _time.sleep(min(5 * (i + 1), 20))
 
     if alive and remaining() <= 60:
         alive = False
         reason = "device alive but budget too small to run the bench"
-    if alive:
+    # The bench child gets up to TWO attempts: sub-results checkpoint
+    # to .bench_cache/bench_parts.json as they complete, so a child
+    # that dies mid-run (tunnel wedge) is resumed by the next attempt
+    # reusing every fresh same-revision part instead of starting over.
+    child_tries = 0
+    while alive and child_tries < 2 and remaining() > 60:
+        child_tries += 1
         child_timeout = remaining()
         proc = run_child(
             {
@@ -478,31 +623,81 @@ def _guarded_main():
         )
         if proc is None:
             reason = f"bench child timed out after {child_timeout:.0f}s"
-        elif proc.returncode != 0:
+            continue
+        if proc.returncode != 0:
             reason = f"bench child exited {proc.returncode}"
-        else:
-            obj = _extract_json_line(proc.stdout)
-            if obj is None:
-                reason = "bench child produced no JSON line"
-            else:
-                if obj.get("platform") == "tpu" and not obj.get("partial"):
-                    # a deadline-cut partial must never shadow the last
-                    # COMPLETE real-device measurement
-                    # Only a real-device result is worth replaying later;
-                    # a CPU smoke run must not masquerade as the TPU number.
-                    # Write-then-rename so a killed writer can't truncate
-                    # the previous good file.
-                    try:
-                        tmp = LAST_GOOD + ".tmp"
-                        with open(tmp, "w") as f:
-                            json.dump(obj, f)
-                            f.write("\n")
-                        os.replace(tmp, LAST_GOOD)
-                    except OSError as e:
-                        print(f"could not persist last-good: {e}", file=sys.stderr)
-                print(json.dumps(obj))
-                return
+            continue
+        obj = _extract_json_line(proc.stdout)
+        if obj is None:
+            reason = "bench child produced no JSON line"
+            continue
+        if obj.get("platform") == "tpu" and not obj.get("partial"):
+            # a deadline-cut partial must never shadow the last
+            # COMPLETE real-device measurement. Only a real-device
+            # result is worth replaying later; a CPU smoke run must
+            # not masquerade as the TPU number. Write-then-rename so
+            # a killed writer can't truncate the previous good file.
+            try:
+                tmp = LAST_GOOD + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(obj, f)
+                    f.write("\n")
+                os.replace(tmp, LAST_GOOD)
+            except OSError as e:
+                print(f"could not persist last-good: {e}", file=sys.stderr)
+        print(json.dumps(obj))
+        return
     print(reason, file=sys.stderr)
+
+    # Before replaying a PRIOR run: assemble from this revision's fresh
+    # checkpointed parts — numbers measured by THIS code minutes ago
+    # beat a stale replay.
+    tall_part = load_part("tall")
+    kern_part = load_part("kernel")
+    if not (tall_part and tall_part.get("topn_qps")) and kern_part and kern_part.get(
+        "kernel_qps"
+    ):
+        # no tall part, but a fresh same-revision kernel measurement
+        # still beats a prior revision's stale replay
+        out = {
+            "metric": "TopN queries/sec (kernel microbench, single chip)",
+            "value": kern_part["kernel_qps"],
+            "unit": "queries/s",
+            "vs_baseline": kern_part.get("kernel_vs_baseline"),
+            "p50_ms": kern_part.get("kernel_p50_ms"),
+            "platform": kern_part.get("platform"),
+            "assembled_from_checkpoints": True,
+            "error": f"final attempt failed ({reason}); kernel part is a "
+            "fresh same-revision measurement from this session",
+        }
+        out.update({k: v for k, v in kern_part.items() if k != "platform"})
+        print(json.dumps(out))
+        return
+    if tall_part and tall_part.get("topn_qps"):
+        out = {
+            "metric": (
+                f"TopN queries/sec (full path, "
+                f"{tall_part.get('build', {}).get('rows', 0):,} rows x "
+                f"{tall_part.get('shards')} shards, single chip)"
+            ),
+            "value": tall_part["topn_qps"],
+            "unit": "queries/s",
+            "vs_baseline": (
+                round(tall_part["topn_qps"] / tall_part["cpu_topn_qps"], 2)
+                if tall_part.get("cpu_topn_qps")
+                else None
+            ),
+            "platform": tall_part.get("platform"),
+            "tall": tall_part,
+            "p50_ms": tall_part.get("topn_p50_ms"),
+            "assembled_from_checkpoints": True,
+            "error": f"final attempt failed ({reason}); parts are fresh "
+            "same-revision measurements from this session",
+        }
+        if kern_part:
+            out.update({k: v for k, v in kern_part.items() if k != "platform"})
+        print(json.dumps(out))
+        return
 
     # Fallback: replay the last good measurement, marked stale.
     try:

@@ -254,20 +254,23 @@ def bench_cluster(tmp, scale):
     for s in socks:
         s.close()
     hosts = [f"127.0.0.1:{p}" for p in ports]
-    servers = []
-    for i, p in enumerate(ports):
-        cfg = Config(
-            data_dir=os.path.join(tmp, f"cnode{i}"),
-            bind=hosts[i],
-            device_policy="auto",
-            metric="none",
-            cluster=ClusterConfig(
-                disabled=False, coordinator=(i == 0), replicas=1, hosts=hosts
-            ),
-        )
-        sv = Server(cfg)
-        sv.open()
-        servers.append(sv)
+
+    def boot(policy):
+        servers = []
+        for i, p in enumerate(ports):
+            cfg = Config(
+                data_dir=os.path.join(tmp, f"cnode{i}"),
+                bind=hosts[i],
+                device_policy=policy,
+                metric="none",
+                cluster=ClusterConfig(
+                    disabled=False, coordinator=(i == 0), replicas=1, hosts=hosts
+                ),
+            )
+            sv = Server(cfg)
+            sv.open()
+            servers.append(sv)
+        return servers
 
     def req(path, body):
         conn = http.client.HTTPConnection("127.0.0.1", ports[0], timeout=60)
@@ -277,6 +280,16 @@ def bench_cluster(tmp, scale):
         conn.close()
         return json.loads(out)
 
+    queries = []
+    for r in range(8):
+        queries += [
+            f"Count(Row(f={r}))",
+            "TopN(f, n=4)",
+            f"Count(Intersect(Row(f={r}), Row(f={(r + 1) % 8})))",
+        ]
+
+    # pass 1: CPU-path cluster — build the data, measure the oracle
+    servers = boot("never")
     try:
         req("/index/c", b"")
         req("/index/c/field/f", b"")
@@ -291,22 +304,38 @@ def bench_cluster(tmp, scale):
                 )
         for i in range(0, len(sets), 500):
             req("/index/c/query", " ".join(sets[i : i + 500]).encode())
-
-        queries = []
-        for r in range(8):
-            queries += [
-                f"Count(Row(f={r}))",
-                "TopN(f, n=4)",
-                f"Count(Intersect(Row(f={r}), Row(f={(r + 1) % 8})))",
-            ]
-        results, qps, p50 = _run_queries(
+        # freshen the rank caches before measuring: TopN right after a
+        # bulk write serves the debounced (stale-ordered) cache — the
+        # reference behaves the same, and ships this endpoint for
+        # exactly this (handler.go /recalculate-caches). Pass 2 reopens
+        # the dirs (restore = recount), so without this the two passes
+        # would diverge on cache freshness, not on compute path.
+        req("/recalculate-caches", b"")
+        cpu_results, cpu_qps, cpu_p50 = _run_queries(
             lambda q: req("/index/c/query", q.encode()), queries, warm=True
         )
-        ok = all("error" not in r for r in results)
-        return _report("cluster_3node", len(queries), qps, qps, p50, ok)
     finally:
         for sv in servers:
             sv.close()
+
+    # pass 2: SAME data dirs rebooted with the device path forced —
+    # the round-3 gauntlet reported one number for both columns
+    # (speedup: 1.0, a tautology); this measures the question it
+    # dodged: does the device help on the cluster HTTP path?
+    servers = boot("always")
+    try:
+        dev_results, dev_qps, dev_p50 = _run_queries(
+            lambda q: req("/index/c/query", q.encode()), queries, warm=True
+        )
+    finally:
+        for sv in servers:
+            sv.close()
+    ok = (
+        all("error" not in r for r in cpu_results)
+        and all("error" not in r for r in dev_results)
+        and [_canon(r) for r in cpu_results] == [_canon(r) for r in dev_results]
+    )
+    return _report("cluster_3node", len(queries), dev_qps, cpu_qps, dev_p50, ok)
 
 
 def bench_spmd(tmp, scale):

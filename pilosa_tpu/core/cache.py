@@ -288,6 +288,11 @@ def _decode_packed_varints(payload: bytes) -> list[int]:
     starts[0] = 0
     starts[1:] = ends[:-1] + 1
     lens = ends - starts + 1
+    if int(lens.max()) > 10:
+        # a u64 uvarint is at most 10 bytes; longer means corruption —
+        # numpy's >=64-bit shifts would silently decode it to garbage
+        # where the scalar reader raised (callers rebuild the cache)
+        raise ValueError("cache file: varint too long")
     vals = np.zeros(ends.size, dtype=np.uint64)
     for j in range(int(lens.max())):
         take = lens > j

@@ -95,11 +95,13 @@ class _NotDeviceable(Exception):
 
 def _make_stacked_scorer() -> BatchedScorer:
     """Coalescing scorer for the cross-shard stacked-sparse TopN path.
-    max_batch=8 bounds the lax.map sweep; num_rows rides in the staged
-    tuple. A factory because the device health gate rebuilds it on
-    restore (its dispatch locks may be held by abandoned workers)."""
+    max_batch bounds the lax.map sweep (default 8; PILOSA_STACKED_MAX_BATCH
+    raises it for high-concurrency serving — c32/c64 clients coalesce
+    into wider launches); num_rows rides in the staged tuple. A factory
+    because the device health gate rebuilds it on restore (its dispatch
+    locks may be held by abandoned workers)."""
     return BatchedScorer(
-        max_batch=8,
+        max_batch=int(os.environ.get("PILOSA_STACKED_MAX_BATCH", 8)),
         single_fn=lambda src, st: ops.sparse_intersection_counts_stacked(src, *st),
         batch_fn=lambda srcs, st: ops.sparse_intersection_counts_stacked_batch(
             srcs, *st

@@ -383,6 +383,28 @@ class Cluster:
             }
         )
 
+    def pull_node_status(self) -> None:
+        """Startup state PULL: fetch each live peer's schema + max
+        shards directly (the other half of memberlist's join-time
+        push/pull). A node restarted LAST would otherwise have pushed
+        its state but received nobody's — its peers pushed while it was
+        down — and serve local-shards-only answers until the periodic
+        exchange."""
+        if self.server is None:
+            return
+        holder = self.server.holder
+        for n in self._other_nodes():
+            try:
+                schema = self._probe_client.schema(n.uri)
+                if schema:
+                    holder.apply_schema(schema)
+                for name, m in (self._probe_client.max_shards(n.uri) or {}).items():
+                    idx = holder.index(name)
+                    if idx is not None:
+                        idx.set_remote_max_shard(int(m))
+            except (ClientError, OSError):
+                continue  # peer down: its push will heal us when it boots
+
     def _apply_node_status(self, msg: dict) -> None:
         self._apply_remote_holder_state(msg)
         # traffic from a node is liveness evidence

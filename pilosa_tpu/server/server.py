@@ -139,7 +139,8 @@ class Server:
         self.node_id: str = ""
         self._closed = threading.Event()
         # memoized translate-primary resolution (see translate_primary)
-        self._translate_primary_cache: Optional[str] = None
+        # (value, monotonic-expiry-or-None); see translate_primary
+        self._translate_primary_cache: Optional[tuple] = None
 
     def _build_mesh(self):
         """Resolve config.mesh_devices into a jax Mesh over the shard
@@ -292,15 +293,23 @@ class Server:
 
         The answer is MEMOIZED after the listener is bound: resolution
         can consult DNS (``_is_self``), and re-resolving on every
-        forwarded mint would put blocking getaddrinfo calls — and
-        resolver blips turning into spurious 409s — on the keyed-write
-        hot path."""
+        forwarded mint would put blocking getaddrinfo calls on the
+        keyed-write hot path. The SELF answer ("") is final; a
+        NON-empty answer is cached with a TTL, because it may be the
+        product of a transient resolver failure at boot (containers) —
+        pinning it forever would leave the true primary 409ing every
+        keyed write until restart."""
         cached = self._translate_primary_cache
         if cached is not None:
-            return cached
+            value, expires = cached
+            if expires is None or time.monotonic() < expires:
+                return value
         out = self._resolve_translate_primary()
-        if self.httpd is not None:  # port known → answer is final
-            self._translate_primary_cache = out
+        if self.httpd is not None:  # port known → answer is cacheable
+            self._translate_primary_cache = (
+                out,
+                None if out == "" else time.monotonic() + 60.0,
+            )
         return out
 
     def _resolve_translate_primary(self) -> str:
@@ -317,7 +326,11 @@ class Server:
         if cc.coordinator:
             return ""
         if cc.coordinator_host:
-            return self._normalize_host_uri(cc.coordinator_host)
+            p = self._normalize_host_uri(cc.coordinator_host)
+            # same self-detection as the other branches: a node whose
+            # coordinator_host names ITSELF under an alternate spelling
+            # must not forward-and-409 its own keyed writes
+            return "" if self._is_self(p) else p
         return ""
 
     def _wire_translate_primary(self) -> None:
@@ -452,6 +465,19 @@ class Server:
             interval = self.config.cluster.status_interval
             if interval <= 0:
                 return
+            # push IMMEDIATELY at startup, not only on the interval:
+            # memberlist does a full state sync at join, so a reference
+            # node knows its peers' maxShards the moment it's up. A
+            # restarted node here otherwise serves queries that cover
+            # only its LOCAL shards for up to a full interval (observed:
+            # cluster TopN counts collapsed to one shard's worth right
+            # after a rolling restart).
+            try:
+                if self.cluster is not None and len(self.cluster.nodes) > 1:
+                    self.cluster.push_node_status()
+                    self.cluster.pull_node_status()
+            except Exception as e:
+                self.logger.printf("node-status push error: %s", e)
             while not self._closed.wait(interval):
                 try:
                     if self.cluster is not None and len(self.cluster.nodes) > 1:

@@ -63,9 +63,21 @@ class AttrStore:
         try:
             with open(self.path, "rb") as f:
                 head = f.read(16)
+                f.seek(0)
+                first_line = f.readline(1 << 20)
         except FileNotFoundError:
             return
         if not head or head == _SQLITE_MAGIC:
+            return
+        # only migrate what provably IS a round-3 JSONL attr log: the
+        # first line must parse as a {"id", "attrs"} record. Anything
+        # else is left untouched (sqlite will then fail loudly on it)
+        # rather than destructively replaced with an empty database.
+        try:
+            rec = json.loads(first_line.decode())
+            if not (isinstance(rec, dict) and "id" in rec and "attrs" in rec):
+                return
+        except (ValueError, UnicodeDecodeError):
             return
         merged: dict[int, dict] = {}
         with open(self.path) as src:
@@ -75,10 +87,12 @@ class AttrStore:
                     continue
                 try:
                     entry = json.loads(line)
-                except ValueError:
-                    continue
-                cur = merged.setdefault(int(entry["id"]), {})
-                for k, v in entry["attrs"].items():
+                    id_ = int(entry["id"])
+                    attrs = entry["attrs"]
+                except (ValueError, KeyError, TypeError):
+                    continue  # skip torn/malformed records
+                cur = merged.setdefault(id_, {})
+                for k, v in attrs.items():
                     if v is None:
                         cur.pop(k, None)
                     else:

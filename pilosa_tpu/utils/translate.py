@@ -49,16 +49,10 @@ _EMPTY = np.uint64(0)
 from pilosa_tpu.utils.protometa import _write_varint as _uvarint  # noqa: E402
 
 
-def _read_uvarint(data: bytes, i: int) -> tuple[int, int]:
-    shift = 0
-    out = 0
-    while True:
-        b = data[i]
-        i += 1
-        out |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return out, i
-        shift += 7
+# one uvarint reader for the whole codebase: protometa's, which raises
+# ValueError on truncation AND on overlong input (>10 bytes) — corrupt
+# WAL bytes become catchable errors, never an IndexError 500
+from pilosa_tpu.utils.protometa import _read_varint as _read_uvarint  # noqa: E402
 
 
 def _hash_key(key: bytes) -> int:
@@ -336,8 +330,10 @@ class TranslateStore:
         Raises ValueError on a structurally corrupt complete entry."""
         try:
             length, i = _read_uvarint(data, at)
-        except IndexError:
-            return None
+        except ValueError as e:
+            if "truncated" in str(e):
+                return None  # incomplete: wait for more bytes
+            raise  # overlong varint: corrupt entry
         end = i + length
         if end > len(data):
             return None
@@ -549,16 +545,22 @@ class TranslateStore:
         os.replace(tmp, self.path)
 
     def close(self) -> None:
-        if self._log:
-            try:
-                self._save_checkpoint()
-            except OSError:
-                pass  # WAL remains the source of truth
-            self._log.close()
-            self._log = None
-        if self._read_fd is not None:
-            os.close(self._read_fd)
-            self._read_fd = None
+        # under the lock: a concurrent writer (replication loop,
+        # in-flight mint) mutating the tables while np.savez serializes
+        # them would produce a checkpoint that passes validation but is
+        # internally inconsistent — silently losing mappings on the
+        # next open
+        with self.mu:
+            if self._log:
+                try:
+                    self._save_checkpoint()
+                except OSError:
+                    pass  # WAL remains the source of truth
+                self._log.close()
+                self._log = None
+            if self._read_fd is not None:
+                os.close(self._read_fd)
+                self._read_fd = None
 
     # -- translate -------------------------------------------------------
 

@@ -41,8 +41,8 @@ def req(uri, method, path, body=None, raw=False):
         return e.code, payload if raw else json.loads(payload or b"{}")
 
 
-def boot_static_cluster(tmp_path, n=3, replicas=1, **cluster_kw):
-    ports = free_ports(n)
+def boot_static_cluster(tmp_path, n=3, replicas=1, ports=None, **cluster_kw):
+    ports = ports or free_ports(n)
     hosts = [f"127.0.0.1:{p}" for p in ports]
     servers = []
     for i, p in enumerate(ports):
@@ -1390,4 +1390,37 @@ class TestIndirectProbing:
             assert n2.state == "DOWN", n2.state
         finally:
             for s in servers[:2]:
+                s.close()
+
+
+class TestRestartStateSync:
+    """A restarted cluster must answer cross-shard queries correctly
+    IMMEDIATELY — node-status push/pull runs at startup (memberlist
+    join-time state sync), not only on the periodic interval.
+    Regression: counts collapsed to one node's local shards right
+    after a full restart (caught by the round-4 gauntlet)."""
+
+    def test_full_restart_serves_all_shards_immediately(self, tmp_path):
+        ports = free_ports(3)  # SAME ring across the restart
+        servers = boot_static_cluster(tmp_path, n=3, ports=ports)
+        try:
+            s0 = servers[0]
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            cols = [s * SHARD_WIDTH + 5 for s in range(6)]
+            for c in cols:
+                req(s0.uri, "POST", "/index/i/query", f"Set({c}, f=1)".encode())
+            st, body = req(s0.uri, "POST", "/index/i/query", b"Count(Row(f=1))")
+            assert body["results"][0] == 6
+        finally:
+            for s in servers:
+                s.close()
+        # full rolling restart over the same data dirs; query at once
+        servers = boot_static_cluster(tmp_path, n=3, ports=ports)
+        try:
+            for s in servers:
+                st, body = req(s.uri, "POST", "/index/i/query", b"Count(Row(f=1))")
+                assert st == 200 and body["results"][0] == 6, (s.uri, body)
+        finally:
+            for s in servers:
                 s.close()
