@@ -1565,6 +1565,7 @@ class _StackedLazyScores:
         # pass-1 chunk covered
         self._shards = list(shards) if shards is not None else list(range(len(frags)))
         self._carry = carry
+        self._prefetching = False  # one prefetch in flight at a time
         if carry:
             for i, s in enumerate(self._shards):
                 seed = {
@@ -1584,6 +1585,17 @@ class _StackedLazyScores:
         staged = self._ex.stager.sparse_rows_stacked(
             self._frags, ids_by_shard, size
         )
+        # overlap: while this chunk's kernel runs + fetches, pre-stage
+        # the NEXT chunk on a side thread (the stager memoizes by
+        # content key, so the walk's next _score_next finds it hot).
+        # Deep walks thus pipeline host packing with device compute
+        # instead of alternating them serially. NOT from the head
+        # chunk (lo == 0): most walks prune inside it on skewed data —
+        # eagerly staging the 4096-candidate chunk behind it would
+        # re-introduce exactly the cold-staging cost the small head
+        # chunk was measured to avoid (class docstring).
+        if lo > 0 and hi < self._max_len:
+            self._prefetch(hi)
         if staged is None:  # no shard contributed blocks — all score 0
             for i, ids in enumerate(ids_by_shard):
                 self._scores[i].update((rid, 0) for rid in ids)
@@ -1605,6 +1617,29 @@ class _StackedLazyScores:
                 (rid, int(scores[base + j])) for j, rid in enumerate(ids)
             )
         self._publish(ids_by_shard)
+
+    def _prefetch(self, lo: int) -> None:
+        if self._prefetching:
+            return
+        self._prefetching = True
+        size = _chunk_size(lo)
+        ids_by_shard = tuple(
+            _chunk_ids(ps, lo, lo + size) for ps in self._pairs
+        )
+
+        def warm():
+            try:
+                self._ex.stager.sparse_rows_stacked(
+                    self._frags, ids_by_shard, size
+                )
+            except Exception:
+                pass  # purely advisory; the real call surfaces errors
+            finally:
+                self._prefetching = False
+
+        threading.Thread(
+            target=warm, name="stage-prefetch", daemon=True
+        ).start()
 
     def _publish(self, ids_by_shard) -> None:
         if self._carry is None:
