@@ -115,3 +115,67 @@ void pt_popcount_per_block(const uint64_t* words, size_t n_blocks,
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// Expand selected containers from a parsed roaring file buffer into dense
+// 1024-word blocks — the block-sparse staging pack's hot loop
+// (fragment.sparse_row_blocks). Decoding straight from the mmapped file
+// replaces a Python-per-container decode (observed ~170 ms per cold
+// 4096-candidate chunk at the 1B scale).
+//
+//   buf      base of the parsed file (mmap)
+//   metas    packed 12-byte entries at buf+8: key u64 | typ u16 | n-1 u16
+//   offsets  u32 payload offsets into buf, one per base container
+//   sel      indices into metas/offsets to expand
+//   out      nsel * 1024 u64 words, caller-zeroed
+//
+// Container types per the reference file format (roaring/roaring.go):
+// 1 = sorted u16 array, 2 = 1024-word bitmap, 3 = RLE (count u16, then
+// (start,last) u16 pairs, inclusive).
+void pt_expand_blocks(const uint8_t* buf, const uint8_t* metas,
+                      const uint32_t* offsets, const int64_t* sel,
+                      size_t nsel, uint64_t* out) {
+    constexpr size_t kWords = 1024;
+    for (size_t s = 0; s < nsel; s++) {
+        const int64_t i = sel[s];
+        uint64_t* dst = out + s * kWords;
+        const uint8_t* m = metas + 12 * static_cast<size_t>(i);
+        uint16_t typ, nm1;
+        __builtin_memcpy(&typ, m + 8, 2);
+        __builtin_memcpy(&nm1, m + 10, 2);
+        const uint32_t n = static_cast<uint32_t>(nm1) + 1;
+        const uint8_t* p = buf + offsets[i];
+        if (typ == 2) {  // bitmap: straight copy
+            __builtin_memcpy(dst, p, kWords * 8);
+        } else if (typ == 1) {  // array: scatter bits
+            for (uint32_t k = 0; k < n; k++) {
+                uint16_t v;
+                __builtin_memcpy(&v, p + 2 * k, 2);
+                dst[v >> 6] |= 1ULL << (v & 63);
+            }
+        } else if (typ == 3) {  // run: word-filled inclusive ranges
+            uint16_t rc;
+            __builtin_memcpy(&rc, p, 2);
+            const uint8_t* rp = p + 2;
+            for (uint32_t r = 0; r < rc; r++) {
+                uint16_t start, last;
+                __builtin_memcpy(&start, rp + 4 * r, 2);
+                __builtin_memcpy(&last, rp + 4 * r + 2, 2);
+                uint32_t w0 = start >> 6, w1 = last >> 6;
+                const uint64_t ones = ~0ULL;
+                const uint64_t head = ones << (start & 63);
+                const uint64_t tail = ones >> (63 - (last & 63));
+                if (w0 == w1) {
+                    dst[w0] |= head & tail;
+                } else {
+                    dst[w0] |= head;
+                    for (uint32_t w = w0 + 1; w < w1; w++) dst[w] = ones;
+                    dst[w1] |= tail;
+                }
+            }
+        }
+    }
+}
+
+}  // extern "C"

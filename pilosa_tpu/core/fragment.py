@@ -800,10 +800,21 @@ class Fragment:
         sel_keys = keys[key_idx]
         block_slot = (sel_keys.astype(np.int64) % per).astype(np.int32)
         store = self.storage.containers
-        for j, k in enumerate(sel_keys):
-            c = store.get(int(k))
-            if c is not None and c.n:
-                blocks[j] = c.words()
+        # fast path: for a PURE mmap store the occupancy indices ARE
+        # base indices, and the native kernel expands every selected
+        # container straight from the map into `blocks` — no Python
+        # iteration per container (the staging pack's hot loop). The
+        # snapshot length rides along so a stale occupancy snapshot
+        # (taken mid-mutation by this lockless reader) can never feed
+        # shifted indices to the native decode.
+        if not (
+            hasattr(store, "expand_base_blocks")
+            and store.expand_base_blocks(key_idx, blocks, snapshot_len=keys.size)
+        ):
+            for j, k in enumerate(sel_keys):
+                c = store.get(int(k))
+                if c is not None and c.n:
+                    blocks[j] = c.words()
         return blocks, block_row, block_slot
 
     def bsi_planes(self, bit_depth: int) -> np.ndarray:

@@ -48,16 +48,46 @@ def _build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    """The .so predates the source — a prebuilt library from an older
+    checkout would be missing newer symbols."""
+    try:
+        src = os.path.getmtime(os.path.join(_NATIVE_DIR, "bitmap_kernels.cpp"))
+        so = os.path.getmtime(_SO_PATH)
+        return src > so
+    except OSError:
+        return False
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_SO_PATH) and not _build():
-        return None
+    if (not os.path.exists(_SO_PATH) or _stale()) and not _build():
+        if not os.path.exists(_SO_PATH):
+            return None
     try:
         lib = ctypes.CDLL(_SO_PATH)
     except OSError:
         return None
+    try:
+        _bind(lib)
+    except AttributeError:
+        # stale prebuilt .so missing a newer symbol (e.g. built before
+        # the mtime check existed): rebuild once, then degrade to numpy
+        # rather than crash — the module contract
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+            _bind(lib)
+        except (OSError, AttributeError):
+            return None
+    _lib = lib
+    return lib
+
+
+def _bind(lib: ctypes.CDLL) -> None:
     u64p = ctypes.POINTER(ctypes.c_uint64)
     u16p = ctypes.POINTER(ctypes.c_uint16)
     i64p = ctypes.POINTER(ctypes.c_int64)
@@ -85,8 +115,15 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.pt_popcount_per_block.argtypes = [
         u64p, ctypes.c_size_t, ctypes.c_size_t, i64p,
     ]
-    _lib = lib
-    return lib
+    lib.pt_expand_blocks.restype = None
+    lib.pt_expand_blocks.argtypes = [
+        ctypes.c_void_p,  # buf base
+        ctypes.c_void_p,  # metas base
+        ctypes.POINTER(ctypes.c_uint32),
+        i64p,
+        ctypes.c_size_t,
+        u64p,
+    ]
 
 
 def available() -> bool:
@@ -168,3 +205,30 @@ def popcount_per_block(words: np.ndarray, words_per_block: int) -> np.ndarray:
     out = np.empty(n_blocks, dtype=np.int64)
     lib.pt_popcount_per_block(_u64p(words), n_blocks, words_per_block, _i64p(out))
     return out
+
+
+def expand_blocks(
+    buf_addr: int,
+    metas_addr: int,
+    offsets: np.ndarray,
+    sel: np.ndarray,
+    out: np.ndarray,
+) -> bool:
+    """Expand selected base containers (by index) into dense 1024-word
+    blocks, decoding straight from the mmapped file. ``out`` must be a
+    caller-zeroed C-contiguous u64[len(sel), 1024]. Returns False when
+    the native library is unavailable (caller takes the Python path)."""
+    lib = _load()
+    if lib is None:
+        return False
+    sel = np.ascontiguousarray(sel, dtype=np.int64)
+    offsets = np.ascontiguousarray(offsets, dtype=np.uint32)
+    lib.pt_expand_blocks(
+        ctypes.c_void_p(buf_addr),
+        ctypes.c_void_p(metas_addr),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        _i64p(sel),
+        sel.size,
+        _u64p(out),
+    )
+    return True

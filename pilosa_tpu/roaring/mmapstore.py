@@ -488,6 +488,34 @@ class MmapContainers:
                 p, keys, cs, self._base_n, self.ops_offset, roaring_path=self.path
             )
 
+    def expand_base_blocks(
+        self, sel: np.ndarray, out: np.ndarray, snapshot_len: Optional[int] = None
+    ) -> bool:
+        """Expand base containers (by BASE index) into dense 1024-word
+        blocks via the native kernel, decoding straight from the mmap —
+        the staging pack's hot loop without a Python iteration per
+        container. Only valid for a PURE store (no overlay/tombstones)
+        whose occupancy indices equal base indices; callers that
+        computed ``sel`` against an occupancy SNAPSHOT must pass that
+        snapshot's length — a snapshot taken while an overlay key
+        existed has a different length than the base, and using its
+        indices against the base would stage wrong containers (or read
+        past the offsets array into the C++ kernel). Returns False when
+        impure, stale, out of bounds, or the native library is absent
+        (caller falls back to the per-container Python decode)."""
+        if self.overlay or self._deleted or self._base_n == 0:
+            return False
+        if snapshot_len is not None and snapshot_len != self._base_n:
+            return False  # sel indexes a different (stale) key universe
+        if sel.size and (int(sel.max()) >= self._base_n or int(sel.min()) < 0):
+            return False
+        from pilosa_tpu import native_bridge
+
+        head = np.frombuffer(self.buf, dtype=np.uint8, count=1)
+        return native_bridge.expand_blocks(
+            head.ctypes.data, self.metas.ctypes.data, self.offsets, sel, out
+        )
+
     def max_key(self) -> Optional[int]:
         best = max(self.overlay) if self.overlay else None
         i = self._base_n - 1

@@ -52,3 +52,64 @@ def test_popcount_per_block():
     want = np.bitwise_count(w.reshape(16, 128)).sum(axis=1)
     got = native_bridge.popcount_per_block(w, 128)
     assert np.array_equal(got, want)
+
+
+class TestExpandBlocks:
+    """Native mmap-direct container expansion (staging pack hot loop):
+    must match the per-container Python decode bit for bit across all
+    three container forms."""
+
+    def test_matches_python_decode(self, tmp_path):
+        import numpy as np
+
+        from pilosa_tpu.roaring import Bitmap
+        from pilosa_tpu import native_bridge
+
+        if not native_bridge.available():
+            import pytest
+
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(21)
+        b = Bitmap()
+        # array containers
+        for c in range(6):
+            vals = np.unique(rng.integers(0, 1 << 16, size=500, dtype=np.uint64))
+            b.merge_positions(add=np.uint64(c << 16) + vals)
+        # a dense bitmap container and run containers
+        b.merge_positions(add=np.uint64(10 << 16) + np.arange(40000, dtype=np.uint64))
+        b.merge_positions(add=np.uint64(12 << 16) + np.arange(300, dtype=np.uint64))
+        b.merge_positions(
+            add=np.uint64(12 << 16) + np.arange(1000, 1500, dtype=np.uint64)
+        )
+        b.optimize()
+        p = str(tmp_path / "frag")
+        with open(p, "wb") as f:
+            b.write_to(f)
+        lazy = Bitmap.open_mmap_file(p)
+        store = lazy.containers
+        n = store._base_n
+        assert n >= 8
+        sel = np.arange(n, dtype=np.int64)
+        out = np.zeros((n, 1024), dtype=np.uint64)
+        assert store.expand_base_blocks(sel, out)
+        for j in range(n):
+            k = int(store.metas["key"][j])
+            want = store.get(k).words()
+            assert np.array_equal(out[j], want), f"container {k}"
+
+    def test_impure_store_declines(self, tmp_path):
+        import numpy as np
+
+        from pilosa_tpu.roaring import Bitmap
+
+        b = Bitmap()
+        b.merge_positions(add=np.arange(100, dtype=np.uint64))
+        p = str(tmp_path / "frag")
+        with open(p, "wb") as f:
+            b.write_to(f)
+        lazy = Bitmap.open_mmap_file(p)
+        lazy.add_no_oplog(5 << 16)  # overlay → indices no longer base
+        out = np.zeros((1, 1024), dtype=np.uint64)
+        assert not lazy.containers.expand_base_blocks(
+            np.zeros(1, dtype=np.int64), out
+        )
