@@ -424,6 +424,53 @@ def bench_spmd(tmp, scale):
     return _report("spmd_mesh_http", len(queries), dev_qps, cpu_qps, p50, ok)
 
 
+def bench_keyed(tmp, scale):
+    """Keyed-index path: string column/row keys through the FULL stack
+    (translate store mint/lookup around every query), exercising the
+    binary-WAL + numpy-hash-table TranslateStore at gauntlet scale —
+    the round-4 memory-scalable store must not slow the serving path.
+    Bit-identity compares device vs CPU policies over the same holder."""
+    import numpy as np
+
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.utils.translate import TranslateStore
+
+    h = Holder(os.path.join(tmp, "keyed"))
+    from pilosa_tpu.core.field import FieldOptions
+
+    idx = h.create_index("k", keys=True)
+    idx.create_field("likes", FieldOptions(keys=True))
+    ts = TranslateStore(os.path.join(tmp, "keyed", ".keys"))
+    cpu = Executor(h, device_policy="never", translate_store=ts)
+    dev = Executor(h, device_policy="always", translate_store=ts)
+    rng = np.random.default_rng(13)
+    users = [f"user-{i:06d}" for i in range(2000 * scale)]
+    topics = [f"topic-{i}" for i in range(16)]
+    writes = []
+    for u in users:
+        t = topics[int(rng.integers(0, len(topics)))]
+        writes.append(f'Set("{u}", likes="{t}")')
+    for i in range(0, len(writes), 500):
+        cpu.execute("k", " ".join(writes[i : i + 500]))
+    queries = [f'Count(Row(likes="{t}"))' for t in topics]
+    queries += [f'Row(likes="{t}")' for t in topics[:4]]
+    queries += ["TopN(likes, n=5)"]
+    cpu_results, cpu_qps, _ = _run_queries(
+        lambda q: cpu.execute("k", q), queries, warm=True
+    )
+    dev_results, dev_qps, p50 = _run_queries(
+        lambda q: dev.execute("k", q), queries, warm=True
+    )
+    ok = [_canon(r) for r in cpu_results] == [_canon(r) for r in dev_results]
+    # every written key must resolve — the whole universe, not a token
+    resolved = ts.translate_columns_to_ids("k", users, create=False)
+    ok = ok and None not in resolved and len(set(resolved)) == len(users)
+    ts.close()
+    h.close()
+    return _report("keyed_translate", len(queries), dev_qps, cpu_qps, p50, ok)
+
+
 def bench_tall_scaled(tmp, scale):
     """Config 4's true shape (tall singleton rows + hot rows, mmap
     store, block-sparse staging) at gauntlet scale: 4 shards x 200k
@@ -474,6 +521,7 @@ def main():
             bench_synthetic,
             bench_cluster,
             bench_spmd,
+            bench_keyed,
             bench_tall_scaled,
         ):
             try:
