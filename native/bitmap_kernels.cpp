@@ -133,9 +133,13 @@ extern "C" {
 // Container types per the reference file format (roaring/roaring.go):
 // 1 = sorted u16 array, 2 = 1024-word bitmap, 3 = RLE (count u16, then
 // (start,last) u16 pairs, inclusive).
-void pt_expand_blocks(const uint8_t* buf, const uint8_t* metas,
-                      const uint32_t* offsets, const int64_t* sel,
-                      size_t nsel, uint64_t* out) {
+// Returns 0 on success, 1 when any selected container's payload would
+// read past buf_len (truncated or corrupt file) or has an unknown type —
+// the caller falls back to the Python decode path, which surfaces the
+// corruption as a ValueError instead of a native out-of-bounds read.
+int pt_expand_blocks_v2(const uint8_t* buf, size_t buf_len,
+                        const uint8_t* metas, const uint32_t* offsets,
+                        const int64_t* sel, size_t nsel, uint64_t* out) {
     constexpr size_t kWords = 1024;
     for (size_t s = 0; s < nsel; s++) {
         const int64_t i = sel[s];
@@ -145,18 +149,25 @@ void pt_expand_blocks(const uint8_t* buf, const uint8_t* metas,
         __builtin_memcpy(&typ, m + 8, 2);
         __builtin_memcpy(&nm1, m + 10, 2);
         const uint32_t n = static_cast<uint32_t>(nm1) + 1;
-        const uint8_t* p = buf + offsets[i];
+        const size_t off = offsets[i];
+        if (off > buf_len) return 1;
+        const size_t avail = buf_len - off;
+        const uint8_t* p = buf + off;
         if (typ == 2) {  // bitmap: straight copy
+            if (avail < kWords * 8) return 1;
             __builtin_memcpy(dst, p, kWords * 8);
         } else if (typ == 1) {  // array: scatter bits
+            if (avail < 2 * static_cast<size_t>(n)) return 1;
             for (uint32_t k = 0; k < n; k++) {
                 uint16_t v;
                 __builtin_memcpy(&v, p + 2 * k, 2);
                 dst[v >> 6] |= 1ULL << (v & 63);
             }
         } else if (typ == 3) {  // run: word-filled inclusive ranges
+            if (avail < 2) return 1;
             uint16_t rc;
             __builtin_memcpy(&rc, p, 2);
+            if (avail < 2 + 4 * static_cast<size_t>(rc)) return 1;
             const uint8_t* rp = p + 2;
             for (uint32_t r = 0; r < rc; r++) {
                 uint16_t start, last;
@@ -174,8 +185,11 @@ void pt_expand_blocks(const uint8_t* buf, const uint8_t* metas,
                     dst[w1] |= tail;
                 }
             }
+        } else {
+            return 1;  // unknown container type
         }
     }
+    return 0;
 }
 
 }  // extern "C"

@@ -115,9 +115,10 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.pt_popcount_per_block.argtypes = [
         u64p, ctypes.c_size_t, ctypes.c_size_t, i64p,
     ]
-    lib.pt_expand_blocks.restype = None
-    lib.pt_expand_blocks.argtypes = [
+    lib.pt_expand_blocks_v2.restype = ctypes.c_int
+    lib.pt_expand_blocks_v2.argtypes = [
         ctypes.c_void_p,  # buf base
+        ctypes.c_size_t,  # buf length (bounds-checks file-provided offsets)
         ctypes.c_void_p,  # metas base
         ctypes.POINTER(ctypes.c_uint32),
         i64p,
@@ -209,6 +210,7 @@ def popcount_per_block(words: np.ndarray, words_per_block: int) -> np.ndarray:
 
 def expand_blocks(
     buf_addr: int,
+    buf_len: int,
     metas_addr: int,
     offsets: np.ndarray,
     sel: np.ndarray,
@@ -217,18 +219,24 @@ def expand_blocks(
     """Expand selected base containers (by index) into dense 1024-word
     blocks, decoding straight from the mmapped file. ``out`` must be a
     caller-zeroed C-contiguous u64[len(sel), 1024]. Returns False when
-    the native library is unavailable (caller takes the Python path)."""
+    the native library is unavailable OR the kernel detects a payload
+    running past ``buf_len`` (truncated/corrupt file) — either way the
+    caller takes the Python decode path, which raises a proper error."""
     lib = _load()
     if lib is None:
         return False
     sel = np.ascontiguousarray(sel, dtype=np.int64)
     offsets = np.ascontiguousarray(offsets, dtype=np.uint32)
-    lib.pt_expand_blocks(
+    rc = lib.pt_expand_blocks_v2(
         ctypes.c_void_p(buf_addr),
+        buf_len,
         ctypes.c_void_p(metas_addr),
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
         _i64p(sel),
         sel.size,
         _u64p(out),
     )
+    if rc != 0:
+        out[:] = 0  # discard any partial expansion
+        return False
     return True

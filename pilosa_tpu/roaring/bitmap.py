@@ -765,6 +765,13 @@ class Bitmap:
             return cls()
         with open(path, "rb") as f:
             mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+            # fstat of the fd the map came from: the file identity the
+            # mapped bytes actually belong to. A later snapshot that
+            # REPLACES the file cannot change this — which is what
+            # makes it the sound .occ sidecar stamp (occupancy() would
+            # otherwise stat the path at compute time and could stamp
+            # OLD-map occupancy with the NEW file's identity)
+            st = _os.fstat(f.fileno())
         b = cls.unmarshal_mmap(mm)
         # knowing the backing path enables the .occ occupancy sidecar
         # (mmapstore.occupancy) — first touch becomes a page-in
@@ -772,6 +779,7 @@ class Bitmap:
 
         if isinstance(b.containers, MmapContainers):
             b.containers.path = path
+            b.containers.open_stat = st
         return b
 
     @classmethod

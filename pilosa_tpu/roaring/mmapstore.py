@@ -81,6 +81,7 @@ class MmapContainers:
         "_kc_cache",
         "ops_offset",
         "path",
+        "open_stat",
     )
 
     def __init__(
@@ -97,6 +98,11 @@ class MmapContainers:
         # backing file path (set by the mmap open path); enables the
         # .occ occupancy sidecar
         self.path: Optional[str] = None
+        # fstat of the fd the mmap was created from (set by the mmap
+        # open path): the identity of the bytes this store actually
+        # reads — the sound sidecar stamp even when the file on disk
+        # is later replaced by a snapshot
+        self.open_stat = None
         # byte offset of the trailing op log = end of the serialized
         # snapshot region; an unmutated store serializes by copying
         # buf[:ops_offset] verbatim (see serialize_clean)
@@ -428,13 +434,22 @@ class MmapContainers:
             if got is not None:
                 self._kc_cache = got
                 return got
+        # stamp with the identity of the mmapped bytes (fstat captured
+        # when the map was established — mmapstore.open_stat): a
+        # snapshot replacing the file any time after open would
+        # otherwise let us stamp OLD-map occupancy with the NEW file's
+        # (size, mtime_ns) — exactly the staleness the stamp exists to
+        # catch (the balanced clear/set case where base_n/ops_offset
+        # coincide). write_occ_sidecar re-stats the path at save time
+        # and refuses when (size, mtime_ns, inode) differs.
+        st_before = getattr(self, "open_stat", None)
         keys, cs = occ_arrays(*self.keys_and_counts())
         # re-check purity AFTER computing: a writer racing this lockless
         # reader may have grown the overlay mid-pass, and persisting
         # overlay-inclusive counts as the "pure base" sidecar would
         # poison every future open of this fragment on disk
         if pure and not (self.overlay or self._deleted):
-            self._occ_sidecar_save(keys, cs)
+            self._occ_sidecar_save(keys, cs, stamp_stat=st_before)
         self._kc_cache = (keys, cs)
         return self._kc_cache
 
@@ -481,11 +496,19 @@ class MmapContainers:
         except (ValueError, IndexError):
             return None
 
-    def _occ_sidecar_save(self, keys: np.ndarray, cs: np.ndarray) -> None:
+    def _occ_sidecar_save(
+        self, keys: np.ndarray, cs: np.ndarray, stamp_stat=None
+    ) -> None:
         p = self._occ_path()
         if p:
             write_occ_sidecar(
-                p, keys, cs, self._base_n, self.ops_offset, roaring_path=self.path
+                p,
+                keys,
+                cs,
+                self._base_n,
+                self.ops_offset,
+                roaring_path=self.path,
+                stamp_stat=stamp_stat,
             )
 
     def expand_base_blocks(
@@ -513,7 +536,12 @@ class MmapContainers:
 
         head = np.frombuffer(self.buf, dtype=np.uint8, count=1)
         return native_bridge.expand_blocks(
-            head.ctypes.data, self.metas.ctypes.data, self.offsets, sel, out
+            head.ctypes.data,
+            len(self.buf),
+            self.metas.ctypes.data,
+            self.offsets,
+            sel,
+            out,
         )
 
     def max_key(self) -> Optional[int]:
@@ -599,11 +627,16 @@ def write_occ_sidecar(
     base_n: int,
     ops_offset: int,
     roaring_path: Optional[str] = None,
+    stamp_stat=None,
 ) -> None:
     """Atomically write a .occ occupancy sidecar (format documented on
     MmapContainers.occupancy), stamped with the roaring file's current
-    (size, mtime_ns). Failures are swallowed — the sidecar is a pure
-    accelerator; the roaring file stays the source of truth."""
+    (size, mtime_ns). When ``stamp_stat`` (the file's stat captured
+    BEFORE the occupancy was computed) is given, the save is refused if
+    the file's (size, mtime_ns, inode) has since changed — a snapshot
+    replacing the file mid-compute must not get old occupancy stamped
+    with its new identity. Failures are swallowed — the sidecar is a
+    pure accelerator; the roaring file stays the source of truth."""
     import os as _os
 
     if roaring_path is None:
@@ -611,6 +644,12 @@ def write_occ_sidecar(
     st = _os_stat(roaring_path)
     if st is None:
         return
+    if stamp_stat is not None and (
+        st.st_size != stamp_stat.st_size
+        or st.st_mtime_ns != stamp_stat.st_mtime_ns
+        or st.st_ino != stamp_stat.st_ino
+    ):
+        return  # file replaced since the occupancy was computed
     tmp = occ_path + ".tmp"
     try:
         with open(tmp, "wb") as f:

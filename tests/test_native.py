@@ -97,6 +97,43 @@ class TestExpandBlocks:
             want = store.get(k).words()
             assert np.array_equal(out[j], want), f"container {k}"
 
+    def test_truncated_file_declines_instead_of_oob(self, tmp_path):
+        """File-provided offsets are bounds-checked in the kernel: a
+        truncated fragment file must make expand_base_blocks return
+        False (Python decode then surfaces the corruption as an error)
+        rather than read past the mmap (SIGSEGV on the serving path)."""
+        import numpy as np
+
+        from pilosa_tpu.roaring import Bitmap
+        from pilosa_tpu import native_bridge
+
+        if not native_bridge.available():
+            import pytest
+
+            pytest.skip("native library unavailable")
+        b = Bitmap()
+        # a dense bitmap container (8 KiB payload, NOT optimize()d —
+        # arange would convert to a tiny run container and the payload
+        # would fit inside any truncation)
+        rng = np.random.default_rng(7)
+        b.merge_positions(
+            add=np.unique(rng.integers(0, 1 << 16, size=40000, dtype=np.uint64))
+        )
+        p = str(tmp_path / "frag")
+        with open(p, "wb") as f:
+            b.write_to(f)
+        lazy = Bitmap.open_mmap_file(p)
+        store = lazy.containers
+        # corrupt the offsets table the way a damaged file would: point
+        # the container payload within a page of the buffer end, so the
+        # 8 KiB bitmap payload would run past the mmap
+        store.offsets = store.offsets.copy()
+        store.offsets[:] = max(0, len(store.buf) - 16)
+        out = np.zeros((store._base_n, 1024), dtype=np.uint64)
+        sel = np.arange(store._base_n, dtype=np.int64)
+        assert not store.expand_base_blocks(sel, out)
+        assert not out.any()  # partial expansion discarded
+
     def test_impure_store_declines(self, tmp_path):
         import numpy as np
 
