@@ -23,6 +23,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Callable, Optional
 
 from pilosa_tpu.parallel.client import ClientError, InternalClient
@@ -134,6 +135,10 @@ class Cluster:
         # node; down_after failures → DOWN, any failure → SUSPECT
         self.down_after = down_after
         self._fail_counts: dict[str, int] = {}
+        # nodes with a DOWN-verification probe in flight (guarded by
+        # self.mu): a chatty unreachable peer must cost at most ONE
+        # blocked pool thread, not one per inbound message
+        self._verifying: set[str] = set()
         self.probe_timeout = probe_timeout
         self._probe_client = InternalClient(
             timeout=probe_timeout, ssl_context=ssl_context
@@ -224,6 +229,14 @@ class Cluster:
             by_id = {n.id: n for n in self.nodes}
             for n in saved:
                 if n.id not in by_id:
+                    # liveness is runtime evidence, not durable fact: a
+                    # DOWN/SUSPECT persisted before a restart says
+                    # nothing about the peer NOW (memberlist likewise
+                    # starts every member alive and lets probing
+                    # re-discover). Without this, a full-cluster
+                    # restart would boot with peers stuck DOWN — and
+                    # DOWN is only cleared by an active probe success.
+                    n.state = NODE_READY
                     self.nodes.append(n)
             self._sort_nodes()
             if adopt_params:
@@ -305,7 +318,10 @@ class Cluster:
         for f in futures:
             try:
                 f.result(timeout=max(0.1, deadline - time.monotonic()))
-            except TimeoutError:
+            except FuturesTimeoutError:
+                # concurrent.futures.TimeoutError only aliases the
+                # builtin on 3.11+; catching the futures class works on
+                # every supported Python
                 continue  # verdict lands via _note_probe when it finishes
 
     def _probe_via_peers(self, target: Node) -> bool:
@@ -341,13 +357,35 @@ class Cluster:
                 continue
         return False
 
-    def _note_probe(self, node: Node, alive: bool) -> None:
+    def _note_probe(self, node: Node, alive: bool, *, traffic: bool = False) -> None:
+        """Record liveness evidence. ``traffic`` marks passive evidence
+        (a message received from the node) as opposed to an active
+        direct/indirect probe verdict. Passive evidence can refresh a
+        READY/SUSPECT node but can NOT resurrect a DOWN one: a message
+        sent while the node was still alive may land after the prober
+        declared it DOWN (send/receive are not ordered with probe
+        sweeps), and flipping DOWN->READY on that stale evidence would
+        route queries to a dead node until the next sweep. Only a
+        successful probe — evidence the node answers NOW — clears DOWN
+        (memberlist similarly requires a live ack to refute death)."""
         with self.mu:
             # a concurrent ClusterStatus application rebuilds self.nodes
             # from dicts — re-resolve by id so the result lands on the
             # object the planner actually reads, not an orphaned ref
             node = next((n for n in self.nodes if n.id == node.id), node)
             if alive:
+                if traffic and node.state == NODE_DOWN:
+                    # verify off-thread instead: if the peer really is
+                    # back (e.g. it just restarted and pushed its
+                    # status), the probe success — active evidence —
+                    # clears DOWN within one round-trip. One in-flight
+                    # verification per node, or sustained traffic from
+                    # a dead-to-us peer would queue a pool task per
+                    # message and starve the probe sweeps.
+                    if node.id not in self._verifying:
+                        self._verifying.add(node.id)
+                        self._pool.submit(self._verify_down, node)
+                    return
                 changed = node.state != NODE_READY
                 node.state = NODE_READY
                 self._fail_counts.pop(node.id, None)
@@ -365,23 +403,54 @@ class Cluster:
             if self.is_coordinator:
                 threading.Thread(target=self._broadcast_status, daemon=True).start()
 
-    def push_node_status(self) -> None:
+    def _verify_down(self, node: Node) -> None:
+        """Direct probe of a DOWN node that just sent us traffic; a
+        success is the active evidence required to clear DOWN."""
+        try:
+            self._probe_client.status(node.uri)
+        except (ClientError, OSError):
+            return
+        finally:
+            with self.mu:
+                self._verifying.discard(node.id)
+        self._note_probe(node, True)
+
+    def push_node_status(self, sync: bool = False) -> None:
         """Periodic NodeStatus exchange: schema + maxShards to peers
         (the reference's gossip push/pull payload, server.go:602-630) so
-        schema and shard-count drift heals without waiting for a write."""
+        schema and shard-count drift heals without waiting for a write.
+        ``sync`` (boot-time join sync) fans the per-peer pushes out
+        through the pool and joins with a deadline: open() pays ~one
+        probe timeout total, not peers × timeout when several are
+        black-holed."""
         if self.server is None:
             return
         holder = self.server.holder
-        self.send_async(
-            {
-                "type": "node-status",
-                "node_id": self.node_id,
-                "schema": holder.schema(),
-                "maxShards": {
-                    name: idx.max_shard() for name, idx in holder.indexes.items()
-                },
-            }
-        )
+        msg = {
+            "type": "node-status",
+            "node_id": self.node_id,
+            "schema": holder.schema(),
+            "maxShards": {
+                name: idx.max_shard() for name, idx in holder.indexes.items()
+            },
+        }
+        if not sync:
+            self.send_async(msg)
+            return
+
+        def push(n):
+            try:
+                self._probe_client.send_message(n.uri, msg)
+            except (ClientError, OSError):
+                pass  # down peer: its own boot push heals the reverse path
+
+        futs = [self._pool.submit(push, n) for n in self._other_nodes()]
+        deadline = time.monotonic() + self.probe_timeout * 2
+        for f in futs:
+            try:
+                f.result(timeout=max(0.1, deadline - time.monotonic()))
+            except FuturesTimeoutError:
+                pass  # laggard keeps pushing in the background
 
     def pull_node_status(self) -> None:
         """Startup state PULL: fetch each live peer's schema + max
@@ -393,7 +462,8 @@ class Cluster:
         if self.server is None:
             return
         holder = self.server.holder
-        for n in self._other_nodes():
+
+        def pull(n):
             try:
                 schema = self._probe_client.schema(n.uri)
                 if schema:
@@ -403,14 +473,25 @@ class Cluster:
                     if idx is not None:
                         idx.set_remote_max_shard(int(m))
             except (ClientError, OSError):
-                continue  # peer down: its push will heal us when it boots
+                pass  # peer down: its push will heal us when it boots
+
+        # parallel fan-out + deadlined join, like the boot-time push:
+        # several black-holed peers cost ~one probe timeout, not their sum
+        futs = [self._pool.submit(pull, n) for n in self._other_nodes()]
+        deadline = time.monotonic() + self.probe_timeout * 2
+        for f in futs:
+            try:
+                f.result(timeout=max(0.1, deadline - time.monotonic()))
+            except FuturesTimeoutError:
+                pass
 
     def _apply_node_status(self, msg: dict) -> None:
         self._apply_remote_holder_state(msg)
-        # traffic from a node is liveness evidence
+        # traffic from a node is liveness evidence — but passive: it
+        # cannot clear DOWN (see _note_probe)
         sender = next((n for n in self.nodes if n.id == msg.get("node_id")), None)
         if sender is not None:
-            self._note_probe(sender, True)
+            self._note_probe(sender, True, traffic=True)
 
     def _apply_remote_holder_state(self, msg: dict) -> None:
         """Merge a peer's schema + maxShards into the local holder (the
@@ -548,11 +629,17 @@ class Cluster:
         if errs:
             raise errs[0]
 
-    def send_async(self, msg: dict) -> None:
+    def send_async(self, msg: dict, client: Optional[InternalClient] = None) -> None:
+        """Best-effort broadcast (errors swallowed). Sequential on
+        purpose: consecutive broadcasts keep per-peer ordering, which
+        keeps ClusterStatus application monotone without sequence
+        numbers. ``client`` overrides the transport (the boot-time sync
+        passes the short-timeout probe client)."""
+        client = client or self.client
         for n in self._other_nodes():
             try:
-                self.client.send_message(n.uri, msg)
-            except ClientError:
+                client.send_message(n.uri, msg)
+            except (ClientError, OSError):
                 pass
 
     def send_to(self, node: Node, msg: dict) -> None:

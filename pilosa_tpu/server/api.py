@@ -594,8 +594,20 @@ class API:
 
     def probe_node(self, uri: str) -> bool:
         """Probe ``uri``'s /status with the cluster's short probe
-        timeout; the relay half of SWIM indirect probing."""
+        timeout; the relay half of SWIM indirect probing. Only URIs
+        belonging to known cluster members are probed — the reference's
+        memberlist ping-req likewise only targets members — so the
+        endpoint cannot be used as an open relay into arbitrary
+        internal addresses (SSRF)."""
         if self.cluster is None:
+            return False
+        from pilosa_tpu.utils.uri import same_endpoint
+
+        with self.cluster.mu:
+            known = any(
+                same_endpoint(n.uri, uri) for n in self.cluster.nodes
+            )
+        if not known:
             return False
         try:
             self.cluster._probe_client.status(uri)

@@ -237,6 +237,22 @@ class Server:
             from pilosa_tpu.executor.autotune import autotune_executor
 
             autotune_executor(self.executor, logger=self.logger)
+        # startup node-status sync runs SYNCHRONOUSLY before open()
+        # returns (memberlist's join-time full state sync): a restarted
+        # node must know its live peers' schema + maxShards the moment
+        # it serves, or cross-shard counts collapse to local shards
+        # until the periodic exchange. Peers that are still down are
+        # skipped — their own boot-time push heals the other direction.
+        if (
+            self.cluster is not None
+            and len(self.cluster.nodes) > 1
+            and self.config.cluster.status_interval > 0
+        ):
+            try:
+                self.cluster.push_node_status(sync=True)
+                self.cluster.pull_node_status()
+            except Exception as e:
+                self.logger.printf("startup node-status sync error: %s", e)
         self._start_background_loops()
 
     def _normalize_host_uri(self, h: str) -> str:
@@ -465,19 +481,9 @@ class Server:
             interval = self.config.cluster.status_interval
             if interval <= 0:
                 return
-            # push IMMEDIATELY at startup, not only on the interval:
-            # memberlist does a full state sync at join, so a reference
-            # node knows its peers' maxShards the moment it's up. A
-            # restarted node here otherwise serves queries that cover
-            # only its LOCAL shards for up to a full interval (observed:
-            # cluster TopN counts collapsed to one shard's worth right
-            # after a rolling restart).
-            try:
-                if self.cluster is not None and len(self.cluster.nodes) > 1:
-                    self.cluster.push_node_status()
-                    self.cluster.pull_node_status()
-            except Exception as e:
-                self.logger.printf("node-status push error: %s", e)
+            # (the join-time full state sync runs synchronously in
+            # open() — see there; this loop is only the periodic drift
+            # healer, reference server.go:565-630)
             while not self._closed.wait(interval):
                 try:
                     if self.cluster is not None and len(self.cluster.nodes) > 1:
