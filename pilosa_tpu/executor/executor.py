@@ -191,8 +191,8 @@ class Executor:
                     fn = spmd.count_stack_spmd(self.mesh)
                 elif kind == "plane_counts":
                     fn = spmd.bsi_sum_spmd(self.mesh, *statics)
-                elif kind == "topn_scores":
-                    fn = spmd.topn_scores_spmd(self.mesh)
+                elif kind == "topn_scores_sparse":
+                    fn = spmd.topn_scores_sparse_spmd(self.mesh, *statics)
                 else:
                     raise ValueError(kind)
                 self._spmd_kernels[key] = fn
@@ -1263,9 +1263,15 @@ class Executor:
         ]
         if not any(pairs_by_shard):
             return []
-        srcs = self._device_bitmap_stack(index, c.children[0], shards)
+        # lazy: a pass 2 fully covered by the carry never resolves the
+        # source stack (no device re-fold of compound sources)
         provider = _StackedLazyScores(
-            self, frags, pairs_by_shard, srcs, shards=shards, carry=carry
+            self,
+            frags,
+            pairs_by_shard,
+            lambda: self._device_bitmap_stack(index, c.children[0], shards),
+            shards=shards,
+            carry=carry,
         )
         opt_ = TopOptions(
             n=int(n),
@@ -1286,14 +1292,15 @@ class Executor:
     def _topn_shards_spmd(
         self, index, c: Call, shards, carry=None
     ) -> list[tuple[int, int]]:
-        """All shards' TopN candidate scoring in ONE mesh program: the
-        per-shard candidate matrices stage sharded over the mesh, one
-        shard_map launch scores every candidate everywhere (all_gather
-        replaces the reference's HTTP Pairs exchange, executor.go:563-585),
-        and the host replays the ranked walk per shard for bit-identical
-        pruning."""
-        from pilosa_tpu.executor.batcher import _next_pow2
-
+        """Cross-shard TopN on the mesh with LAZY chunked staging: the
+        ranked walk (replayed per shard for bit-identical pruning)
+        pulls pow2 chunks of block-sparse candidates on demand; each
+        chunk is one shard_map program whose all_gather replaces the
+        reference's HTTP Pairs exchange (executor.go:563-585). Eagerly
+        staging every ranked-cache candidate densely cost k × S ×
+        128 KB — tens of GB at the reference's 50k-candidate cache
+        (cache.go:136-233) — where the lazy walk usually prunes within
+        the head chunk (fragment.go:870-1002 threshold break)."""
         field, _ = c.string_arg("_field")
         n, _ = c.uint_arg("n")
         attr_name, _ = c.string_arg("attrName")
@@ -1317,50 +1324,34 @@ class Executor:
         pairs_by_shard = [
             f._top_bitmap_pairs(row_ids) if f is not None else [] for f in frags
         ]
-        max_k = max((len(p) for p in pairs_by_shard), default=0)
-        if max_k == 0:
+        if not any(pairs_by_shard):
             return []
-        k = _next_pow2(max_k)
-        ids_by_shard = tuple(tuple(p[0] for p in ps) for ps in pairs_by_shard)
-        # cross-pass carry (same contract as the batched path): pass 1
-        # scores every cache candidate, so pass 2's id subset is always
-        # covered — skip its mesh dispatch entirely when it is
-        carried = None
-        if carry:
-            carried = [
-                {rid: carry[(s, rid)] for rid in ids if (s, rid) in carry}
-                for s, ids in zip(batch, ids_by_shard)
-            ]
-            if any(len(d) != len(ids) for d, ids in zip(carried, ids_by_shard)):
-                carried = None
-        if carried is None:
-            srcs = self._device_bitmap_stack(index, c.children[0], batch)
-            mats = self.stager.rows_stack(frags, ids_by_shard, k)
-            scores = np.asarray(self._spmd_kernel("topn_scores")(srcs, mats))
-
+        # carry-seeded provider: pass 2's id subset was scored by pass 1
+        # (same source, same fragment snapshot), so a fully-covered
+        # second pass dispatches nothing — not even the source stack
+        # (srcs is a thunk resolved on first chunk dispatch)
+        provider = _SpmdLazyScores(
+            self,
+            frags,
+            pairs_by_shard,
+            lambda: self._device_bitmap_stack(index, c.children[0], batch),
+            shards=batch,
+            carry=carry,
+        )
+        opt_ = TopOptions(
+            n=int(n),
+            src=None,
+            row_ids=row_ids,
+            min_threshold=min_threshold,
+            filter_name=attr_name,
+            filter_values=attr_values,
+            tanimoto_threshold=0,
+        )
         out: list[tuple[int, int]] = []
         for i, (frag, pairs) in enumerate(zip(frags, pairs_by_shard)):
             if frag is None or not pairs:
                 continue
-            if carried is not None:
-                score_by_id = carried[i]
-            else:
-                score_by_id = {
-                    rid: int(scores[i, j]) for j, rid in enumerate(ids_by_shard[i])
-                }
-                if carry is not None:
-                    s = batch[i]
-                    carry.update(((s, rid), n) for rid, n in score_by_id.items())
-            opt_ = TopOptions(
-                n=int(n),
-                src=None,
-                row_ids=row_ids,
-                min_threshold=min_threshold,
-                filter_name=attr_name,
-                filter_values=attr_values,
-                tanimoto_threshold=0,
-            )
-            out = pairs_add(out, _ranked_walk(frag, opt_, pairs, score_by_id))
+            out = pairs_add(out, _ranked_walk(frag, opt_, pairs, provider.view(i)))
         return out
 
     def _execute_topn_shard(
@@ -1536,19 +1527,27 @@ def _chunk_ids(pairs, lo: int, hi: int) -> tuple[int, ...]:
     return tuple(p[0] for p in pairs[lo:hi])
 
 
-class _StackedLazyScores:
-    """Cross-shard chunked lazy scoring: the next chunk is scored for
-    ALL shards in one sparse_intersection_counts_stacked dispatch the
-    first time any shard's walk reads past the scored prefix. Chunk
-    staging keys are content-derived (the per-shard candidate id
-    tuples), so repeated queries reuse the HBM-resident blocks.
+class _ChunkedLazyScores:
+    """Shared chunk-walk skeleton for cross-shard lazy TopN scoring:
+    the next pow2 chunk of every shard's candidate list is staged and
+    scored the first time any shard's ranked walk reads past the
+    scored prefix. Chunk staging keys are content-derived (the
+    per-shard candidate id tuples), so repeated queries reuse the
+    HBM-resident blocks.
 
     The FIRST chunk is small: on skewed data the walk prunes within the
     hot head (reference threshold break, fragment.go:969), so staging
     4096 candidates x S shards up front wastes HBM upload — at the 1B
     scale that is the difference between ~0.5 GB and ~2.3 GB of cold
     staging. Later chunks grow to amortize dispatch count on deep
-    walks."""
+    walks.
+
+    ``srcs`` may be a thunk: it resolves only when a chunk actually
+    dispatches, so a pass 2 fully covered by the cross-pass carry pays
+    no device work at all (not even re-folding a compound source).
+    Subclasses define _stage (host packing, memoized by the stager)
+    and _score (kernel dispatch returning a (shard_i, j) -> int
+    accessor)."""
 
     def __init__(self, ex, frags, pairs_by_shard, srcs, shards=None, carry=None) -> None:
         self._ex = ex
@@ -1576,15 +1575,24 @@ class _StackedLazyScores:
                 if seed:
                     self._scores[i].update(seed)
 
+    def _stage(self, ids_by_shard, size: int):
+        raise NotImplementedError
+
+    def _score(self, staged, size: int):
+        raise NotImplementedError
+
+    def _resolved_srcs(self):
+        if callable(self._srcs):
+            self._srcs = self._srcs()
+        return self._srcs
+
     def _score_next(self) -> None:
         lo = self._pos
         size = _chunk_size(lo)
         hi = lo + size
         self._pos = hi
         ids_by_shard = tuple(_chunk_ids(ps, lo, hi) for ps in self._pairs)
-        staged = self._ex.stager.sparse_rows_stacked(
-            self._frags, ids_by_shard, size
-        )
+        staged = self._stage(ids_by_shard, size)
         # overlap: while this chunk's kernel runs + fetches, pre-stage
         # the NEXT chunk on a side thread (the stager memoizes by
         # content key, so the walk's next _score_next finds it hot).
@@ -1599,23 +1607,12 @@ class _StackedLazyScores:
         if staged is None:  # no shard contributed blocks — all score 0
             for i, ids in enumerate(ids_by_shard):
                 self._scores[i].update((rid, 0) for rid in ids)
-            self._publish(ids_by_shard)
-            return
-        blocks, brow, bslot, bshard, num_rows = staged
-        # route through the coalescing scorer: key on the staged arrays'
-        # identity (same live objects ⇔ same snapshot — the BatchedScorer
-        # contract), so concurrent queries over this chunk share one
-        # kernel launch and one fetch
-        scores = self._ex.stacked_scorer.score(
-            (id(blocks), id(brow)),
-            (blocks, brow, bslot, bshard, num_rows),
-            self._srcs,
-        )
-        for i, ids in enumerate(ids_by_shard):
-            base = i * size
-            self._scores[i].update(
-                (rid, int(scores[base + j])) for j, rid in enumerate(ids)
-            )
+        else:
+            get = self._score(staged, size)
+            for i, ids in enumerate(ids_by_shard):
+                self._scores[i].update(
+                    (rid, get(i, j)) for j, rid in enumerate(ids)
+                )
         self._publish(ids_by_shard)
 
     def _prefetch(self, lo: int) -> None:
@@ -1629,9 +1626,7 @@ class _StackedLazyScores:
 
         def warm():
             try:
-                self._ex.stager.sparse_rows_stacked(
-                    self._frags, ids_by_shard, size
-                )
+                self._stage(ids_by_shard, size)
             except Exception:
                 pass  # purely advisory; the real call surfaces errors
             finally:
@@ -1653,6 +1648,29 @@ class _StackedLazyScores:
         return _ShardScoreView(self, shard_index)
 
 
+class _StackedLazyScores(_ChunkedLazyScores):
+    """Single-device form: each chunk is one merged block-sparse
+    sparse_intersection_counts_stacked dispatch covering all shards
+    (global segment ids), coalesced with concurrent queries through
+    the BatchedScorer."""
+
+    def _stage(self, ids_by_shard, size: int):
+        return self._ex.stager.sparse_rows_stacked(self._frags, ids_by_shard, size)
+
+    def _score(self, staged, size: int):
+        blocks, brow, bslot, bshard, num_rows = staged
+        # route through the coalescing scorer: key on the staged arrays'
+        # identity (same live objects ⇔ same snapshot — the BatchedScorer
+        # contract), so concurrent queries over this chunk share one
+        # kernel launch and one fetch
+        scores = self._ex.stacked_scorer.score(
+            (id(blocks), id(brow)),
+            (blocks, brow, bslot, bshard, num_rows),
+            self._resolved_srcs(),
+        )
+        return lambda i, j: int(scores[i * size + j])
+
+
 class _ShardScoreView:
     __slots__ = ("_p", "_i")
 
@@ -1666,6 +1684,29 @@ class _ShardScoreView:
         while row_id not in sc and p._pos < p._max_len:
             p._score_next()
         return sc[row_id]
+
+
+class _SpmdLazyScores(_ChunkedLazyScores):
+    """Mesh form: each chunk is ONE shard_map program
+    (topn_scores_sparse_spmd) over block-sparse candidate stacks
+    sharded across the mesh. The eager predecessor staged EVERY
+    ranked-cache candidate densely (k × S × 128 KB — tens of GB at a
+    50k-candidate cache); here a skewed walk that prunes in the hot
+    head pays only the head chunk, and bytes staged scale with set
+    containers (reference threshold walk semantics preserved by
+    _ranked_walk; fragment.go:870-1002)."""
+
+    def _stage(self, ids_by_shard, size: int):
+        return self._ex.stager.sparse_rows_stack(self._frags, ids_by_shard, size)
+
+    def _score(self, staged, size: int):
+        blocks, brow, bslot = staged
+        scores = np.asarray(
+            self._ex._spmd_kernel("topn_scores_sparse", size)(
+                self._resolved_srcs(), blocks, brow, bslot
+            )
+        )
+        return lambda i, j: int(scores[i, j])
 
 
 class _LazyScores:

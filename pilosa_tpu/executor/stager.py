@@ -246,23 +246,6 @@ class DeviceStager:
             self._stack_key(frags, "row_stack", (row_id,)), build
         )
 
-    def rows_stack(self, frags, row_ids_per_frag: tuple[tuple[int, ...], ...], k: int):
-        """u32[S, k, W]: per-shard candidate row matrices, row counts
-        padded to a common k (zero rows score 0 and callers index
-        results by each shard's true row_ids). The SPMD TopN scoring
-        operand."""
-
-        def build():
-            words = np.zeros((len(frags), k, SHARD_WIDTH // 64), dtype=np.uint64)
-            for i, (f, ids) in enumerate(zip(frags, row_ids_per_frag)):
-                if f is not None and ids:
-                    words[i, : len(ids)] = f.packed_rows(list(ids))
-            return self._to_device_sharded(words), words.nbytes
-
-        return self._get_or_build(
-            self._stack_key(frags, "rows_stack", (row_ids_per_frag, k)), build
-        )
-
     def sparse_rows_stacked(
         self, frags, ids_by_shard: tuple[tuple[int, ...], ...], chunk: int
     ):
@@ -314,6 +297,68 @@ class DeviceStager:
 
         return self._get_or_build(
             self._stack_key(frags, "sparse_stack", (chunk, ids_by_shard)), build
+        )
+
+    def sparse_rows_stack(
+        self, frags, ids_by_shard: tuple[tuple[int, ...], ...], k: int
+    ):
+        """Shard-major block-sparse candidate staging for the MESH TopN
+        path: (blocks u32[S, B, 2048], brow i32[S, B], bslot i32[S, B])
+        with every array's leading dim split over the mesh's shard axis
+        and B padded to a common power of two across shards. Bytes
+        staged scale with set containers, not candidates × 128 KB — the
+        sparse analog of rows_stack (SURVEY.md §7 hard part 2). Padding
+        blocks are zeros aimed at (row 0, slot 0): they contribute 0 to
+        every intersection. Returns None when no shard has blocks."""
+        from pilosa_tpu.executor.batcher import _next_pow2
+
+        def build():
+            per_shard = []
+            for f, ids in zip(frags, ids_by_shard):
+                if f is None or not ids:
+                    per_shard.append(None)
+                    continue
+                b, br, bs = f.sparse_row_blocks(list(ids))
+                per_shard.append((b, br.astype(np.int32), bs))
+            bmax = max(
+                (p[0].shape[0] for p in per_shard if p is not None), default=0
+            )
+            if bmax == 0:
+                return None, 0
+            bmax = _next_pow2(bmax)
+            S = len(frags)
+            blocks = np.zeros((S, bmax, 1024), dtype=np.uint64)
+            brow = np.zeros((S, bmax), dtype=np.int32)
+            bslot = np.zeros((S, bmax), dtype=np.int32)
+            for i, p in enumerate(per_shard):
+                if p is None:
+                    continue
+                b, br, bs = p
+                blocks[i, : b.shape[0]] = b
+                brow[i, : br.size] = br
+                bslot[i, : bs.size] = bs
+            w32 = np.ascontiguousarray(blocks).view("<u4").reshape(S, bmax, 2048)
+            if self.mesh is not None and S % self.mesh.devices.size == 0:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                from pilosa_tpu.parallel.spmd import SHARD_AXIS
+
+                sharding = NamedSharding(self.mesh, PartitionSpec(SHARD_AXIS))
+                dev = (
+                    jax.device_put(w32, sharding),
+                    jax.device_put(brow, sharding),
+                    jax.device_put(bslot, sharding),
+                )
+            else:
+                dev = (
+                    jax.device_put(w32, self.device),
+                    jax.device_put(brow, self.device),
+                    jax.device_put(bslot, self.device),
+                )
+            return dev, w32.nbytes + brow.nbytes + bslot.nbytes
+
+        return self._get_or_build(
+            self._stack_key(frags, "sparse_rows_stack", (k, ids_by_shard)), build
         )
 
     def planes_stack(self, frags, bit_depth: int):

@@ -177,32 +177,46 @@ def count_stack_spmd(mesh: Mesh):
     )
 
 
-def topn_scores_spmd(mesh: Mesh):
-    """Per-shard TopN candidate scoring across the mesh in one program.
+def topn_scores_sparse_spmd(mesh: Mesh, k: int):
+    """Block-sparse per-shard TopN candidate scoring across the mesh.
 
-    srcs: u32[S, W] (per-shard source bitmap), mats: u32[S, K, W]
-    (per-shard candidate rows) -> i32[S, K] scores replicated on every
-    device via all_gather. The host then replays the reference's ranked
-    walk per shard with these precomputed intersection counts — the
-    executor's _top_device batching, distributed: HTTP candidate
-    exchange (executor.go:563-585) becomes one ICI all_gather.
+    A dense form would stage every candidate row at 128 KB regardless
+    of sparsity — at a 50k-candidate ranked cache that is tens of GB
+    of staging per query (SURVEY.md §7 hard part 2). Here each shard
+    stages only its candidates' nonempty 2^16-bit container blocks,
+    padded to a common per-shard block count:
+
+      srcs:   u32[S, W]        per-shard source bitmap (shard-sharded)
+      blocks: u32[S, B, 2048]  per-shard candidate container blocks
+      brow:   i32[S, B]        local candidate index per block
+      bslot:  i32[S, B]        container position within the row
+
+    Padding blocks are zero words aimed at (row 0, slot 0) and
+    contribute nothing to an intersection. Returns i32[S, k] scores
+    replicated everywhere via all_gather (the reference's HTTP Pairs
+    exchange, executor.go:563-585, riding ICI). k is static; callers
+    use pow2 chunk sizes so the compile cache stays bounded.
     """
+    from pilosa_tpu.ops.packed import CONTAINER_WORDS
 
-    def kernel(srcs, mats):
-        # per-device: srcs u32[s_local, W], mats u32[s_local, K, W]
-        scores = jnp.sum(
-            jax.lax.population_count(
-                jnp.bitwise_and(mats, srcs[:, None, :])
-            ).astype(jnp.int32),
-            axis=-1,
-        )  # [s_local, K]
+    def kernel(srcs, blocks, brow, bslot):
+        # per-device: srcs u32[s_local, W], blocks u32[s_local, B, 2048]
+        per_shard = srcs.reshape(srcs.shape[0], -1, CONTAINER_WORDS)
+
+        def one(src_blocks, blk, br, bs):
+            src_blk = src_blocks[bs]  # [B, 2048]
+            pc = jax.lax.population_count(jnp.bitwise_and(blk, src_blk))
+            per_block = jnp.sum(pc.astype(jnp.int32), axis=-1)
+            return jax.ops.segment_sum(per_block, br, num_segments=k)
+
+        scores = jax.vmap(one)(per_shard, blocks, brow, bslot)  # [s_local, k]
         return jax.lax.all_gather(scores, SHARD_AXIS, axis=0, tiled=True)
 
     return jax.jit(
         jax.shard_map(
             kernel,
             mesh=mesh,
-            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
             out_specs=P(),
             check_vma=False,
         )
