@@ -1,0 +1,38 @@
+"""Cross-process collective plane: the 2-process jax.distributed CPU
+mesh dryrun (dryrun_multiprocess.py) must pass — count psum, TopN
+all_gather, and BSI Sum psum over a shard axis that SPANS the process
+boundary, the in-program analog of the reference's multi-host cluster
+(reference cluster.go:788-857)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_mesh_collectives():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "dryrun_multiprocess.py")],
+        capture_output=True,
+        text=True,
+        timeout=280,
+        # the parent spawns its own workers with a clean CPU platform;
+        # scrub the conftest's single-process XLA flags so the workers
+        # get exactly 4 devices each
+        env={
+            k: v
+            for k, v in os.environ.items()
+            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+        },
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout[proc.stdout.index("{") :])
+    assert summary["ok"] is True
+    assert summary["processes"] == 2
+    assert len(summary["per_rank"]) == 2
+    for rank in summary["per_rank"]:
+        assert rank["global_devices"] == 8
+        assert rank["local_devices"] == 4
+        assert all(rank["ok"].values()), rank
