@@ -192,6 +192,33 @@ class AttrStore:
         with self.mu:
             return len(self._cache)
 
+    def resident_bytes(self) -> int:
+        """Python-heap bytes resident in the attr LRU — the only
+        structure here whose size could scale with the attr-set size
+        (the B-tree pages live in SQLite's own bounded page cache).
+        The memory contract's enforcement hook, mirroring
+        TranslateStore.rss_bytes (reference boltdb attrstore likewise
+        bounds residency to its AttrCache, boltdb/attrstore.go:82)."""
+        import sys
+
+        def deep(obj) -> int:
+            # recursive sizing: attr values may be lists/dicts whose
+            # elements dominate (shallow getsizeof counts only the
+            # container header and would let the contract test pass
+            # while real residency is orders larger)
+            n = sys.getsizeof(obj)
+            if isinstance(obj, dict):
+                n += sum(deep(k) + deep(v) for k, v in obj.items())
+            elif isinstance(obj, (list, tuple, set, frozenset)):
+                n += sum(deep(v) for v in obj)
+            return n
+
+        with self.mu:
+            total = sys.getsizeof(self._cache)
+            for k, v in self._cache.items():
+                total += sys.getsizeof(k) + deep(v)
+            return total
+
     # -- anti-entropy blocks (reference AttrBlocks / Diff, attr.go:90-120) --
 
     def blocks(self) -> list[tuple[int, bytes]]:
