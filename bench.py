@@ -497,6 +497,23 @@ def main():
     emit(final=True)
 
 
+def _cpu_fresh_main():
+    """Child mode: measure every chip-independent metric fresh on the
+    CPU backend (warm open, staging breakdown, CPU-path QPS). Run when
+    the device never answers, so the artifact carries numbers measured
+    by THIS code instead of a wholesale stale replay."""
+    from pilosa_tpu.utils.jaxplatform import bootstrap
+
+    bootstrap()
+    import bench_tall
+
+    budget = float(os.environ.get("PILOSA_BENCH_CHILD_BUDGET", 240))
+    out = bench_tall.run_cpu_fresh(deadline_s=budget - 15)
+    out["metric"] = "chip-independent fresh measurements (device unreachable)"
+    out["measured_at_rev"] = _git_rev()
+    print(json.dumps(out), flush=True)
+
+
 def _probe_main():
     """Tiny device liveness check run in a disposable child: init the
     backend, round-trip one array. Exits 0 iff the device answered."""
@@ -654,6 +671,41 @@ def _guarded_main():
     # beat a stale replay.
     tall_part = load_part("tall")
     kern_part = load_part("kernel")
+
+    # The device never answered — but most of the system is HOST work
+    # that can still be measured NOW: warm open, staging pack, CPU-path
+    # QPS. Run them fresh on the CPU backend and partition them from
+    # anything replayed below (VERDICT r4: a full-stale replay carried
+    # open_warm_s=134.5 while the shipped code opened in ~4 s). Skipped
+    # when a fresh same-session tall checkpoint already carries those
+    # numbers — re-measuring them would burn the margin that protects
+    # the final JSON write from the caller's outer timeout.
+    fresh_cpu = None
+    if remaining() > 120 and not (tall_part and tall_part.get("topn_qps")):
+        proc = run_child(
+            {
+                "PILOSA_BENCH_CPU_FRESH": "1",
+                "JAX_PLATFORMS": "cpu",
+                "PILOSA_BENCH_CHILD_BUDGET": str(remaining(margin=20.0)),
+            },
+            remaining(margin=15.0),
+        )
+        if proc is not None and proc.returncode == 0:
+            fresh_cpu = _extract_json_line(proc.stdout)
+            if fresh_cpu:
+                fresh_cpu.pop("metric", None)
+        if fresh_cpu is None:
+            print("cpu-fresh measurement failed", file=sys.stderr)
+
+    def attach_fresh(out: dict) -> dict:
+        if fresh_cpu:
+            out["fresh_cpu"] = fresh_cpu
+            out["note"] = (
+                "fresh_cpu fields were measured by THIS run on the CPU "
+                "backend and supersede the same-named fields inside any "
+                "replayed/checkpointed section"
+            )
+        return out
     if not (tall_part and tall_part.get("topn_qps")) and kern_part and kern_part.get(
         "kernel_qps"
     ):
@@ -671,7 +723,7 @@ def _guarded_main():
             "fresh same-revision measurement from this session",
         }
         out.update({k: v for k, v in kern_part.items() if k != "platform"})
-        print(json.dumps(out))
+        print(json.dumps(attach_fresh(out)))
         return
     if tall_part and tall_part.get("topn_qps"):
         out = {
@@ -696,35 +748,49 @@ def _guarded_main():
         }
         if kern_part:
             out.update({k: v for k, v in kern_part.items() if k != "platform"})
-        print(json.dumps(out))
+        print(json.dumps(attach_fresh(out)))
         return
 
-    # Fallback: replay the last good measurement, marked stale.
+    # Fallback: replay the last good DEVICE measurement, marked as the
+    # replayed partition — fresh_cpu (above) carries everything this
+    # run could honestly re-measure without the chip.
     try:
         with open(LAST_GOOD) as f:
             obj = json.load(f)
         obj["stale"] = True
-        obj["error"] = f"replayed last good result; this run failed: {reason}"
-        print(json.dumps(obj))
+        obj["stale_device"] = True
+        obj["error"] = (
+            f"device fields replayed from last good on-chip run; this "
+            f"run failed: {reason}"
+        )
+        print(json.dumps(attach_fresh(obj)))
         return
     except (OSError, ValueError):
         pass
-    print(
-        json.dumps(
-            {
-                "metric": "TopN queries/sec (backend unavailable)",
-                "value": 0.0,
-                "unit": "queries/s",
-                "vs_baseline": 0.0,
-                "error": reason,
-            }
+    out = {
+        "metric": "TopN queries/sec (backend unavailable)",
+        "value": 0.0,
+        "unit": "queries/s",
+        "vs_baseline": 0.0,
+        "error": reason,
+    }
+    if fresh_cpu and fresh_cpu.get("cpu_topn_qps"):
+        # no device and nothing to replay: the CPU full path measured
+        # NOW is the only honest headline
+        out["metric"] = (
+            "TopN queries/sec (CPU full path; device unreachable, no "
+            "prior on-chip result to replay)"
         )
-    )
+        out["value"] = fresh_cpu["cpu_topn_qps"]
+        out["vs_baseline"] = 1.0
+    print(json.dumps(attach_fresh(out)))
 
 
 if __name__ == "__main__":
     if os.environ.get("PILOSA_BENCH_PROBE"):
         _probe_main()
+    elif os.environ.get("PILOSA_BENCH_CPU_FRESH"):
+        _cpu_fresh_main()
     elif os.environ.get("PILOSA_BENCH_CHILD"):
         main()
     else:

@@ -471,6 +471,80 @@ def bench_keyed(tmp, scale):
     return _report("keyed_translate", len(queries), dev_qps, cpu_qps, p50, ok)
 
 
+def bench_auto_policy(tmp, scale):
+    """The SHIPPED policy end-to-end (VERDICT r4 weak #5): device_policy
+    "auto" with a MEASURED crossover (autotune, blocking — the same
+    measurement the server runs at open) must keep a tiny query on the
+    CPU roaring path, agree with its own estimate-vs-crossover rule on
+    every Count, and stay bit-identical to the CPU oracle either way."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.executor.autotune import autotune_executor
+    from pilosa_tpu.pql import parse
+
+    h = Holder(os.path.join(tmp, "autopol"))
+    h.open()
+    idx = h.create_index("a")
+    f = idx.create_field("f")
+    # tiny: row 0 touches 2 containers in shard 0
+    f.import_bits([0, 0], [5, 70_000])
+    # large: rows 1..8 populate every 2^16 container block of 8 shards
+    rows, cols = [], []
+    for r in range(1, 9):
+        for s in range(8):
+            for k in range(16):
+                rows.append(r)
+                cols.append((s << 20) + (k << 16) + r)
+    f.import_bits(rows, cols)
+
+    cpu = Executor(h, device_policy="never")
+    auto = Executor(h, device_policy="auto")
+    autotune_executor(auto, blocking=True)
+
+    tiny_q = "Count(Row(f=0))"
+    count_qs = [
+        tiny_q,
+        "Count(Union(Row(f=1), Row(f=2), Row(f=3), Row(f=4)))",
+        "Count(Intersect(Row(f=5), Row(f=6), Row(f=7)))",
+    ]
+    queries = count_qs + ["TopN(f, Row(f=1), n=4)"]
+    ok = True
+    routed = []
+    for q in queries:
+        before = auto.stager.hits + auto.stager.misses
+        want = cpu.execute("a", q)
+        got = auto.execute("a", q)
+        ok = ok and _canon([want]) == _canon([got])
+        routed.append(auto.stager.hits + auto.stager.misses > before)
+    # the tiny query must stay on the CPU path under ANY measured
+    # crossover (its estimate ~2 is below autotune's floor of 16)
+    ok = ok and routed[0] is False
+    # each Count's observed routing must agree with the policy's own
+    # per-shard estimate-vs-crossover decision — the shipped behavior,
+    # not a hardcoded expectation (on a co-located backend the large
+    # queries cross; behind a slow tunnel the crossover is higher)
+    all_shards = list(range(8))
+    for q, used in zip(count_qs, routed[: len(count_qs)]):
+        call = parse(q).calls[0]
+        expect = any(
+            auto._use_device("a", call.children[0], s) for s in all_shards
+        )
+        ok = ok and used == expect
+    _, qps, p50 = _run_queries(lambda q: auto.execute("a", q), queries, warm=True)
+    _, cpu_qps, _ = _run_queries(lambda q: cpu.execute("a", q), queries)
+    h.close()
+    print(
+        json.dumps(
+            {
+                "config": "auto_policy_note",
+                "measured_crossover": auto.auto_min_containers,
+                "routed_to_device": routed,
+            }
+        )
+    )
+    return _report("auto_policy", len(queries), qps, cpu_qps, p50, ok)
+
+
 def bench_tall_scaled(tmp, scale):
     """Config 4's true shape (tall singleton rows + hot rows, mmap
     store, block-sparse staging) at gauntlet scale: 4 shards x 200k
@@ -498,7 +572,7 @@ def bench_tall_scaled(tmp, scale):
     ok = tall.get("bit_identical") is True and not tall.get("error")
     return _report(
         "tall_scaled",
-        0,
+        tall.get("topn_queries_timed") or 0,
         tall.get("topn_qps") or 0.0,
         tall.get("cpu_topn_qps") or 0.0,
         tall.get("topn_p50_ms") or 0.0,
@@ -522,6 +596,7 @@ def main():
             bench_cluster,
             bench_spmd,
             bench_keyed,
+            bench_auto_policy,
             bench_tall_scaled,
         ):
             try:

@@ -131,7 +131,7 @@ def _queries():
 
 
 def _measure(execute, queries, seconds: float):
-    """(qps, p50_ms) over repeated passes within a time budget."""
+    """(qps, p50_ms, n_timed) over repeated passes within a time budget."""
     lat = []
     t_all = time.perf_counter()
     n = 0
@@ -145,7 +145,41 @@ def _measure(execute, queries, seconds: float):
             break
     total = time.perf_counter() - t_all
     lat.sort()
-    return n / total, lat[len(lat) // 2] * 1000
+    return n / total, lat[len(lat) // 2] * 1000, n
+
+
+def _scale_from_env() -> tuple[int, int]:
+    """(shards, rows_per_shard) from env, shrunk to available disk.
+    Guard rails: building the full 64-shard config needs ~18 GB disk
+    and ~13 GB resident occupancy index at query time. One definition —
+    run() and run_cpu_fresh() must build the SAME dataset or the
+    fresh-vs-replayed comparison is skewed."""
+    shards = int(os.environ.get("PILOSA_BENCH_TALL_SHARDS", SHARDS_DEFAULT))
+    rows_per_shard = int(
+        os.environ.get("PILOSA_BENCH_TALL_ROWS_PER_SHARD", ROWS_PER_SHARD)
+    )
+    free_gb = shutil.disk_usage(REPO).free / 1e9
+    need_gb = shards * rows_per_shard * 18e-9 + 5
+    if free_gb < need_gb:
+        shards = max(1, int((free_gb - 5) / (rows_per_shard * 18e-9)))
+    return shards, rows_per_shard
+
+
+def _open_warm(rows_per_shard: int):
+    """(holder, open_warm_s): open the data dir and eager-open every
+    fragment, like the reference's startup walk (holder.Open →
+    fragment.Open incl. cache restore, fragment.go:167-266). That cost
+    is storage open + occupancy sidecar mmap + cache restore — not
+    device staging, which warms under its own clock."""
+    from pilosa_tpu.core import Holder
+
+    h = Holder(_effective_cache_dir(rows_per_shard))
+    t_open = time.monotonic()
+    h.open()
+    view = h.view("tall", "f", "standard")
+    for s in sorted(view.fragments):
+        view.fragments[s].ensure_open()
+    return h, round(time.monotonic() - t_open, 2)
 
 
 def run(deadline_s: float = 1e9) -> dict:
@@ -156,16 +190,7 @@ def run(deadline_s: float = 1e9) -> dict:
     def remaining():
         return deadline_s - (time.monotonic() - t0)
 
-    shards = int(os.environ.get("PILOSA_BENCH_TALL_SHARDS", SHARDS_DEFAULT))
-    rows_per_shard = int(
-        os.environ.get("PILOSA_BENCH_TALL_ROWS_PER_SHARD", ROWS_PER_SHARD)
-    )
-    # guard rails: building the full 64-shard config needs ~18 GB disk
-    # and ~13 GB resident occupancy index at query time
-    free_gb = shutil.disk_usage(REPO).free / 1e9
-    need_gb = shards * rows_per_shard * 18e-9 + 5
-    if free_gb < need_gb:
-        shards = max(1, int((free_gb - 5) / (rows_per_shard * 18e-9)))
+    shards, rows_per_shard = _scale_from_env()
     # reserve time for open/warm/measure; the build resumes next run if cut
     reserve = min(200.0, remaining() * 0.5)
     build_budget = float(
@@ -179,21 +204,9 @@ def run(deadline_s: float = 1e9) -> dict:
 
     import jax
 
-    from pilosa_tpu.core import Holder
     from pilosa_tpu.executor import Executor
 
-    h = Holder(_effective_cache_dir(rows_per_shard))
-    t_open = time.monotonic()
-    h.open()
-    # eager-open every fragment, like the reference's startup walk
-    # (holder.Open → fragment.Open incl. cache restore,
-    # fragment.go:167-266): open_warm_s is THAT cost — storage open +
-    # occupancy sidecar mmap + cache restore — not device staging,
-    # which warms below under its own clock (device_warm_s)
-    view = h.view("tall", "f", "standard")
-    for s in sorted(view.fragments):
-        view.fragments[s].ensure_open()
-    out["open_warm_s"] = round(time.monotonic() - t_open, 2)
+    h, out["open_warm_s"] = _open_warm(rows_per_shard)
     dev = Executor(h, device_policy="always")
     cpu = Executor(h, device_policy="never")
     topn, chains = _queries()
@@ -234,17 +247,19 @@ def run(deadline_s: float = 1e9) -> dict:
         out["device_warm_s"] = round(time.monotonic() - t_warm, 1)
 
         budget = max(min(remaining() - 20, 60), 6)
-        topn_qps, topn_p50 = _measure(
+        topn_qps, topn_p50, topn_n = _measure(
             lambda q: dev.execute("tall", q), topn, budget / 2
         )
-        chain_qps, chain_p50 = _measure(
+        chain_qps, chain_p50, chain_n = _measure(
             lambda q: dev.execute("tall", q), chains, budget / 2
         )
         out.update(
             topn_qps=round(topn_qps, 2),
             topn_p50_ms=round(topn_p50, 2),
+            topn_queries_timed=topn_n,
             chain_qps=round(chain_qps, 2),
             chain_p50_ms=round(chain_p50, 2),
+            chain_queries_timed=chain_n,
             platform=jax.devices()[0].platform,
         )
         # serving throughput: 8 concurrent clients — pipelined round
@@ -343,10 +358,10 @@ def run(deadline_s: float = 1e9) -> dict:
         # CPU full-path baseline on a small sample (labelled: this is
         # this repo's Python roaring path, not the reference Go binary)
         if remaining() > 20:
-            cpu_topn_qps, _ = _measure(
+            cpu_topn_qps, _, _ = _measure(
                 lambda q: cpu.execute("tall", q), topn[:2], min(remaining() - 10, 10)
             )
-            cpu_chain_qps, _ = _measure(
+            cpu_chain_qps, _, _ = _measure(
                 lambda q: cpu.execute("tall", q), chains[:2], min(remaining() - 5, 5)
             )
             out["cpu_topn_qps"] = round(cpu_topn_qps, 3)
@@ -355,6 +370,88 @@ def run(deadline_s: float = 1e9) -> dict:
                 "CPU = this repo's Python roaring full path; reference Go "
                 "binary unavailable in image (see BASELINE.md)"
             )
+    except Exception as e:  # noqa: BLE001 — bench must always return a dict
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        h.close()
+    return out
+
+
+def run_cpu_fresh(deadline_s: float = 300.0) -> dict:
+    """Every chip-INDEPENDENT measurement of the tall config, fresh:
+    warm open, staging-pack breakdown, CPU-path QPS. Run on the CPU
+    backend when the device never answers, so the bench artifact
+    degrades to partial-fresh (these numbers measured by THIS code,
+    now) instead of replaying a whole stale round (VERDICT r4 weak #1:
+    the replay reported open_warm_s=134.5 while the shipped code
+    opened in ~4 s)."""
+    t0 = time.monotonic()
+
+    def remaining():
+        return deadline_s - (time.monotonic() - t0)
+
+    out: dict = {"config": "tall_1b_cpu_fresh"}
+    shards, rows_per_shard = _scale_from_env()
+    # resume-build only within half the budget: when the dataset is
+    # already on disk (the normal case) this is a no-op stat pass
+    build = build_data(shards, rows_per_shard, budget_s=remaining() * 0.5)
+    out["build"] = build
+    out["shards"] = build["shards_present"]
+    if build["shards_present"] == 0:
+        out["error"] = "no fragments on disk and none built within budget"
+        return out
+
+    from pilosa_tpu.executor import Executor
+
+    h, out["open_warm_s"] = _open_warm(rows_per_shard)
+    view = h.view("tall", "f", "standard")
+
+    try:
+        # staging-pack breakdown: the candidate staging cost that feeds
+        # the device path, measured host-side (it IS host work). Cold =
+        # first touch (page-in + native expand); warm = packed again
+        # from the page cache.
+        frag = view.fragments[min(view.fragments)]
+        cand = [p[0] for p in frag.cache.top()[:4096]]
+        if cand:
+            t_c = time.perf_counter()
+            frag.sparse_row_blocks(cand)
+            cold_ms = (time.perf_counter() - t_c) * 1000
+            warm = []
+            for _ in range(3):
+                t_c = time.perf_counter()
+                frag.sparse_row_blocks(cand)
+                warm.append((time.perf_counter() - t_c) * 1000)
+            from pilosa_tpu import native_bridge
+
+            out["staging"] = {
+                "candidates": len(cand),
+                "pack_cold_ms": round(cold_ms, 1),
+                "pack_warm_ms": round(sorted(warm)[1], 1),
+                "native_kernel": native_bridge.available(),
+            }
+        # CPU full-path QPS (the reference-shaped roaring walk through
+        # PQL parse -> executor -> fragment.top), measured fresh
+        cpu = Executor(h, device_policy="never")
+        topn, chains = _queries()
+        if remaining() > 30:
+            qps, p50, _ = _measure(
+                lambda q: cpu.execute("tall", q), topn[:2],
+                min(remaining() * 0.4, 25),
+            )
+            out["cpu_topn_qps"] = round(qps, 3)
+            out["cpu_topn_p50_ms"] = round(p50, 1)
+        if remaining() > 15:
+            qps, p50, _ = _measure(
+                lambda q: cpu.execute("tall", q), chains[:2],
+                min(remaining() * 0.5, 15),
+            )
+            out["cpu_chain_qps"] = round(qps, 3)
+            out["cpu_chain_p50_ms"] = round(p50, 1)
+        out["baseline_note"] = (
+            "CPU = this repo's Python roaring full path; reference Go "
+            "binary unavailable in image (see BASELINE.md)"
+        )
     except Exception as e:  # noqa: BLE001 — bench must always return a dict
         out["error"] = f"{type(e).__name__}: {e}"
     finally:
