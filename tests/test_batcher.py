@@ -278,3 +278,32 @@ class TestRankingsMemo:
         a = r.chunk_ids(0, 2)
         assert a is r.chunk_ids(0, 2)
         assert r.chunk_ids(2, 10) == (1,)
+
+
+def test_chunk_schedule_pow2_and_deterministic():
+    """The lazy-walk chunk schedule: small head, geometric growth to
+    MAX_CHUNK, every size pow2 (bounded XLA compile cache), and a pure
+    function of position — chunk boundaries (and therefore staging
+    keys) must be identical across queries for the HBM cache to hit."""
+    from pilosa_tpu.executor.executor import (
+        FIRST_CHUNK,
+        MAX_CHUNK,
+        SCORE_CHUNK,
+        _chunk_size,
+    )
+
+    pos, sizes = 0, []
+    while pos < 100_000:
+        s = _chunk_size(pos)
+        sizes.append(s)
+        pos += s
+    assert sizes[0] == FIRST_CHUNK
+    assert sizes[1] == SCORE_CHUNK
+    assert all(s & (s - 1) == 0 for s in sizes)
+    assert max(sizes) == MAX_CHUNK
+    assert sizes == sorted(sizes)  # monotone growth
+    # replaying the boundary positions yields the same schedule
+    pos = 0
+    for s in sizes:
+        assert _chunk_size(pos) == s
+        pos += s
