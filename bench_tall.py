@@ -311,6 +311,14 @@ def run(deadline_s: float = 1e9) -> dict:
                 out["topn_qps_c32"] = measure_cn(
                     topn, 32, min(remaining() - 15, 20)
                 )
+                if remaining() > 35:
+                    # chains are transport-bound sequentially (one fused
+                    # dispatch ≈ one RTT) — c32 is the number that
+                    # answers the chain 10x question
+                    # (docs/perf_analysis.md §Chains)
+                    out["chain_qps_c32"] = measure_cn(
+                        chains, 32, min(remaining() - 15, 15)
+                    )
         # Latency decomposition: how much of a single query's p50 is
         # tunnel RTT vs host work? One tiny device round-trip bounds
         # the dispatch floor; dispatch counts per query multiply it.
