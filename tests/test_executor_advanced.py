@@ -236,3 +236,34 @@ class TestBSIFuzz:
         assert s.val == int(vals.sum()) and s.count == len(vals)
         assert cpu.execute("bz", "Min(field=v)")[0].val == int(vals.min())
         assert cpu.execute("bz", "Max(field=v)")[0].val == int(vals.max())
+
+
+class TestReferenceParityTail:
+    """Long-tail reference executor_test.go behaviors pinned exactly."""
+
+    def test_old_pql_calls_rejected(self, holder):
+        """v0-era call names are unknown calls with the reference's
+        exact message (reference TestExecutor_Execute_OldPQL,
+        executor_test.go:378-391)."""
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        e = execu(holder)
+        e.execute("i", "Set(0, f=1)")
+        with pytest.raises(ValueError, match="unknown call: SetBit"):
+            e.execute("i", "SetBit(frame=f, row=11, col=1)")
+
+    def test_set_column_attrs_excludes_field(self, holder):
+        """SetColumnAttrs stores exactly the given attrs — no stray
+        field/column key leaks into the attr map (reference
+        TestExecutor_SetColumnAttrs_ExcludeField,
+        executor_test.go:1264-1312)."""
+        idx = holder.create_index("i")
+        idx.column_attrs = AttrStore()
+        idx.create_field("f")
+        e = execu(holder)
+        e.execute("i", "Set(10, f=1)")
+        e.execute("i", "SetColumnAttrs(10, foo='bar')")
+        assert idx.column_attrs.attrs(10) == {"foo": "bar"}
+        e.execute("i", "Set(20, f=10)")
+        e.execute("i", "SetColumnAttrs(20, foo='bar')")
+        assert idx.column_attrs.attrs(20) == {"foo": "bar"}
