@@ -114,6 +114,57 @@ void pt_popcount_per_block(const uint64_t* words, size_t n_blocks,
     }
 }
 
+// CSV import fast path: parse strict "<u64>,<u64>\n" lines (optional
+// \r before \n; empty lines skipped). Returns the number of pairs
+// written to a/b, or -1 on ANY deviation — quoting, spaces, a third
+// field (timestamps), overflow, or more than max_out lines — in which
+// case the caller re-parses with the Python csv path, which owns error
+// reporting and timestamp handling. The reference parses import CSVs
+// line-by-line in Go (ctl/import.go:40-90); at 2^30-bit imports the
+// per-line Python loop is minutes of pure parse.
+long long pt_parse_csv_pairs(const uint8_t* buf, size_t len, uint64_t* a,
+                             uint64_t* b, size_t max_out) {
+    size_t n = 0, i = 0;
+    while (i < len) {
+        if (buf[i] == '\n') { i++; continue; }  // empty line
+        if (buf[i] == '\r' && i + 1 < len && buf[i + 1] == '\n') {
+            i += 2;
+            continue;
+        }
+        if (n >= max_out) return -1;
+        // first field
+        uint64_t v = 0;
+        size_t start = i;
+        while (i < len && buf[i] >= '0' && buf[i] <= '9') {
+            uint64_t d = buf[i] - '0';
+            if (v > (UINT64_MAX - d) / 10) return -1;  // overflow
+            v = v * 10 + d;
+            i++;
+        }
+        if (i == start || i >= len || buf[i] != ',') return -1;
+        a[n] = v;
+        i++;  // ','
+        // second field
+        v = 0;
+        start = i;
+        while (i < len && buf[i] >= '0' && buf[i] <= '9') {
+            uint64_t d = buf[i] - '0';
+            if (v > (UINT64_MAX - d) / 10) return -1;
+            v = v * 10 + d;
+            i++;
+        }
+        if (i == start) return -1;
+        b[n] = v;
+        n++;
+        if (i >= len) break;          // last line, no newline
+        if (buf[i] == '\r') i++;
+        if (i >= len) break;
+        if (buf[i] != '\n') return -1;  // third field / junk → Python
+        i++;
+    }
+    return static_cast<long long>(n);
+}
+
 }  // extern "C"
 
 extern "C" {

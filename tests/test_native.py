@@ -150,3 +150,43 @@ class TestExpandBlocks:
         assert not lazy.containers.expand_base_blocks(
             np.zeros(1, dtype=np.int64), out
         )
+
+
+class TestCsvFastPath:
+    """Native import CSV parser: strict 2-column u64 lines at C speed;
+    ANY deviation defers to the Python csv loop (which owns error
+    reporting and timestamp handling — reference ctl/import.go)."""
+
+    def test_parses_and_matches(self):
+        from pilosa_tpu import native_bridge
+
+        if not native_bridge.available():
+            import pytest
+
+            pytest.skip("native library unavailable")
+        got = native_bridge.parse_csv_pairs(
+            b"1,2\n3,4\r\n\n18446744073709551615,0\n5,6"
+        )
+        assert got is not None
+        a, b = got
+        assert a.tolist() == [1, 3, 18446744073709551615, 5]
+        assert b.tolist() == [2, 4, 0, 6]
+
+    def test_deviations_defer_to_python(self):
+        from pilosa_tpu import native_bridge
+
+        if not native_bridge.available():
+            import pytest
+
+            pytest.skip("native library unavailable")
+        for bad in (
+            b"1,2,2018-01-02T03:04\n",  # timestamp column
+            b"1, 2\n",                   # spaces
+            b'"1",2\n',                  # quoting
+            b"a,2\n",
+            b"1,\n",
+            b",2\n",
+            b"18446744073709551616,1\n",  # u64 overflow
+            b"1,2\x003,4\n",              # junk separator
+        ):
+            assert native_bridge.parse_csv_pairs(bad) is None, bad
