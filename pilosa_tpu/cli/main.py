@@ -189,7 +189,13 @@ def _try_native_csv(path):
             head = head[: cut + 1]
         if native_bridge.parse_csv_pairs(head) is None:
             return None
-        return native_bridge.parse_csv_pairs(mm)
+        try:
+            return native_bridge.parse_csv_pairs(mm)
+        except MemoryError:
+            # output arrays (~16 B/pair) didn't fit: the csv loop
+            # streams in batch_size chunks and completes where the
+            # one-shot arrays cannot
+            return None
     finally:
         mm.close()
 
@@ -333,11 +339,49 @@ def cmd_check(args) -> int:
                 if p.size > 1 and not (p[:-1] < p[1:]).all():
                     raise ValueError(f"container {key}: positions not sorted/unique")
                 n_containers += 1
+            # validate the sidecar BEFORE printing the fragment's ok
+            # line: a corrupt sidecar must not leave 'path: ok' on
+            # stdout for a path that exits 1
+            occ = _check_occ_sidecar(path, b)
             print(f"{path}: ok (bits={b.count()}, containers={n_containers}, ops={b.op_n})")
+            if occ is not None:
+                print(f"{path}.occ: {occ}")
         except Exception as e:
             print(f"{path}: FAILED: {e}", file=sys.stderr)
             rc = 1
     return rc
+
+
+def _check_occ_sidecar(path: str, b) -> "str | None":
+    """Validate a .occ occupancy sidecar against the fragment it
+    accelerates: the mmap store's loader applies the staleness stamp
+    (size/mtime/base) exactly as the serving path would, then the keys
+    and prefix sums are recomputed from the file and compared. Returns
+    a status string, or None when no sidecar exists / the store isn't
+    mmap-backed."""
+    import os as _os
+
+    import numpy as np
+
+    if not _os.path.exists(path + ".occ"):
+        return None
+    store = getattr(b, "containers", None)
+    if not hasattr(store, "_occ_sidecar_load"):
+        return None
+    got = store._occ_sidecar_load()
+    if got is None:
+        return "stale (stamp mismatch; serving ignores it — safe to delete)"
+    from pilosa_tpu.roaring.mmapstore import occ_arrays
+
+    keys, cs = occ_arrays(*store.keys_and_counts())
+    if np.array_equal(got[0], keys) and np.array_equal(got[1], cs):
+        return f"ok (containers={keys.size}, bits={int(cs[-1]) if cs.size else 0})"
+    # a sidecar that PASSES the staleness stamp but disagrees with the
+    # file would poison sparse staging — that is a real integrity
+    # failure, not a stale-and-ignored accelerator
+    raise ValueError(
+        ".occ sidecar passes the staleness stamp but disagrees with the file"
+    )
 
 
 def cmd_inspect(args) -> int:

@@ -3,6 +3,7 @@ ctl/*_test.go)."""
 
 import io
 import json
+import os
 import sys
 import threading
 import urllib.request
@@ -136,3 +137,37 @@ def test_config_commands(tmp_path, capsys):
     assert main(["config", "-c", str(cfg_file)]) == 0
     out = capsys.readouterr().out
     assert "1.2.3.4:5555" in out
+
+
+def test_check_validates_occ_sidecar(tmp_path, capsys):
+    """`check` validates the .occ occupancy sidecar: ok when it matches,
+    stale when the staleness stamp rejects it, FAILED (exit 1) when a
+    stamp-passing sidecar disagrees with the file."""
+    import numpy as np
+
+    from pilosa_tpu.cli.main import main
+    from pilosa_tpu.roaring import build_fragment_file
+
+    p = str(tmp_path / "frag")
+    build_fragment_file(
+        p, [np.arange(0, 5 << 16, 7, dtype=np.uint64)]
+    )
+    assert os.path.exists(p + ".occ")
+    assert main(["check", p]) == 0
+    out = capsys.readouterr().out
+    assert ".occ: ok" in out
+
+    # corrupt one prefix-sum word PAST the header: stamp still matches,
+    # data does not -> integrity failure
+    with open(p + ".occ", "r+b") as f:
+        f.seek(80)
+        f.write(b"\xff\xff\xff\xff")
+    assert main(["check", p]) == 1
+
+    # a stale stamp (file rewritten) is reported as ignorable, exit 0
+    build_fragment_file(
+        str(tmp_path / "frag2"), [np.arange(0, 3 << 16, 5, dtype=np.uint64)]
+    )
+    os.replace(str(tmp_path / "frag2.occ"), p + ".occ")
+    assert main(["check", p]) == 0
+    assert "stale" in capsys.readouterr().out
