@@ -165,6 +165,32 @@ long long pt_parse_csv_pairs(const uint8_t* buf, size_t len, uint64_t* a,
     return static_cast<long long>(n);
 }
 
+// CSV export fast path: format n "<u64>,<u64>\n" lines into out.
+// Returns bytes written, or -1 when out_cap could be exceeded (caller
+// sizes out at 42 bytes/line — two 20-digit u64s + ',' + '\n' — so
+// this only trips on a miscomputed cap). The inverse of
+// pt_parse_csv_pairs; the reference formats export CSV row-by-row in
+// Go (http/handler.go handleGetExport).
+long long pt_format_csv_pairs(const uint64_t* a, const uint64_t* b, size_t n,
+                              char* out, size_t out_cap) {
+    char tmp[20];
+    size_t w = 0;
+    for (size_t i = 0; i < n; i++) {
+        if (out_cap - w < 42) return -1;  // max line: 20+1+20+1 bytes
+        uint64_t v = a[i];
+        int k = 0;
+        do { tmp[k++] = static_cast<char>('0' + v % 10); v /= 10; } while (v);
+        while (k) out[w++] = tmp[--k];
+        out[w++] = ',';
+        v = b[i];
+        k = 0;
+        do { tmp[k++] = static_cast<char>('0' + v % 10); v /= 10; } while (v);
+        while (k) out[w++] = tmp[--k];
+        out[w++] = '\n';
+    }
+    return static_cast<long long>(w);
+}
+
 }  // extern "C"
 
 extern "C" {

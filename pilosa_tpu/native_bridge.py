@@ -123,6 +123,14 @@ def _bind(lib: ctypes.CDLL) -> None:
         u64p,             # out b
         ctypes.c_size_t,  # max_out
     ]
+    lib.pt_format_csv_pairs.restype = ctypes.c_longlong
+    lib.pt_format_csv_pairs.argtypes = [
+        u64p,             # a
+        u64p,             # b
+        ctypes.c_size_t,  # n
+        ctypes.c_void_p,  # out
+        ctypes.c_size_t,  # out_cap
+    ]
     lib.pt_expand_blocks_v2.restype = ctypes.c_int
     lib.pt_expand_blocks_v2.argtypes = [
         ctypes.c_void_p,  # buf base
@@ -238,6 +246,26 @@ def parse_csv_pairs(data: bytes):
     if n < 0:
         return None
     return a[:n], b[:n]
+
+
+def format_csv_pairs(a: np.ndarray, b: np.ndarray):
+    """Format two u64 arrays as ``<a>,<b>\\n`` CSV bytes — the export
+    fast path (inverse of parse_csv_pairs). Returns bytes, or None
+    when the native library is absent (caller formats in Python)."""
+    lib = _load()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    b = np.ascontiguousarray(b, dtype=np.uint64)
+    if a.size != b.size:
+        return None  # mismatched inputs must not read past b
+    out = np.empty(a.size * 42, dtype=np.uint8)
+    n = lib.pt_format_csv_pairs(
+        _u64p(a), _u64p(b), a.size, ctypes.c_void_p(out.ctypes.data), out.size
+    )
+    if n < 0:
+        return None
+    return out[:n].tobytes()
 
 
 def expand_blocks(

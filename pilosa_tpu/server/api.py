@@ -8,9 +8,10 @@ restricted set is callable.
 
 from __future__ import annotations
 
-import csv
 import io
 from typing import Any, Optional
+
+import numpy as np
 
 from pilosa_tpu import SHARD_WIDTH, __version__
 from pilosa_tpu.core import FieldOptions, Row
@@ -324,21 +325,35 @@ class API:
 
     # -- export (reference api.ExportCSV:328) --
 
-    def export_csv(self, index: str, field: str, shard: int) -> str:
+    def export_csv(self, index: str, field: str, shard: int) -> bytes:
+        """CSV bytes for one shard, "row,col\\n" lines (the reference's
+        Go csv writer likewise emits bare \\n, http/handler.go
+        handleGetExport) — both paths byte-identical so cross-node
+        export diffs can't depend on whether the native library built."""
         self._validate("export_csv")
         f = self.holder.field(index, field)
         if f is None:
             raise NotFoundError(f"field not found: {field}")
         frag = self.holder.fragment(index, field, VIEW_STANDARD, shard)
-        buf = io.StringIO()
-        w = csv.writer(buf)
-        if frag is not None:
-            positions = frag.storage.slice_all()
-            for p in positions:
-                row = int(p) // SHARD_WIDTH
-                col = frag.shard * SHARD_WIDTH + (int(p) % SHARD_WIDTH)
-                w.writerow([row, col])
-        return buf.getvalue()
+        if frag is None:
+            return b""
+        positions = np.asarray(frag.storage.slice_all(), dtype=np.uint64)
+        if positions.size == 0:
+            return b""
+        rows = positions // np.uint64(SHARD_WIDTH)
+        cols = np.uint64(frag.shard * SHARD_WIDTH) + (
+            positions % np.uint64(SHARD_WIDTH)
+        )
+        # native formatter (inverse of the import parser); Python
+        # fallback when the library isn't built
+        from pilosa_tpu import native_bridge
+
+        out = native_bridge.format_csv_pairs(rows, cols)
+        if out is not None:
+            return out
+        return (
+            "".join(f"{r},{c}\n" for r, c in zip(rows.tolist(), cols.tolist()))
+        ).encode()
 
     # -- fragment sync endpoints (reference api.go:376-472) --
 

@@ -314,10 +314,10 @@ class Handler:
 
     def get_export(self, req):
         q = req.query
-        csv_text = self.api.export_csv(
+        csv_bytes = self.api.export_csv(
             _qreq(q, "index"), _qreq(q, "field"), int(_qreq(q, "shard"))
         )
-        return RawResponse(csv_text.encode(), "text/csv")
+        return RawResponse(csv_bytes, "text/csv")
 
     def post_recalculate_caches(self, req) -> dict:
         self.api.recalculate_caches()

@@ -190,3 +190,17 @@ class TestCsvFastPath:
             b"1,2\x003,4\n",              # junk separator
         ):
             assert native_bridge.parse_csv_pairs(bad) is None, bad
+
+    def test_format_round_trips_with_parse(self):
+        from pilosa_tpu import native_bridge
+
+        if not native_bridge.available():
+            import pytest
+
+            pytest.skip("native library unavailable")
+        a = np.array([0, 1, 18446744073709551615, 42], dtype=np.uint64)
+        b = np.array([5, 0, 7, 1 << 20], dtype=np.uint64)
+        out = native_bridge.format_csv_pairs(a, b)
+        assert out == b"0,5\n1,0\n18446744073709551615,7\n42,1048576\n"
+        ra, rb = native_bridge.parse_csv_pairs(out)
+        assert ra.tolist() == a.tolist() and rb.tolist() == b.tolist()
