@@ -471,6 +471,72 @@ def bench_keyed(tmp, scale):
     return _report("keyed_translate", len(queries), dev_qps, cpu_qps, p50, ok)
 
 
+def bench_import(tmp, scale):
+    """Bulk import throughput END TO END — CSV file -> CLI parse (native
+    fast path) -> HTTP -> field import -> fragment bulk merge +
+    snapshot — with integrity as the pass condition: the export must
+    round-trip the imported bit set exactly. The reference ships this
+    as a run-to-measure micro-benchmark (BenchmarkFragment_Import,
+    fragment_internal_test.go:1208); here it is the full-server path."""
+    import numpy as np
+
+    from pilosa_tpu import SHARD_WIDTH, native_bridge
+    from pilosa_tpu.cli.main import main as cli_main
+    from pilosa_tpu.server.config import Config
+    from pilosa_tpu.server.server import Server
+
+    N = 2_000_000 * scale
+    rng = np.random.default_rng(8)
+    rows = rng.integers(0, 5000, N).astype(np.uint64)
+    cols = rng.integers(0, 8 << 20, N).astype(np.uint64)
+    path = os.path.join(tmp, "imp.csv")
+    blob = native_bridge.format_csv_pairs(rows, cols)
+    if blob is None:
+        blob = "".join(
+            f"{r},{c}\n" for r, c in zip(rows.tolist(), cols.tolist())
+        ).encode()
+    with open(path, "wb") as f:
+        f.write(blob)
+
+    cfg = Config(
+        data_dir=os.path.join(tmp, "impdata"),
+        bind="127.0.0.1:0",
+        device_policy="never",
+        metric="none",
+        anti_entropy_interval=0,
+    )
+    srv = Server(cfg)
+    srv.open()
+    try:
+        t0 = time.perf_counter()
+        rc = cli_main(
+            [
+                "import",
+                "-i", "imp", "-f", "f", "--create",
+                "--host", srv.uri,
+                path,
+            ]
+        )
+        dt = time.perf_counter() - t0
+        bits_per_s = N / dt
+        ok = rc == 0
+        # integrity: export every shard and compare the bit SET exactly
+        # (shard count derived from the generated column range)
+        n_shards = ((8 << 20) - 1) // SHARD_WIDTH + 1
+        got = set()
+        for shard in range(n_shards):
+            for line in srv.api.export_csv("imp", "f", shard).splitlines():
+                r, c = line.split(b",")
+                got.add((int(r), int(c)))
+        want = set(zip(rows.tolist(), cols.tolist()))
+        ok = ok and got == want
+    finally:
+        srv.close()
+    return _report(
+        "bulk_import", N, bits_per_s, 0.0, dt * 1000, ok
+    )
+
+
 def bench_auto_policy(tmp, scale):
     """The SHIPPED policy end-to-end (VERDICT r4 weak #5): device_policy
     "auto" with a MEASURED crossover (autotune, blocking — the same
@@ -596,6 +662,7 @@ def main():
             bench_cluster,
             bench_spmd,
             bench_keyed,
+            bench_import,
             bench_auto_policy,
             bench_tall_scaled,
         ):

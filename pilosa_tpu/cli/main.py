@@ -73,7 +73,11 @@ def main(argv=None) -> int:
         action="store_true",
         help="rows are col,value pairs for an int field",
     )
-    p.add_argument("--batch-size", type=int, default=100000)
+    # reference default: 10M-bit import buffer (ctl/import.go:84).
+    # Every batch pays a snapshot per touched fragment, so a small
+    # default made big imports quadratic-ish (measured: 2M bits in 20
+    # batches spent ~90 s re-snapshotting growing fragments)
+    p.add_argument("--batch-size", type=int, default=10_000_000)
     p.add_argument("files", nargs="+")
     p.set_defaults(fn=cmd_import)
 
@@ -158,10 +162,10 @@ def cmd_server(args) -> int:
     return 0
 
 
-def _post(host, path, body, is_json=True) -> dict:
+def _post(host, path, body, is_json=True, timeout: float = 60) -> dict:
     data = json.dumps(body).encode() if is_json else body
     req = urllib.request.Request(host + path, data=data, method="POST")
-    with urllib.request.urlopen(req, timeout=60) as resp:
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
         return json.loads(resp.read() or b"{}")
 
 
@@ -222,6 +226,11 @@ def cmd_import(args) -> int:
     def flush(rows, cols, timestamps):
         if not cols:
             return
+        # the server json-decodes the body and runs merge+snapshot
+        # before responding; scale the timeout with the batch (a 10M-bit
+        # reference-default batch is ~150 MB of JSON) instead of letting
+        # a fixed 60 s abort a large import mid-way
+        timeout = max(60.0, 60.0 + len(cols) / 20_000)
         if args.values:
             # value-mode CSV is columnID,value (reference
             # ctl/import.go:404-415), so the first CSV field — parsed
@@ -230,12 +239,18 @@ def cmd_import(args) -> int:
                 host,
                 f"/index/{args.index}/field/{args.field}/import-value",
                 {"columnIDs": rows, "values": cols},
+                timeout=timeout,
             )
         else:
             body = {"rowIDs": rows, "columnIDs": cols}
             if any(t for t in timestamps):
                 body["timestamps"] = timestamps
-            _post(host, f"/index/{args.index}/field/{args.field}/import", body)
+            _post(
+                host,
+                f"/index/{args.index}/field/{args.field}/import",
+                body,
+                timeout=timeout,
+            )
 
     total = 0
     for path in args.files:
@@ -250,11 +265,9 @@ def cmd_import(args) -> int:
                 a, b = parsed
                 for lo in range(0, len(a), args.batch_size):
                     hi = min(lo + args.batch_size, len(a))
-                    flush(
-                        a[lo:hi].tolist(),
-                        b[lo:hi].tolist(),
-                        [0] * (hi - lo),
-                    )
+                    # the strict format has no timestamp column; flush
+                    # skips the key for an empty list
+                    flush(a[lo:hi].tolist(), b[lo:hi].tolist(), [])
                     total += hi - lo
                 continue
         f = sys.stdin if path == "-" else open(path)
