@@ -533,6 +533,11 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
 
     class _Req(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # TCP_NODELAY: without it, a keep-alive client pays the
+        # Nagle + delayed-ACK interaction (~40 ms) on EVERY small
+        # response — measured 23 qps vs 1,300+ on this loopback. The
+        # reference's Go net/http sets it by default.
+        disable_nagle_algorithm = True
 
         def log_message(self, fmt, *args):  # silence default stderr logging
             if handler.logger:
