@@ -142,16 +142,26 @@ def server_level(out: dict) -> None:
     """Same dataset through the FULL HTTP stack (parse + handler +
     executor), one server meshless/CPU vs one meshed — the layer where
     the round-3/4 gauntlet saw the mesh lose."""
+    import http.client
     import json as _json
-    from urllib.request import Request, urlopen
 
     from pilosa_tpu.server.config import Config
     from pilosa_tpu.server.server import Server
 
+    # keep-alive client (what the reference's Go client and every
+    # production HTTP client use); the server speaks HTTP/1.1 with
+    # TCP_NODELAY, so this measures the serving path without
+    # per-request TCP setup
+    conns: dict = {}
+
     def post(uri, path, body: str):
-        req = Request(uri + path, data=body.encode(), method="POST")
-        with urlopen(req) as resp:
-            return _json.loads(resp.read())
+        host = uri.replace("http://", "")
+        conn = conns.get(host)
+        if conn is None:
+            conn = conns[host] = http.client.HTTPConnection(host, timeout=60)
+        conn.request("POST", path, body=body.encode())
+        resp = conn.getresponse()
+        return _json.loads(resp.read())
 
     q_pruned = "TopN(f, Row(f=0), n=10)"
     q_full = f"TopN(f, Row(f=0), n={TAIL_ROWS + HOT_ROWS})"
