@@ -148,6 +148,42 @@ def _measure(execute, queries, seconds: float):
     return n / total, lat[len(lat) // 2] * 1000, n
 
 
+def _measure_closed_loop(dev, queries, n_clients: int, budget_s: float) -> float:
+    """QPS with ``n_clients`` closed-loop clients: each thread sends its
+    next query the moment the previous one returns (how N concurrent
+    HTTP clients actually behave). The earlier wave-barrier harness
+    (submit N futures, join all, repeat) convoyed the pipeline: the
+    slowest query of each wave idled every other client, and the
+    continuous batcher never saw a full queue."""
+    import threading
+
+    stop = time.perf_counter() + budget_s
+    counts = [0] * n_clients
+    errors: list[BaseException] = []
+
+    def client(ci: int) -> None:
+        i = ci  # offset so clients interleave different queries
+        try:
+            while time.perf_counter() < stop and not errors:
+                dev.execute("tall", queries[i % len(queries)])
+                i += 1
+                counts[ci] += 1
+        except BaseException as e:  # surface, don't shrink QPS silently
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=client, args=(ci,)) for ci in range(n_clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return round(sum(counts) / (time.perf_counter() - t0), 2)
+
+
 def _scale_from_env() -> tuple[int, int]:
     """(shards, rows_per_shard) from env, shrunk to available disk.
     Guard rails: building the full 64-shard config needs ~18 GB disk
@@ -270,15 +306,7 @@ def run(deadline_s: float = 1e9) -> dict:
             from concurrent.futures import ThreadPoolExecutor
 
             def measure_c8(queries, budget_c):
-                with ThreadPoolExecutor(max_workers=8) as pool:
-                    t0 = time.perf_counter()
-                    n = 0
-                    while time.perf_counter() - t0 < budget_c:
-                        futs = [pool.submit(dev.execute, "tall", q) for q in queries]
-                        for f in futs:
-                            f.result()
-                        n += len(queries)
-                    return round(n / (time.perf_counter() - t0), 2)
+                return _measure_closed_loop(dev, queries, 8, budget_c)
 
             d0, q0 = dev.stacked_scorer.dispatches, dev.stacked_scorer.batched_queries
             out["topn_qps_c8"] = measure_c8(topn, min(remaining() - 15, 20))
@@ -292,21 +320,8 @@ def run(deadline_s: float = 1e9) -> dict:
                 # deeper concurrency: the BatchedScorer coalesces c32
                 # into wider stacked launches (the serving ceiling on a
                 # tunneled chip, where sequential qps is RTT-bound)
-                from concurrent.futures import ThreadPoolExecutor as _TPE
-
                 def measure_cn(queries, n, budget_c):
-                    with _TPE(max_workers=n) as pool:
-                        t0 = time.perf_counter()
-                        done = 0
-                        while time.perf_counter() - t0 < budget_c:
-                            futs = [
-                                pool.submit(dev.execute, "tall", queries[i % len(queries)])
-                                for i in range(n)
-                            ]
-                            for f in futs:
-                                f.result()
-                            done += n
-                        return round(done / (time.perf_counter() - t0), 2)
+                    return _measure_closed_loop(dev, queries, n, budget_c)
 
                 out["topn_qps_c32"] = measure_cn(
                     topn, 32, min(remaining() - 15, 20)

@@ -30,6 +30,7 @@ import numpy as np
 from pilosa_tpu import SHARD_WIDTH, ops
 from pilosa_tpu.core import Row, TopOptions, VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD
 from pilosa_tpu.core.cache import CACHE_TYPE_NONE, sort_pairs
+from pilosa_tpu.core.cache import pairs_arrays as cache_pairs_arrays
 from pilosa_tpu.core.field import FIELD_TYPE_SET
 from pilosa_tpu.core.fragment import DEFAULT_MIN_THRESHOLD
 from pilosa_tpu.core.timequantum import TIME_FORMAT, views_by_time_range
@@ -95,15 +96,16 @@ class _NotDeviceable(Exception):
 
 def _make_stacked_scorer() -> BatchedScorer:
     """Coalescing scorer for the cross-shard stacked-sparse TopN path.
-    max_batch bounds the lax.map sweep (default 8; PILOSA_STACKED_MAX_BATCH
-    raises it for high-concurrency serving — c32/c64 clients coalesce
-    into wider launches); num_rows rides in the staged tuple. A factory
-    because the device health gate rebuilds it on restore (its dispatch
-    locks may be held by abandoned workers)."""
+    max_batch bounds the lax.map sweep (default 32: on a tunneled chip
+    the scores fetch is ~1 RTT regardless of width, so wide coalesced
+    launches are the serving throughput lever; PILOSA_STACKED_MAX_BATCH
+    tunes it); num_rows rides in the staged tuple. A factory because
+    the device health gate rebuilds it on restore (its queue may be
+    held by abandoned workers)."""
     return BatchedScorer(
-        max_batch=int(os.environ.get("PILOSA_STACKED_MAX_BATCH", 8)),
+        max_batch=int(os.environ.get("PILOSA_STACKED_MAX_BATCH", 32)),
         single_fn=lambda src, st: ops.sparse_intersection_counts_stacked(src, *st),
-        batch_fn=lambda srcs, st: ops.sparse_intersection_counts_stacked_batch(
+        batch_fn=lambda srcs, st: ops.sparse_intersection_counts_stacked_batch_list(
             srcs, *st
         ),
     )
@@ -1282,6 +1284,9 @@ class Executor:
             filter_values=attr_values,
             tanimoto_threshold=0,
         )
+        fast = _vectorized_topn_walk(pairs_by_shard, provider, opt_)
+        if fast is not None:
+            return fast
         out: list[tuple[int, int]] = []
         for i, (frag, pairs) in enumerate(zip(frags, pairs_by_shard)):
             if frag is None or not pairs:
@@ -1347,6 +1352,9 @@ class Executor:
             filter_values=attr_values,
             tanimoto_threshold=0,
         )
+        fast = _vectorized_topn_walk(pairs_by_shard, provider, opt_)
+        if fast is not None:
+            return fast
         out: list[tuple[int, int]] = []
         for i, (frag, pairs) in enumerate(zip(frags, pairs_by_shard)):
             if frag is None or not pairs:
@@ -1543,6 +1551,16 @@ def _chunk_ids(pairs, lo: int, hi: int) -> tuple[int, ...]:
     return tuple(p[0] for p in pairs[lo:hi])
 
 
+def _chunk_arrays(pairs, lo: int, hi: int):
+    """(ids int64[L], counts int64[L]) for pairs[lo:hi]; memoized on
+    Rankings snapshots, built fresh for plain lists (small row_ids
+    walks)."""
+    chunk = getattr(pairs, "chunk_arrays", None)
+    if chunk is not None:
+        return chunk(lo, hi)
+    return cache_pairs_arrays(pairs[lo:hi])
+
+
 class _ChunkedLazyScores:
     """Shared chunk-walk skeleton for cross-shard lazy TopN scoring:
     the next pow2 chunk of every shard's candidate list is staged and
@@ -1573,6 +1591,14 @@ class _ChunkedLazyScores:
         self._scores: list[dict[int, int]] = [{} for _ in frags]
         self._pos = 0  # scored prefix length (per shard)
         self._max_len = max((len(p) for p in pairs_by_shard), default=0)
+        # per-chunk score matrices [S, size] + their candidate ids; the
+        # vectorized cross-shard walk consumes these directly, and the
+        # per-id dict fanout (only needed by the scalar fallback walk)
+        # happens lazily in _fanout()
+        self._mats: list[np.ndarray] = []
+        self._chunk_meta: list[tuple] = []  # (lo, size, ids_by_shard)
+        self._fanned = 0
+        self._mat_cache = None
         # cross-pass score carry: TopN pass 2 re-reads counts pass 1
         # already computed (same source bitmap, same fragment snapshot —
         # both constant within one _execute_topn) — seeding from the
@@ -1621,15 +1647,50 @@ class _ChunkedLazyScores:
         if lo > 0 and hi < self._max_len:
             self._prefetch(hi)
         if staged is None:  # no shard contributed blocks — all score 0
-            for i, ids in enumerate(ids_by_shard):
-                self._scores[i].update((rid, 0) for rid in ids)
+            mat = np.zeros((len(self._frags), size), dtype=np.int32)
         else:
-            get = self._score(staged, size)
+            mat = self._score(staged, size)
+        self._mats.append(mat)
+        self._chunk_meta.append((lo, size, ids_by_shard))
+        self._publish(ids_by_shard, mat)
+
+    def _fanout(self) -> None:
+        """Populate the per-shard id->score dicts from chunk matrices
+        (scalar-walk fallback path only; zip over .tolist() is C-speed)."""
+        while self._fanned < len(self._mats):
+            _, _, ids_by_shard = self._chunk_meta[self._fanned]
+            mat = self._mats[self._fanned]
             for i, ids in enumerate(ids_by_shard):
-                self._scores[i].update(
-                    (rid, get(i, j)) for j, rid in enumerate(ids)
-                )
-        self._publish(ids_by_shard)
+                if ids:
+                    self._scores[i].update(zip(ids, mat[i].tolist()))
+            self._fanned += 1
+
+    def matrices(self):
+        """(scores i32[S, P], ids i64[S, P], counts i64[S, P],
+        valid bool[S, P]) over the scored prefix; memoized per chunk
+        count. Padding columns carry id -1 / count 0 / score 0."""
+        k = len(self._mats)
+        if self._mat_cache is not None and self._mat_cache[0] == k:
+            return self._mat_cache[1]
+        S = len(self._frags)
+        smat = (
+            np.concatenate(self._mats, axis=1) if k > 1 else self._mats[0]
+        )
+        P = smat.shape[1]
+        idm = np.full((S, P), -1, dtype=np.int64)
+        cntm = np.zeros((S, P), dtype=np.int64)
+        col = 0
+        for (lo, size, ids_by_shard), m in zip(self._chunk_meta, self._mats):
+            for i, ids in enumerate(ids_by_shard):
+                L = len(ids)
+                if L:
+                    a_ids, a_cnts = _chunk_arrays(self._pairs[i], lo, lo + L)
+                    idm[i, col : col + L] = a_ids
+                    cntm[i, col : col + L] = a_cnts
+            col += size
+        out = (smat, idm, cntm, idm >= 0)
+        self._mat_cache = (k, out)
+        return out
 
     def _prefetch(self, lo: int) -> None:
         if self._prefetching:
@@ -1652,13 +1713,15 @@ class _ChunkedLazyScores:
             target=warm, name="stage-prefetch", daemon=True
         ).start()
 
-    def _publish(self, ids_by_shard) -> None:
+    def _publish(self, ids_by_shard, mat) -> None:
         if self._carry is None:
             return
         for i, ids in enumerate(ids_by_shard):
+            if not ids:
+                continue
             s = self._shards[i]
-            sc = self._scores[i]
-            self._carry.update(((s, rid), sc[rid]) for rid in ids)
+            row = mat[i].tolist()
+            self._carry.update(zip(((s, rid) for rid in ids), row))
 
     def view(self, shard_index: int) -> "_ShardScoreView":
         return _ShardScoreView(self, shard_index)
@@ -1684,7 +1747,9 @@ class _StackedLazyScores(_ChunkedLazyScores):
             (blocks, brow, bslot, bshard, num_rows),
             self._resolved_srcs(),
         )
-        return lambda i, j: int(scores[i * size + j])
+        return np.asarray(scores)[: len(self._frags) * size].reshape(
+            len(self._frags), size
+        )
 
 
 class _ShardScoreView:
@@ -1697,8 +1762,12 @@ class _ShardScoreView:
     def __getitem__(self, row_id: int) -> int:
         p = self._p
         sc = p._scores[self._i]
+        if row_id in sc:
+            return sc[row_id]
+        p._fanout()
         while row_id not in sc and p._pos < p._max_len:
             p._score_next()
+            p._fanout()
         return sc[row_id]
 
 
@@ -1722,7 +1791,7 @@ class _SpmdLazyScores(_ChunkedLazyScores):
                 self._resolved_srcs(), blocks, brow, bslot
             )
         )
-        return lambda i, j: int(scores[i, j])
+        return scores[: len(self._frags), :size]
 
 
 class _LazyScores:
@@ -1796,6 +1865,109 @@ class _LazyScores:
         while row_id not in self._scores and self._next < len(self._pairs):
             self._score_chunk()
         return self._scores[row_id]
+
+
+def _vectorized_topn_walk(pairs_by_shard, provider, opt_: TopOptions):
+    """All shards' ranked walks in one numpy pass, or None when the
+    scalar fallback is required (tanimoto / attr filters).
+
+    Exactness argument (mirrors _ranked_walk below, reference
+    fragment.go:870-1002): the scalar walk's heap never pops, so once
+    the first n qualifying candidates are pushed the heap minimum — the
+    walk's threshold T — is FIXED: later pushes require count >= T.
+    The walk therefore reduces to closed form per shard:
+      phase 1: the first n candidates in cache order with
+               cached>=min_threshold and score>=min_threshold;
+               T = min of their scores;
+      break:   the first later candidate with cached<T ends the walk;
+      phase 2: candidates before the break with score >= T.
+    Shards with fewer than n qualifying candidates scan their whole
+    pairs list (the scalar loop never leaves phase 1). The cross-shard
+    merge (pairs_add + final sort_pairs) is order-insensitive, so the
+    picked SETS being identical makes the result bit-identical."""
+    if opt_.tanimoto_threshold > 0:
+        return None
+    if opt_.filter_name and opt_.filter_values:
+        return None
+    n = 0 if opt_.row_ids else opt_.n
+    mth = max(int(opt_.min_threshold), 1)
+    lengths = np.array([len(p) for p in pairs_by_shard], dtype=np.int64)
+    max_len = int(lengths.max()) if lengths.size else 0
+    if max_len == 0:
+        return []
+
+    if n == 0:
+        # exhaustive mode (pass 2 / n=0): every eligible candidate is
+        # scored; pairs lists here are the explicit id set — small —
+        # and usually fully covered by the cross-pass carry, so the
+        # dict lookups below dispatch nothing
+        ids_out: list[int] = []
+        cnts_out: list[int] = []
+        for i, pairs in enumerate(pairs_by_shard):
+            if not pairs:
+                continue
+            view = provider.view(i)
+            for rid, cnt in pairs:
+                if cnt < mth:
+                    continue
+                sc = view[rid]
+                if sc >= mth:
+                    ids_out.append(rid)
+                    cnts_out.append(sc)
+        return _merge_picked(
+            np.asarray(ids_out, dtype=np.int64),
+            np.asarray(cnts_out, dtype=np.int64),
+        )
+
+    big = np.int64(1) << np.int64(62)
+    while True:
+        if provider._pos == 0:
+            provider._score_next()
+        smat, idm, cntm, vmask = provider.matrices()
+        P = smat.shape[1]
+        elig = vmask & (cntm >= mth)
+        ok = elig & (smat >= mth)
+        cum = np.cumsum(ok, axis=1)
+        total_ok = cum[:, -1]
+        has_n = total_ok >= n
+        sel = ok & (cum <= n)
+        T = np.where(has_n, np.where(sel, smat, big).min(axis=1), big)
+        nth_pos = np.where(has_n, np.argmax(cum >= n, axis=1), P)
+        colr = np.arange(P, dtype=np.int64)[None, :]
+        after = colr > nth_pos[:, None]
+        brk_mask = elig & after & (cntm < T[:, None])
+        has_brk = brk_mask.any(axis=1)
+        exhausted = P >= lengths
+        done = (has_n & has_brk) | exhausted
+        if done.all():
+            brk = np.where(has_brk, np.argmax(brk_mask, axis=1), P)
+            phase2 = (
+                elig
+                & after
+                & (colr < brk[:, None])
+                & (smat >= T[:, None])
+            )
+            picked = np.where(has_n[:, None], sel | phase2, ok)
+            s_idx, c_idx = np.nonzero(picked)
+            return _merge_picked(
+                idm[s_idx, c_idx], smat[s_idx, c_idx].astype(np.int64)
+            )
+        if provider._pos >= max_len:
+            # unreachable (P == provider._pos >= every shard's length
+            # implies exhausted.all()); bail to the scalar walk rather
+            # than risk looping
+            return None
+        provider._score_next()
+
+
+def _merge_picked(ids: np.ndarray, counts: np.ndarray) -> list[tuple[int, int]]:
+    """Cross-shard merge: sum counts per id (pairs_add semantics; final
+    ordering is applied by the caller's sort_pairs)."""
+    if ids.size == 0:
+        return []
+    uids, inv = np.unique(ids, return_inverse=True)
+    sums = np.bincount(inv, weights=counts.astype(np.float64))
+    return list(zip(uids.tolist(), sums.astype(np.int64).tolist()))
 
 
 def _ranked_walk(frag, opt_: TopOptions, pairs, score_by_id) -> list[tuple[int, int]]:

@@ -149,24 +149,59 @@ def sparse_intersection_counts_stacked(
     return jax.ops.segment_sum(per_block, block_row, num_segments=num_rows)
 
 
+_BATCH_GROUP = 8  # queries scored per block-stream pass (footprint knob)
+
+
 @functools.partial(jax.jit, static_argnames=("num_rows",))
 def sparse_intersection_counts_stacked_batch(
     srcs_q, blocks, block_row, block_slot, block_shard, num_rows: int
 ):
     """Concurrent-query batch of the stacked cross-shard scoring: the
-    staged candidate blocks stream from HBM once for all Q sources
-    (the serving-throughput lever at the 1B-row scale, where the block
-    set is hundreds of MB and each extra query would otherwise re-read
-    it). lax.map bounds the peak footprint at one [B, 2048] popcount
-    buffer.
+    staged candidate blocks stream from HBM once per GROUP of query
+    sources (the serving-throughput lever at the 1B-row scale, where
+    the block set is hundreds of MB and each extra query would
+    otherwise re-read it). A pure lax.map over queries re-read the
+    block set per query — measured 147 ms vs 75 ms at Q=32 on the
+    1B/64-shard config; vectorizing groups of 8 inside the map keeps
+    the peak gather footprint bounded while amortizing the stream.
 
     srcs_q: u32[Q, S, W]; blocks: u32[B, 2048]; returns i32[Q, num_rows].
     """
-    return jax.lax.map(
-        lambda s: sparse_intersection_counts_stacked(
-            s, blocks, block_row, block_slot, block_shard, num_rows
-        ),
-        srcs_q,
+    q = srcs_q.shape[0]
+    group = min(_BATCH_GROUP, q)
+    if q % group:
+        # q is pow2-padded by the batcher; any stray remainder falls
+        # back to the per-query sweep rather than a mid-shape compile
+        return jax.lax.map(
+            lambda s: sparse_intersection_counts_stacked(
+                s, blocks, block_row, block_slot, block_shard, num_rows
+            ),
+            srcs_q,
+        )
+    per_shard = srcs_q.reshape(q, srcs_q.shape[1], -1, CONTAINER_WORDS)
+
+    def one_group(g):
+        src_blk = g[:, block_shard, block_slot]  # [G, B, W]
+        pc = jax.lax.population_count(jnp.bitwise_and(blocks[None], src_blk))
+        per_block = jnp.sum(pc.astype(jnp.int32), axis=-1)  # [G, B]
+        return jax.vmap(
+            lambda pb: jax.ops.segment_sum(pb, block_row, num_segments=num_rows)
+        )(per_block)
+
+    gs = per_shard.reshape(q // group, group, *per_shard.shape[1:])
+    return jax.lax.map(one_group, gs).reshape(q, num_rows)
+
+
+@functools.partial(jax.jit, static_argnames=("num_rows",))
+def sparse_intersection_counts_stacked_batch_list(
+    srcs, blocks, block_row, block_slot, block_shard, num_rows: int
+):
+    """List-of-sources form: stacks inside the jit so a coalesced batch
+    costs ONE dispatch RPC instead of stack + kernel (each Python-level
+    dispatch is a serialized ~70 ms round-trip on a tunneled chip).
+    srcs: [u32[S, W]] * Q (Q static via the arg structure)."""
+    return sparse_intersection_counts_stacked_batch(
+        jnp.stack(srcs), blocks, block_row, block_slot, block_shard, num_rows
     )
 
 
@@ -183,6 +218,14 @@ def intersection_counts_matrix_batch(srcs, mat) -> jax.Array:
     Pallas version (ops.pallas_kernels) tiles it properly on real TPU.
     """
     return jax.lax.map(lambda s: intersection_counts_matrix(s, mat), srcs)
+
+
+@jax.jit
+def intersection_counts_matrix_batch_list(srcs, mat) -> jax.Array:
+    """List-of-sources form of the dense batch scorer: stacks inside
+    the jit so a coalesced batch costs one dispatch RPC (see
+    sparse_intersection_counts_stacked_batch_list)."""
+    return intersection_counts_matrix_batch(jnp.stack(srcs), mat)
 
 
 # -- fold a stack of rows with one op ---------------------------------------

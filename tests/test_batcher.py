@@ -51,18 +51,18 @@ def test_scorer_single_caller(data):
 
 
 def test_scorer_concurrent_same_key(data):
-    """Deterministic coalescing: hold the fragment's dispatch lock while
-    all callers enqueue; on release the first dispatcher must drain the
-    whole queue into ONE batched launch."""
+    """Deterministic coalescing: mark the scorer as having an active
+    dispatcher so every caller enqueues as a waiter; then run one
+    dispatch round — it must drain the whole queue into ONE batched
+    launch."""
     import time
 
     srcs, mat = data
     q = srcs.shape[0]
     s = BatchedScorer()
     key = ("frag0", 0, (1, 2))
-    gate = threading.Lock()
-    s._dispatch_locks[key[0]] = gate
-    gate.acquire()
+    with s._lock:
+        s._dispatching = True  # play the leader from this thread
 
     results = [None] * q
 
@@ -72,17 +72,18 @@ def test_scorer_concurrent_same_key(data):
     threads = [threading.Thread(target=run, args=(i,)) for i in range(q)]
     for t in threads:
         t.start()
-    # wait until every caller is enqueued behind the held dispatch lock
+    # wait until every caller is enqueued behind the active dispatcher
     deadline = time.time() + 5
     while time.time() < deadline:
         with s._lock:
-            if len(s._pending.get(key, [])) == q:
+            ent = s._pending.get(key)
+            if ent is not None and len(ent[1]) == q:
                 break
         time.sleep(0.001)
     else:
-        gate.release()
+        s._dispatch_loop()
         pytest.fail("callers never enqueued")
-    gate.release()
+    s._dispatch_loop()  # drains everything, then clears _dispatching
     for t in threads:
         t.join()
     for i in range(q):
@@ -135,7 +136,9 @@ def test_scorer_error_propagates_to_peers(data, monkeypatch):
     def raise_fn(*a, **k):
         raise boom
 
-    monkeypatch.setattr(batcher_mod.ops, "intersection_counts_matrix_batch", raise_fn)
+    monkeypatch.setattr(
+        batcher_mod.ops, "intersection_counts_matrix_batch_list", raise_fn
+    )
     slots = [_Slot(srcs[0]), _Slot(srcs[1])]
     with pytest.raises(RuntimeError, match="device exploded"):
         s._fill(slots, mat)

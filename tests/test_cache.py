@@ -1,0 +1,76 @@
+"""RankCache invalidation semantics (reference cache.go:136-286).
+
+The reference's rankCache.Invalidate() re-sorts whenever its 10 s
+debounce window has passed — including on the read-only TopN path
+(topBitmapPairs, fragment.go:1004-1044). On an unmodified cache that
+re-sort is a semantic no-op; at the 1B/64-shard scale it was measured
+as the dominant GIL serialization under concurrent TopN (34 ms per 50k
+entry fragment). The dirty flag skips it without changing any output.
+"""
+
+from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.core.cache import RankCache
+
+
+def _filled(n=1000):
+    c = RankCache(50)
+    for i in range(n):
+        c.bulk_add(i, n - i)
+    c.recalculate()
+    return c
+
+
+class TestInvalidateDirtyFlag:
+    def test_clean_invalidate_is_free(self, monkeypatch):
+        c = _filled()
+        # expired debounce window: the old code would re-sort here
+        c._update_time = -1e9
+        before = c.rankings
+        calls = []
+        monkeypatch.setattr(
+            cache_mod, "sort_pairs", lambda p: calls.append(1) or sorted(
+                p, key=lambda x: (-x[1], x[0])
+            )
+        )
+        c.invalidate()
+        assert calls == []  # no re-sort
+        assert c.rankings is before  # rankings snapshot untouched
+
+    def test_write_then_invalidate_recalculates(self):
+        c = _filled()
+        c._update_time = -1e9
+        c.add(5000, 99999)
+        assert c.rankings[0] == (5000, 99999)
+
+    def test_debounce_still_applies_to_dirty(self):
+        c = _filled()
+        # recent recalc: a write within the window must NOT re-sort
+        # (reference debounce, cache.go:233-241)
+        before = c.rankings
+        c.bulk_add(6000, 88888)
+        c.invalidate()
+        assert c.rankings is before
+        # ...but the dirtiness persists: after the window the next
+        # invalidate picks it up
+        c._update_time = -1e9
+        c.invalidate()
+        assert c.rankings[0] == (6000, 88888)
+
+    def test_remove_marks_dirty(self):
+        c = _filled()
+        top_id = c.rankings[0][0]
+        c.remove(top_id)
+        assert all(p[0] != top_id for p in c.rankings)
+        c._update_time = -1e9
+        c.invalidate()  # rebuild from entries must also exclude it
+        assert all(p[0] != top_id for p in c.rankings)
+
+    def test_trim_and_threshold_unchanged(self):
+        # reference trim behavior: maxEntries cut + thresholdValue from
+        # the first trimmed entry (cache.go:250-270)
+        c = RankCache(10)
+        for i in range(30):
+            c.bulk_add(i, 100 - i)
+        c.recalculate()
+        assert len(c.rankings) == 10
+        assert c.threshold_value == 100 - 10

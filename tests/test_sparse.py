@@ -213,7 +213,7 @@ class TestSparseTopN:
             single_fn=lambda src, st: ops.sparse_intersection_counts_stacked(
                 src, *st
             ),
-            batch_fn=lambda srcs, st: ops.sparse_intersection_counts_stacked_batch(
+            batch_fn=lambda srcs, st: ops.sparse_intersection_counts_stacked_batch_list(
                 srcs, *st
             ),
         )
@@ -227,15 +227,15 @@ class TestSparseTopN:
             for s in srcs
         ]
 
-        # pre-create + hold the dispatch lock so every score() call
-        # enqueues; release once all four are pending
-        dlock = scorer._dispatch_locks.setdefault(key[0], threading.Lock())
-        dlock.acquire()
+        # mark the dispatcher active so every score() call enqueues as
+        # a waiter; run one dispatch round once all four are pending
+        with scorer._lock:
+            scorer._dispatching = True
         with ThreadPoolExecutor(max_workers=4) as pool:
             futs = [pool.submit(scorer.score, key, staged, s) for s in srcs]
-            while sum(len(v) for v in scorer._pending.values()) < 4:
+            while sum(len(v[1]) for v in scorer._pending.values()) < 4:
                 pass
-            dlock.release()
+            scorer._dispatch_loop()
             got = [f.result() for f in futs]
         for g, w in zip(got, want):
             assert np.array_equal(g, w)
