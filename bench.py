@@ -242,6 +242,33 @@ def main():
                 tv = result.get("tall", {}).get(tall_key)
                 if nv and tv:
                     result[out_key] = round(tv / nv, 2)
+            # Serving margin vs the CORE-SCALED baseline (BASELINE.md
+            # convention: native single-core x8 ~= the reference server
+            # parallelizing shards over an 8-core box). The serving
+            # number is the best measured concurrency level — on a
+            # tunneled chip the sequential qps is RTT-bound and the
+            # closed-loop concurrent number is what a deployment sees.
+            # prefix matches ONLY the closed-loop concurrency keys
+            # (topn_qps_c8/_c32/_c64...) — a budget-cut run that only
+            # measured the RTT-bound sequential number must not publish
+            # it under a serving label
+            for native_key, prefix, out_key in (
+                ("tall_1Bx64shards", "topn_qps_c", "topn_vs_native_core8"),
+                ("tall_chains_1Bx64shards", "chain_qps_c", "chain_vs_native_core8"),
+            ):
+                nv = _native.get(native_key, {}).get("native_cpu_qps")
+                t = result.get("tall", {})
+                best = max(
+                    (t[k] for k in t if k.startswith(prefix)
+                     and isinstance(t[k], (int, float))),
+                    default=None,
+                )
+                if nv and best:
+                    result[out_key] = {
+                        "serving_qps": best,
+                        "native_core8_qps": round(nv * 8, 2),
+                        "margin": round(best / (nv * 8), 2),
+                    }
     except Exception as e:  # any malformed baseline file — keep the JSON flowing
         print(f"native baseline unavailable: {type(e).__name__}: {e}", file=sys.stderr)
 

@@ -302,27 +302,52 @@ def run(deadline_s: float = 1e9) -> dict:
         # trips + the executor's continuous micro-batching; sequential
         # qps on a tunneled chip is RTT-bound, this is the number a
         # real serving deployment sees
+        def measure_cn(queries, n, budget_c):
+            return _measure_closed_loop(dev, queries, n, budget_c)
+
         if remaining() > 30:
-            from concurrent.futures import ThreadPoolExecutor
+            # Batch-width compile warm: the stacked/grouped kernels
+            # compile once per pow2 batch width, and a cold width costs
+            # 20-40 s of XLA compile — inside a 15 s measure window that
+            # reads as a 2x QPS loss (observed: c32 41.5 cold vs ~90
+            # steady-state on the same revision). Touch each width the
+            # measures below can reach (the scorer chunks launches at
+            # max_batch, so wider widths compile nothing new) so they
+            # observe steady state; the persistent compile cache makes
+            # this a no-op on re-runs. Each warm call can block ~40 s
+            # inside one cold compile (the closed-loop budget only
+            # gates loop entry, not an in-flight execute), so only
+            # attempt it while the budget could absorb that worst case
+            # without starving the measurement windows below. Warmed
+            # widths are recorded: a budget-cut artifact whose
+            # c-numbers ran against cold compiles is distinguishable
+            # ([] or a short list here, vs the full ladder).
+            max_w = getattr(dev.stacked_scorer, "max_batch", 32)
+            warmed = []
+            for width in (8, 16, 32, 64):
+                if width > max_w or remaining() < 110:
+                    break
+                try:  # best-effort: a transient tunnel error during a
+                    # throwaway warm must not abort the measurements
+                    _measure_closed_loop(dev, topn, width, 2.0)
+                    warmed.append(width)
+                except Exception:
+                    break
+            out["warmed_widths"] = warmed
 
-            def measure_c8(queries, budget_c):
-                return _measure_closed_loop(dev, queries, 8, budget_c)
-
+        if remaining() > 30:
             d0, q0 = dev.stacked_scorer.dispatches, dev.stacked_scorer.batched_queries
-            out["topn_qps_c8"] = measure_c8(topn, min(remaining() - 15, 20))
+            out["topn_qps_c8"] = measure_cn(topn, 8, min(remaining() - 15, 20))
             # coalescing telemetry: how many concurrent queries shared a
             # stacked kernel launch during the c8 window
             out["c8_coalesced_queries"] = dev.stacked_scorer.batched_queries - q0
             out["c8_dispatches"] = dev.stacked_scorer.dispatches - d0
             if remaining() > 30:
-                out["chain_qps_c8"] = measure_c8(chains, min(remaining() - 15, 15))
+                out["chain_qps_c8"] = measure_cn(chains, 8, min(remaining() - 15, 15))
             if remaining() > 40:
-                # deeper concurrency: the BatchedScorer coalesces c32
+                # deeper concurrency: the BatchedScorer coalesces c32/c64
                 # into wider stacked launches (the serving ceiling on a
                 # tunneled chip, where sequential qps is RTT-bound)
-                def measure_cn(queries, n, budget_c):
-                    return _measure_closed_loop(dev, queries, n, budget_c)
-
                 out["topn_qps_c32"] = measure_cn(
                     topn, 32, min(remaining() - 15, 20)
                 )
@@ -333,6 +358,17 @@ def run(deadline_s: float = 1e9) -> dict:
                     # (docs/perf_analysis.md §Chains)
                     out["chain_qps_c32"] = measure_cn(
                         chains, 32, min(remaining() - 15, 15)
+                    )
+                if remaining() > 40:
+                    # c64: closed-loop clients at the depth a fleet of
+                    # HTTP frontends would drive; the continuous batcher
+                    # self-tunes width to the fetch latency
+                    out["topn_qps_c64"] = measure_cn(
+                        topn, 64, min(remaining() - 15, 20)
+                    )
+                if remaining() > 35:
+                    out["chain_qps_c64"] = measure_cn(
+                        chains, 64, min(remaining() - 15, 15)
                     )
         # Latency decomposition: how much of a single query's p50 is
         # tunnel RTT vs host work? One tiny device round-trip bounds
