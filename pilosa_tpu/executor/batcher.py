@@ -75,8 +75,17 @@ class BatchedScorer:
     round-trip on a tunneled chip).
     """
 
-    def __init__(self, max_batch: int = 32, single_fn=None, batch_fn=None) -> None:
+    def __init__(
+        self, max_batch: int = 32, single_fn=None, batch_fn=None, pad_fn=None
+    ) -> None:
         self.max_batch = max_batch
+        # pow2 padding strategy: None = cached zeros_like (sources are
+        # single arrays; a zero source scores 0 and is sliced off).
+        # Callers whose src is NOT one array (the chain path's tuple of
+        # leaf arrays) supply pad_fn(proto_src) -> pad_src; padding with
+        # a repeat of a real source is always semantically safe because
+        # pad lanes' results are never assigned to a slot.
+        self._pad_fn = pad_fn
         self._single_fn = single_fn or (
             lambda src, staged: ops.intersection_counts_matrix(src, staged)
         )
@@ -210,12 +219,15 @@ class BatchedScorer:
                 q = _next_pow2(len(chunk))
                 srcs = [s.src for s in chunk]
                 if q > len(chunk):
-                    proto = srcs[0]
-                    zkey = (getattr(proto, "shape", None), str(getattr(proto, "dtype", "")))
-                    zero = self._pad_zeros.get(zkey)
-                    if zero is None:
-                        zero = self._pad_zeros[zkey] = jnp.zeros_like(proto)
-                    srcs = srcs + [zero] * (q - len(chunk))
+                    if self._pad_fn is not None:
+                        srcs = srcs + [self._pad_fn(srcs[0])] * (q - len(chunk))
+                    else:
+                        proto = srcs[0]
+                        zkey = (getattr(proto, "shape", None), str(getattr(proto, "dtype", "")))
+                        zero = self._pad_zeros.get(zkey)
+                        if zero is None:
+                            zero = self._pad_zeros[zkey] = jnp.zeros_like(proto)
+                        srcs = srcs + [zero] * (q - len(chunk))
                 launched.append((chunk, self._batch_fn(srcs, mat)))
             return launched
         except BaseException as e:

@@ -94,6 +94,101 @@ class _NotDeviceable(Exception):
     """Raised when a call subtree can't run on the device path."""
 
 
+class _ScoreCarry:
+    """Cross-pass TopN score carry: pass 1's chunk scores, appended as
+    whole arrays and resolved vectorized at pass-2 seed time.
+
+    Pass 2 only needs the union winners' counts (~n ids per shard), but
+    the previous dict form fanned EVERY pass-1 score into a (shard, id)
+    tuple key eagerly — ~8k tuple builds + dict inserts per query at 64
+    shards, measured ~3 ms of the ~6 ms serialized host work that
+    bounds serving throughput on a 1-core host. Append is O(1) per
+    chunk; seed() does one np.isin per (shard, chunk)."""
+
+    __slots__ = ("_chunks",)
+
+    def __init__(self) -> None:
+        self._chunks: list[tuple[int, object, object]] = []
+
+    def __len__(self) -> int:  # `if carry:` seeds only when non-empty
+        return len(self._chunks)
+
+    def add(self, shard: int, ids, scores) -> None:
+        # scores may be pow2- or chunk-size-padded past len(ids) (the
+        # old dict zip truncated implicitly) — slice, never trust widths
+        if len(ids):
+            self._chunks.append((shard, ids, scores[: len(ids)]))
+
+    def add_stacked(self, shards, ids_by_shard, mat) -> None:
+        for i, ids in enumerate(ids_by_shard):
+            if ids:
+                self._chunks.append((shards[i], ids, mat[i][: len(ids)]))
+
+    def seed(self, shard: int, rids) -> dict[int, int]:
+        """{rid: score} for the requested ids present in this carry.
+        Chunks are disjoint id ranges per shard (prefix walks), so no
+        overwrite ambiguity."""
+        out: dict[int, int] = {}
+        if not self._chunks:
+            return out
+        want = np.asarray(rids, dtype=np.int64)
+        if want.size == 0:
+            return out
+        for s, ids, scores in self._chunks:
+            if s != shard:
+                continue
+            ids_arr = np.asarray(ids, dtype=np.int64)
+            hit = np.isin(ids_arr, want)
+            if hit.any():
+                sc = np.asarray(scores)[hit]
+                for rid, v in zip(ids_arr[hit].tolist(), sc.tolist()):
+                    out[rid] = int(v)
+        return out
+
+
+def _eval_tree(t, leaves):
+    """Evaluate a lowered boolean call tree over leaf word arrays.
+    Traced inside jit: the whole chain becomes one XLA fusion. Works
+    unbatched (leaves u32[S, W]) and batched (u32[Q, S, W]) — the
+    boolean ops are elementwise (reference executor.go:704-1000)."""
+    tag = t[0]
+    if tag == "leaf":
+        return leaves[t[1]]
+    acc = _eval_tree(t[1][0], leaves)
+    for sub in t[1][1:]:
+        v = _eval_tree(sub, leaves)
+        if tag == "Intersect":
+            acc = ops.and_(acc, v)
+        elif tag == "Union":
+            acc = ops.or_(acc, v)
+        elif tag == "Xor":
+            acc = ops.xor_(acc, v)
+        else:
+            acc = ops.andnot(acc, v)
+    return acc
+
+
+def _make_chain_scorer(ex: "Executor") -> BatchedScorer:
+    """Coalescing scorer for fused Count(chain) dispatches: concurrent
+    same-shape chains (identical boolean tree + leaf shapes — the key)
+    stack their leaves into ONE batched kernel, i32[Q] counts back.
+    OFF by default (PILOSA_CHAIN_BATCH=1 enables): on the tunneled
+    chip, per-query dispatch pipelines ~50 independent RPCs and
+    measured 671 qps at c64 vs 235-297 coalesced — the chain kernel is
+    too cheap for batching to amortize, unlike TopN's matrix scan, so
+    the leader's serialized fetch rounds only cost depth. Kept for
+    deployments where per-dispatch overhead (not round-trip
+    pipelining) is the scarce resource. Pads with a repeat of a real
+    source (a leaves tuple has no zeros_like); pad lanes' counts are
+    never read."""
+    return BatchedScorer(
+        max_batch=int(os.environ.get("PILOSA_CHAIN_MAX_BATCH", 32)),
+        single_fn=ex._chain_count_single,
+        batch_fn=ex._chain_count_batch,
+        pad_fn=lambda proto: proto,
+    )
+
+
 def _make_stacked_scorer() -> BatchedScorer:
     """Coalescing scorer for the cross-shard stacked-sparse TopN path.
     max_batch bounds the lax.map sweep (default 32: on a tunneled chip
@@ -150,6 +245,12 @@ class Executor:
         # cache-rankings prefix) coalesce into one stacked kernel launch
         # — one device round-trip serves the whole batch.
         self.stacked_scorer = _make_stacked_scorer()
+        # concurrent same-shape Count(chain) queries CAN coalesce into
+        # one batched tree-count launch (see _make_chain_scorer); off by
+        # default — measured slower than per-query RPC pipelining on the
+        # tunneled chip (rationale at the _execute_count call site)
+        self._chain_batch = os.environ.get("PILOSA_CHAIN_BATCH", "0") == "1"
+        self.chain_scorer = _make_chain_scorer(self)
         # optional device health gate (executor/devicehealth.py):
         # serving deployments pass one so a wedged accelerator degrades
         # reads to the CPU roaring path instead of hanging them; bare
@@ -159,6 +260,8 @@ class Executor:
             health.on_restore = self._on_device_restore
         # fused count-of-tree programs keyed by query structure
         self._tree_jits: dict[str, Any] = {}
+        # batched variants keyed by (structure, pow2 width)
+        self._tree_batch_jits: dict[tuple, Any] = {}
         # auto-policy crossover, in estimated touched containers (see
         # _touched_containers + AUTOTUNE.json). The default assumes a
         # co-located chip (~1-2 ms dispatch ⇒ crossover ~10^2); deploys
@@ -378,6 +481,7 @@ class Executor:
         mutating their orphaned predecessors harmlessly."""
         self.scorer = BatchedScorer()
         self.stacked_scorer = _make_stacked_scorer()
+        self.chain_scorer = _make_chain_scorer(self)
         self.stager.reset_after_wedge()
 
     def _execute_call(self, index, c: Call, shards, opt) -> Any:
@@ -815,33 +919,55 @@ class Executor:
     def _tree_count_jit(self, tree):
         """Jitted popcount-of-tree, cached per tree structure (bounded
         by distinct query shapes, like the reference's parsed-query
-        cache would be)."""
+        cache would be). Returns i32[1] so the batcher's single path
+        and the caller's unwrap are shape-uniform with the batch path."""
         import jax
 
         key = repr(tree)
         fn = self._tree_jits.get(key)
         if fn is None:
-
-            def eval_tree(t, leaves):
-                tag = t[0]
-                if tag == "leaf":
-                    return leaves[t[1]]
-                acc = eval_tree(t[1][0], leaves)
-                for sub in t[1][1:]:
-                    v = eval_tree(sub, leaves)
-                    if tag == "Intersect":
-                        acc = ops.and_(acc, v)
-                    elif tag == "Union":
-                        acc = ops.or_(acc, v)
-                    elif tag == "Xor":
-                        acc = ops.xor_(acc, v)
-                    else:
-                        acc = ops.andnot(acc, v)
-                return acc
-
-            fn = jax.jit(lambda *ls: ops.count_bits(eval_tree(tree, ls)))
+            fn = jax.jit(
+                lambda *ls: ops.count_bits(_eval_tree(tree, ls))[None]
+            )
             self._tree_jits[key] = fn
         return fn
+
+    def _tree_count_batch_jit(self, tree, q: int, nleaves: int):
+        """Jitted popcount-of-tree over Q coalesced same-shape queries:
+        takes the Q queries' leaf arrays flattened (query-major), stacks
+        each leaf position to u32[Q, S, W], evaluates the boolean tree
+        once batched, and returns i32[Q] counts. One kernel dispatch
+        serves Q concurrent chain queries — the lever that takes chains
+        past the tunnel's request-pipelining depth the same way the
+        stacked scorer does for TopN. Cache key includes Q (pow2-padded
+        by the batcher, so compile count stays bounded)."""
+        import jax
+        import jax.numpy as jnp
+
+        key = (repr(tree), q)
+        fn = self._tree_batch_jits.get(key)
+        if fn is None:
+
+            def run(*flat):
+                stacked = tuple(
+                    jnp.stack([flat[k * nleaves + l] for k in range(q)])
+                    for l in range(nleaves)
+                )
+                acc = _eval_tree(tree, stacked)  # u32[Q, S, W]
+                pc = jax.lax.population_count(acc).astype(jnp.int32)
+                return jnp.sum(pc, axis=tuple(range(1, pc.ndim)))
+
+            fn = jax.jit(run)
+            self._tree_batch_jits[key] = fn
+        return fn
+
+    def _chain_count_single(self, leaves, tree):
+        return self._tree_count_jit(tree)(*leaves)
+
+    def _chain_count_batch(self, srcs, tree):
+        nleaves = len(srcs[0])
+        flat = [arr for leaves in srcs for arr in leaves]
+        return self._tree_count_batch_jit(tree, len(srcs), nleaves)(*flat)
 
     def _device_bitmap_stack(self, index, c: Call, shards):
         """Lower a bitmap call subtree to u32[S, W] across shards."""
@@ -996,8 +1122,29 @@ class Executor:
                 # chain is one XLA fusion + one dispatch, instead of an
                 # eager op (= a host round-trip on tunneled chips) per
                 # tree node (SURVEY.md §7 step 4).
+                #
+                # Default: per-query dispatch. Measured A/B on the
+                # tunneled chip (c64 closed-loop, warm): direct 671 qps
+                # vs coalesced 235-297 — the tunnel pipelines ~50
+                # independent RPCs while the scorer's drain rounds
+                # serialize on one fetch chain, and the chain kernel is
+                # too cheap (~0.1 ms) for batching to amortize anything
+                # (unlike TopN's matrix scan). PILOSA_CHAIN_BATCH=1
+                # opts into coalescing for deployments where dispatch
+                # COST (not round-trip pipelining) dominates; each slot
+                # carries its own staged leaf snapshot, so coalescing
+                # never changes which data a query counts.
                 leaves, tree = self._tree_leaves(index, child, batch)
-                return int(self._tree_count_jit(tree)(*leaves))
+                if self._chain_batch:
+                    key = (
+                        "chain",
+                        repr(tree),
+                        tuple(getattr(a, "shape", None) for a in leaves),
+                    )
+                    res = self.chain_scorer.score(key, tree, tuple(leaves))
+                else:
+                    res = self._tree_count_jit(tree)(*leaves)
+                return int(np.asarray(res).reshape(-1)[0])
             except _NotDeviceable:
                 pass
 
@@ -1198,7 +1345,7 @@ class Executor:
         # winning ids sit in every shard's cache head, so pass 2 usually
         # needs no device round-trip at all — on a tunneled chip that is
         # half the query's wall clock
-        carry: dict[tuple[int, int], int] = {}
+        carry = _ScoreCarry()
         pairs = self._execute_topn_shards(index, c, shards, opt, carry)
         if not pairs or ids_arg or opt.remote:
             return _pairs_result(pairs)
@@ -1609,11 +1756,7 @@ class _ChunkedLazyScores:
         self._prefetching = False  # one prefetch in flight at a time
         if carry:
             for i, s in enumerate(self._shards):
-                seed = {
-                    rid: carry[(s, rid)]
-                    for rid, _ in pairs_by_shard[i]
-                    if (s, rid) in carry
-                }
+                seed = carry.seed(s, [rid for rid, _ in pairs_by_shard[i]])
                 if seed:
                     self._scores[i].update(seed)
 
@@ -1716,12 +1859,7 @@ class _ChunkedLazyScores:
     def _publish(self, ids_by_shard, mat) -> None:
         if self._carry is None:
             return
-        for i, ids in enumerate(ids_by_shard):
-            if not ids:
-                continue
-            s = self._shards[i]
-            row = mat[i].tolist()
-            self._carry.update(zip(((s, rid) for rid in ids), row))
+        self._carry.add_stacked(self._shards, ids_by_shard, mat)
 
     def view(self, shard_index: int) -> "_ShardScoreView":
         return _ShardScoreView(self, shard_index)
@@ -1826,11 +1964,7 @@ class _LazyScores:
         self._shard = shard
         self._carry = carry
         if carry:
-            self._scores.update(
-                (rid, carry[(shard, rid)])
-                for rid, _ in pairs
-                if (shard, rid) in carry
-            )
+            self._scores.update(carry.seed(shard, [rid for rid, _ in pairs]))
 
     def _score_chunk(self) -> None:
         # ids materialise per chunk, never as one huge tuple — on a 50k-
@@ -1857,9 +1991,7 @@ class _LazyScores:
             scores = self._ex.scorer.score((id(frag), id(mat)), mat, self._src)
         self._scores.update(zip(ids, (int(s) for s in scores)))
         if self._carry is not None:
-            s = self._shard
-            sc = self._scores
-            self._carry.update(((s, rid), sc[rid]) for rid in ids)
+            self._carry.add(self._shard, ids, scores)
 
     def __getitem__(self, row_id: int) -> int:
         while row_id not in self._scores and self._next < len(self._pairs):
