@@ -26,12 +26,12 @@ import time
 # This experiment is DEFINED on the 8-virtual-device CPU mesh — force
 # the platform regardless of the deployment env (which pins the TPU
 # tunnel via JAX_PLATFORMS=axon + sitecustomize).
-os.environ["JAX_PLATFORMS"] = "cpu"
-if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8"
-    ).strip()
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from pilosa_tpu.utils.jaxplatform import force_cpu_mesh
+
+force_cpu_mesh(8)
 
 import numpy as np
 

@@ -1,0 +1,152 @@
+"""Scaled tri-path differential fuzz: fresh random datasets x random
+PQL queries, CPU roaring vs single-device batched vs 8-device SPMD
+mesh, optionally interleaving random mutations between queries.
+
+The in-suite fuzz (tests/test_fuzz_equivalence.py) pins fixed seeds so
+CI is deterministic; this runner sweeps FRESH seeds at scale — the
+form the round-5 14,480-query and 12,825-mutation sweeps took, now
+committed so any change to the executor can be re-validated the same
+way. Runs on the virtual CPU mesh (no chip dependency).
+
+  python fuzz_sweep.py [--datasets 40] [--queries 40] [--mutate]
+
+Prints one JSON line: comparisons, mismatches (must be 0), seeds of
+any failures for reproduction.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from pilosa_tpu.utils.jaxplatform import force_cpu_mesh
+
+force_cpu_mesh(8)
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"))
+import test_fuzz_equivalence as fz  # the generators are the single source of truth
+
+from pilosa_tpu import SHARD_WIDTH
+from pilosa_tpu.core import FieldOptions, Holder
+from pilosa_tpu.core.field import FIELD_TYPE_INT
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.parallel.spmd import make_mesh
+
+
+def build_dataset(seed: int):
+    rng = np.random.default_rng(seed)
+    h = Holder()
+    h.open()
+    idx = h.create_index("z")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    v = idx.create_field(
+        "v", FieldOptions(type=FIELD_TYPE_INT, min=fz.VAL_MIN, max=fz.VAL_MAX)
+    )
+    for fld, kmax in ((f, 400), (g, 200)):
+        rows, cols = [], []
+        for r in range(fz.N_ROWS):
+            k = int(rng.integers(1, kmax))
+            rows += [r] * k
+            cols += rng.integers(0, fz.N_SHARDS * SHARD_WIDTH, size=k).tolist()
+        fld.import_bits(rows, cols)
+    vcols = rng.choice(fz.N_SHARDS * SHARD_WIDTH, size=600, replace=False)
+    vvals = rng.integers(fz.VAL_MIN, fz.VAL_MAX + 1, size=600)
+    v.import_values(vcols.tolist(), vvals.tolist())
+    return h, idx, rng
+
+
+def mutate(idx, rng) -> None:
+    kind = rng.choice(["set", "clear", "setvalue", "bulk"])
+    f = idx.field(rng.choice(["f", "g"]))
+    if kind == "set":
+        f.set_bit(int(rng.integers(0, fz.N_ROWS)), int(rng.integers(0, fz.N_SHARDS * SHARD_WIDTH)))
+    elif kind == "clear":
+        f.clear_bit(int(rng.integers(0, fz.N_ROWS)), int(rng.integers(0, fz.N_SHARDS * SHARD_WIDTH)))
+    elif kind == "setvalue":
+        idx.field("v").set_value(
+            int(rng.integers(0, fz.N_SHARDS * SHARD_WIDTH)),
+            int(rng.integers(fz.VAL_MIN, fz.VAL_MAX + 1)),
+        )
+    else:
+        n = int(rng.integers(2, 40))
+        f.import_bits(
+            rng.integers(0, fz.N_ROWS, size=n).tolist(),
+            rng.integers(0, fz.N_SHARDS * SHARD_WIDTH, size=n).tolist(),
+        )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--datasets", type=int, default=40)
+    ap.add_argument("--queries", type=int, default=40)
+    ap.add_argument("--mutate", action="store_true")
+    ap.add_argument("--seed", type=int, default=int(time.time()))
+    args = ap.parse_args()
+
+    mesh = make_mesh()
+    master = np.random.default_rng(args.seed)
+    comparisons = 0
+    failures = []
+    t0 = time.time()
+    for d in range(args.datasets):
+        ds_seed = int(master.integers(0, 2**63))
+        h, idx, rng = build_dataset(ds_seed)
+        cpu = Executor(h, device_policy="never")
+        dev = Executor(h, device_policy="always")
+        spmd = Executor(h, device_policy="always", mesh=mesh)
+        for qi in range(args.queries):
+            if args.mutate and rng.random() < 0.5:
+                mutate(idx, rng)
+            q = fz._gen_query(rng)
+            try:
+                want = fz._normalize(cpu.execute("z", q))
+                for name, ex in (("device", dev), ("spmd", spmd)):
+                    got = fz._normalize(ex.execute("z", q))
+                    comparisons += 1
+                    if got != want:
+                        failures.append(
+                            {"dataset_seed": ds_seed, "qi": qi, "path": name, "q": q}
+                        )
+            except Exception as e:
+                failures.append(
+                    {"dataset_seed": ds_seed, "qi": qi, "q": q,
+                     "error": f"{type(e).__name__}: {e}"}
+                )
+        h.close()
+        if (d + 1) % 10 == 0:
+            print(
+                f"{d + 1}/{args.datasets} datasets, {comparisons} comparisons,"
+                f" {len(failures)} failures, {time.time() - t0:.0f}s",
+                file=sys.stderr,
+            )
+    # mismatches (tri-path divergence — the executor is wrong) and
+    # errors (a path raised — harness or executor crash) are different
+    # failures; conflating them would let N crashes masquerade as
+    # N divergences or vice versa
+    mismatches = [f for f in failures if "error" not in f]
+    errors = [f for f in failures if "error" in f]
+    print(
+        json.dumps(
+            {
+                "sweep_seed": args.seed,
+                "datasets": args.datasets,
+                "queries_per_dataset": args.queries,
+                "mutate": args.mutate,
+                "comparisons": comparisons,
+                "mismatches": len(mismatches),
+                "errors": len(errors),
+                "failures": (mismatches + errors)[:10],
+                "wall_s": round(time.time() - t0, 1),
+            }
+        )
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,24 @@ def honor_platform_env() -> None:
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 
+def force_cpu_mesh(n_devices: int = 8) -> None:
+    """Force an n-device virtual CPU mesh, overriding the image's
+    sitecustomize TPU pinning. MUST run before the first jax backend
+    initialisation (it sets XLA_FLAGS, which the backend reads once).
+    The one definition of this override — tests/conftest.py,
+    bench_spmd_measure.py, and fuzz_sweep.py all call it, so a change
+    to the mechanism (or the device count) lands everywhere at once."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def enable_compilation_cache(cache_dir: str | None = None) -> None:
     """Persist XLA compilations across processes.
 

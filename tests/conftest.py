@@ -8,15 +8,6 @@ on TPU-tunnel health. Multi-chip shardings are validated on 8 virtual
 CPU devices; real-TPU benchmarking happens in bench.py, not here.
 """
 
-import os
+from pilosa_tpu.utils.jaxplatform import force_cpu_mesh
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu_mesh(8)
