@@ -162,13 +162,31 @@ class BatchedScorer:
         results are fetched, so independent staged matrices pipeline
         their device work behind one fetch chain. Errors land on the
         affected slots (finish() re-raises them per waiter); one key's
-        failure doesn't abandon other keys' work."""
+        failure doesn't abandon other keys' work.
+
+        Rounds are DOUBLE-BUFFERED: round N+1's kernels launch before
+        round N's results are fetched, so on a tunneled chip the ~1-RTT
+        fetch of round N overlaps round N+1's dispatch, device compute,
+        and readiness — two rounds in flight instead of strict
+        launch→fetch alternation. Correctness is unaffected (each
+        slot's result is still fetched exactly once, just one round
+        later); the leader serves at most one extra round past its own
+        request before handing off."""
+        prev: list = []
+        launched_all: list = []
+
+        def fetch(launched_rounds: list) -> None:
+            for launched in launched_rounds:
+                try:
+                    self._finish(launched)
+                except BaseException:
+                    pass  # every slot of the batch carries the error
         try:
             while True:
                 with self._lock:
                     if not self._pending or (own is not None and own.event.is_set()):
                         self._dispatching = False
-                        return
+                        break
                     work = self._pending
                     self._pending = {}
                 launched_all = []
@@ -177,17 +195,27 @@ class BatchedScorer:
                         launched_all.append(self._launch(batch, mat))
                     except BaseException:
                         pass  # every slot of the batch carries the error
-                for launched in launched_all:
-                    try:
-                        self._finish(launched)
-                    except BaseException:
-                        pass  # ditto
+                fetch(prev)
+                prev = launched_all
+            # the final round's results are fetched after the dispatcher
+            # flag clears; a new leader draining fresh arrivals touches
+            # different slots, so the concurrent _finish is safe
+            fetch(prev)
         except BaseException:
             # never leave the scorer wedged: a leader death outside the
             # per-key guards (KeyboardInterrupt, MemoryError) must not
-            # strand the dispatcher flag
+            # strand the dispatcher flag — and never leave launched
+            # rounds unfetched (their slots left _pending, so _rescue
+            # can't adopt them; unfetched waiters would block forever).
+            # prev and the round launched THIS iteration are distinct
+            # objects whenever an async exception lands between the
+            # fetch and the prev=launched_all swap; _finish is
+            # idempotent per slot, so fetching both is always safe.
             with self._lock:
                 self._dispatching = False
+            fetch(prev)
+            if launched_all is not prev:
+                fetch(launched_all)
             raise
 
     def _fill(self, batch: list[_Slot], mat) -> None:
