@@ -106,6 +106,32 @@ def load_part(name: str):
         return None
 
 
+def best_closed_loop(d: dict, prefix: str):
+    """(key, qps) of the best measured closed-loop number among
+    ``prefix``-keyed fields (topn_qps_c8/_c32/...), or (None, None).
+    One definition — the live headline, the checkpoint-assembly
+    headline, and the core-scaled margin block all use it."""
+    best = (None, None)
+    for k, v in d.items():
+        if k.startswith(prefix) and isinstance(v, (int, float)):
+            if best[0] is None or v > best[1]:
+                best = (k, v)
+    return best
+
+
+def headline_mode(tall: dict):
+    """(mode_label, qps) for the artifact headline: the best measured
+    closed-loop serving number, falling back to sequential when no
+    concurrency window ran — or when none beat the sequential number
+    (a degraded window must not lower the published headline below
+    what the run actually achieved)."""
+    seq = tall.get("topn_qps") or 0.0
+    bk, bv = best_closed_loop(tall, "topn_qps_c")
+    if bk is not None and bv > seq:
+        return f"{bk.rsplit('c', 1)[1]} closed-loop clients", bv
+    return "sequential", seq
+
+
 def main():
     import os
 
@@ -206,17 +232,37 @@ def main():
                 result["tall"] = tall
                 if tall.get("topn_qps"):
                     rows = tall["build"]["rows"]
+                    # Headline = the best measured closed-loop serving
+                    # number: the baseline (reference server / native
+                    # proxy x cores) is concurrent server throughput,
+                    # so the apples-to-apples headline is ours under
+                    # concurrency too. Sequential qps (RTT-bound on a
+                    # tunneled chip, rtt_fraction ~0.85) always rides
+                    # in seq_qps. A budget-cut run that only measured
+                    # sequential reports that, labeled.
+                    mode, headline = headline_mode(tall)
                     result["metric"] = (
                         f"TopN queries/sec (full path, {rows:,} rows x "
-                        f"{tall['shards']} shards, single chip)"
+                        f"{tall['shards']} shards, single chip, {mode})"
                     )
-                    result["value"] = tall["topn_qps"]
+                    result["value"] = headline
+                    result["seq_qps"] = tall["topn_qps"]
                     result["p50_ms"] = tall["topn_p50_ms"]
                     if tall.get("cpu_topn_qps"):
+                        # fair on this 1-core host: the CPU full path is
+                        # host-saturated (100% of the core per query),
+                        # so its sequential qps IS its serving ceiling —
+                        # the ratio compares whole-host serving both
+                        # sides; stated in vs_baseline_note
                         result["vs_baseline"] = round(
-                            tall["topn_qps"] / tall["cpu_topn_qps"], 2
+                            result["value"] / tall["cpu_topn_qps"], 2
                         )
                         result["baseline_cpu_qps"] = tall["cpu_topn_qps"]
+                        result["vs_baseline_note"] = (
+                            "headline serving qps vs the CPU full path, "
+                            "whose sequential qps is its concurrency "
+                            "ceiling on this 1-core host (CPU-bound)"
+                        )
         except Exception as e:  # keep the JSON line flowing
             print(f"tall bench failed: {type(e).__name__}: {e}", file=sys.stderr)
 
@@ -257,12 +303,7 @@ def main():
                 ("tall_chains_1Bx64shards", "chain_qps_c", "chain_vs_native_core8"),
             ):
                 nv = _native.get(native_key, {}).get("native_cpu_qps")
-                t = result.get("tall", {})
-                best = max(
-                    (t[k] for k in t if k.startswith(prefix)
-                     and isinstance(t[k], (int, float))),
-                    default=None,
-                )
+                _, best = best_closed_loop(result.get("tall", {}), prefix)
                 if nv and best:
                     result[out_key] = {
                         "serving_qps": best,
@@ -753,16 +794,21 @@ def _guarded_main():
         print(json.dumps(attach_fresh(out)))
         return
     if tall_part and tall_part.get("topn_qps"):
+        # same headline convention as the live path (one definition:
+        # headline_mode): best closed-loop serving number when one was
+        # measured and beat sequential, else sequential, labeled either way
+        mode, headline = headline_mode(tall_part)
         out = {
             "metric": (
                 f"TopN queries/sec (full path, "
                 f"{tall_part.get('build', {}).get('rows', 0):,} rows x "
-                f"{tall_part.get('shards')} shards, single chip)"
+                f"{tall_part.get('shards')} shards, single chip, {mode})"
             ),
-            "value": tall_part["topn_qps"],
+            "value": headline,
+            "seq_qps": tall_part["topn_qps"],
             "unit": "queries/s",
             "vs_baseline": (
-                round(tall_part["topn_qps"] / tall_part["cpu_topn_qps"], 2)
+                round(headline / tall_part["cpu_topn_qps"], 2)
                 if tall_part.get("cpu_topn_qps")
                 else None
             ),
