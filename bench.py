@@ -132,6 +132,26 @@ def headline_mode(tall: dict):
     return "sequential", seq
 
 
+def vs_baseline_fields(mode: str, headline: float, cpu_qps) -> dict:
+    """The vs_baseline trio, identical from the live and the
+    checkpoint-assembly paths: ratio + denominator + a note stating
+    which convention the ratio uses (serving-vs-host-saturated-CPU for
+    a closed-loop headline; sequential-vs-sequential otherwise)."""
+    if not cpu_qps:
+        return {}
+    note = (
+        "headline serving qps vs the CPU full path, whose sequential "
+        "qps is its concurrency ceiling on this 1-core host (CPU-bound)"
+        if mode != "sequential"
+        else "sequential qps both sides (no concurrency window measured)"
+    )
+    return {
+        "vs_baseline": round(headline / cpu_qps, 2),
+        "baseline_cpu_qps": cpu_qps,
+        "vs_baseline_note": note,
+    }
+
+
 def main():
     import os
 
@@ -248,21 +268,11 @@ def main():
                     result["value"] = headline
                     result["seq_qps"] = tall["topn_qps"]
                     result["p50_ms"] = tall["topn_p50_ms"]
-                    if tall.get("cpu_topn_qps"):
-                        # fair on this 1-core host: the CPU full path is
-                        # host-saturated (100% of the core per query),
-                        # so its sequential qps IS its serving ceiling —
-                        # the ratio compares whole-host serving both
-                        # sides; stated in vs_baseline_note
-                        result["vs_baseline"] = round(
-                            result["value"] / tall["cpu_topn_qps"], 2
+                    result.update(
+                        vs_baseline_fields(
+                            mode, headline, tall.get("cpu_topn_qps")
                         )
-                        result["baseline_cpu_qps"] = tall["cpu_topn_qps"]
-                        result["vs_baseline_note"] = (
-                            "headline serving qps vs the CPU full path, "
-                            "whose sequential qps is its concurrency "
-                            "ceiling on this 1-core host (CPU-bound)"
-                        )
+                    )
         except Exception as e:  # keep the JSON line flowing
             print(f"tall bench failed: {type(e).__name__}: {e}", file=sys.stderr)
 
@@ -807,10 +817,8 @@ def _guarded_main():
             "value": headline,
             "seq_qps": tall_part["topn_qps"],
             "unit": "queries/s",
-            "vs_baseline": (
-                round(headline / tall_part["cpu_topn_qps"], 2)
-                if tall_part.get("cpu_topn_qps")
-                else None
+            **vs_baseline_fields(
+                mode, headline, tall_part.get("cpu_topn_qps")
             ),
             "platform": tall_part.get("platform"),
             "tall": tall_part,

@@ -1,0 +1,60 @@
+"""A/B: chain serving throughput, batched scorer vs per-query pipelining.
+
+Small dataset (2 shards x 200k rows) on the real chip; thorough warm
+(two passes per concurrency) so XLA compiles never land in a window.
+Sweep PILOSA_CHAIN_MAX_BATCH via fresh Executors.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("PILOSA_BENCH_TALL_SHARDS", "2")
+os.environ.setdefault("PILOSA_BENCH_TALL_ROWS_PER_SHARD", "200000")
+from pilosa_tpu.utils.jaxplatform import bootstrap
+
+bootstrap()
+
+import bench_tall
+from pilosa_tpu.executor import Executor
+
+shards, rps = bench_tall._scale_from_env()
+bench_tall.build_data(shards, rps, budget_s=600)
+h, _ = bench_tall._open_warm(rps)
+_, chains = bench_tall._queries()
+
+def bench_exec(dev, label):
+    # warm: sequential once, then two passes at each width
+    for q in chains[:6]:
+        dev.execute("tall", q)
+    for conc in (8, 32, 64):
+        bench_tall._measure_closed_loop(dev, chains, conc, 3.0)
+    out = {"label": label}
+    for conc in (32, 64):
+        out[f"c{conc}"] = bench_tall._measure_closed_loop(dev, chains, conc, 10.0)
+    d = getattr(dev.chain_scorer, "dispatches", None)
+    bq = getattr(dev.chain_scorer, "batched_queries", None)
+    out["dispatches"] = d
+    out["batched_queries"] = bq
+    print("AB " + json.dumps(out), flush=True)
+
+for mb in (1, 32, 64, 128):
+    os.environ["PILOSA_CHAIN_MAX_BATCH"] = str(mb)
+    dev = Executor(h, device_policy="always")
+    if mb == 1:
+        # max_batch=1 still routes through the scorer leader; to get true
+        # per-query pipelining (the old path), call the tree jit directly
+        # by monkeypatching score to bypass coalescing
+        orig = dev.chain_scorer
+        class _Direct:
+            dispatches = None
+            batched_queries = None
+            def score(self, key, tree, leaves):
+                import numpy as np
+                return np.asarray(orig._single_fn(leaves, tree))
+        dev.chain_scorer = _Direct()
+        bench_exec(dev, "unbatched-pipelined")
+    else:
+        bench_exec(dev, f"batched-mb{mb}")

@@ -1,0 +1,88 @@
+"""Profile host-side cost of the tall TopN serving path (chip attached).
+
+Q1: where does the ~11-24 ms of per-query host work go? (cProfile, sequential)
+Q2: how much host CPU is serialized per query at c32? (process_time accounting)
+"""
+import cProfile
+import io
+import json
+import os
+import pstats
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from pilosa_tpu.utils.jaxplatform import bootstrap
+
+bootstrap()
+
+import bench_tall
+from pilosa_tpu.executor import Executor
+
+t0 = time.monotonic()
+h, open_s = bench_tall._open_warm(bench_tall.ROWS_PER_SHARD)
+print(f"open_warm_s={open_s}", flush=True)
+dev = Executor(h, device_policy="always")
+topn, chains = bench_tall._queries()
+
+for rep in range(2):
+    for q in topn:
+        dev.execute("tall", q)
+print(f"warm done at {time.monotonic()-t0:.0f}s", flush=True)
+
+# ---- Q1: sequential profile
+pr = cProfile.Profile()
+pr.enable()
+tq = time.perf_counter()
+n = 0
+while time.perf_counter() - tq < 12:
+    dev.execute("tall", topn[n % len(topn)])
+    n += 1
+pr.disable()
+el = time.perf_counter() - tq
+print(f"\n=== sequential: {n} queries in {el:.1f}s = {n/el:.1f} qps ===", flush=True)
+s = io.StringIO()
+ps = pstats.Stats(pr, stream=s).sort_stats("tottime")
+ps.print_stats(28)
+print(s.getvalue()[:5500], flush=True)
+
+# ---- Q2: c32 with process_time accounting
+def run_c(conc, seconds):
+    stop = time.perf_counter() + seconds
+    counts = [0] * conc
+    def worker(i):
+        k = i
+        while time.perf_counter() < stop:
+            dev.execute("tall", topn[k % len(topn)])
+            k += conc
+            counts[i] += 1
+    cpu0 = time.process_time()
+    t0 = time.perf_counter()
+    ths = [threading.Thread(target=worker, args=(i,)) for i in range(conc)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    wall = time.perf_counter() - t0
+    cpu = time.process_time() - cpu0
+    ntot = sum(counts)
+    return {
+        "conc": conc,
+        "qps": round(ntot / wall, 2),
+        "queries": ntot,
+        "wall_s": round(wall, 1),
+        "proc_cpu_s": round(cpu, 1),
+        "host_cpu_ms_per_query": round(1000 * cpu / max(ntot, 1), 2),
+        "cpu_utilization": round(cpu / wall, 2),
+    }
+
+for conc in (8, 32, 64):
+    r = run_c(conc, 15)
+    print("C-RESULT " + json.dumps(r), flush=True)
+
+# batcher telemetry: how deep the stacked scorer coalesced during the
+# concurrency runs above
+sc = dev.stacked_scorer
+print(f"scorer dispatches={sc.dispatches} batched_queries={sc.batched_queries}")

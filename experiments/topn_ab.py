@@ -1,0 +1,44 @@
+"""A/B at 1B scale: TopN via stacked coalescing scorer (shipped) vs
+per-query direct dispatch, c32/c64 closed-loop, thorough warm."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from pilosa_tpu.utils.jaxplatform import bootstrap
+
+bootstrap()
+
+import numpy as np
+
+import bench_tall
+from pilosa_tpu.executor import Executor
+
+h, open_s = bench_tall._open_warm(bench_tall.ROWS_PER_SHARD)
+print(f"open {open_s}s", flush=True)
+topn, _ = bench_tall._queries()
+
+def bench_exec(dev, label):
+    for q in topn:
+        dev.execute("tall", q)
+    for conc in (8, 32, 64):
+        bench_tall._measure_closed_loop(dev, topn, conc, 3.0)
+    out = {"label": label}
+    for conc in (32, 64):
+        out[f"c{conc}"] = bench_tall._measure_closed_loop(dev, topn, conc, 12.0)
+    print("AB " + json.dumps(out), flush=True)
+
+dev = Executor(h, device_policy="always")
+bench_exec(dev, "stacked-coalesced (shipped)")
+
+dev2 = Executor(h, device_policy="always")
+orig = dev2.stacked_scorer
+class _Direct:
+    dispatches = 0
+    batched_queries = 0
+    max_batch = orig.max_batch
+    def score(self, key, mat, src):
+        return np.asarray(orig._single_fn(src, mat))
+dev2.stacked_scorer = _Direct()
+bench_exec(dev2, "per-query-direct")
