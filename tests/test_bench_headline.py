@@ -1,0 +1,52 @@
+"""Bench artifact headline conventions (bench.py helpers): the
+published value is the best measured closed-loop serving number, never
+lowered by a degraded window below the sequential number the run
+achieved, and the vs_baseline note always states which convention the
+ratio uses. These lock the semantics the BENCH_r05 artifacts and
+docs/perf_analysis.md rely on."""
+
+import importlib.util
+import os
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench():
+    path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    spec = importlib.util.spec_from_file_location("bench_module", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_headline_prefers_best_closed_loop(bench):
+    t = {"topn_qps": 12.5, "topn_qps_c8": 39.0, "topn_qps_c32": 101.6,
+         "topn_qps_c64": 132.9}
+    assert bench.headline_mode(t) == ("64 closed-loop clients", 132.9)
+
+
+def test_headline_never_below_sequential(bench):
+    # a degraded concurrency window must not lower the published number
+    t = {"topn_qps": 0.41, "topn_qps_c8": 0.39}
+    assert bench.headline_mode(t) == ("sequential", 0.41)
+
+
+def test_headline_sequential_only_run(bench):
+    assert bench.headline_mode({"topn_qps": 12.5}) == ("sequential", 12.5)
+
+
+def test_best_closed_loop_ignores_non_numeric_and_other_keys(bench):
+    t = {"topn_qps": 5.0, "topn_qps_c8": 7.0, "topn_qps_c32": "err",
+         "topn_queries_timed": 99, "chain_qps_c8": 1000.0}
+    assert bench.best_closed_loop(t, "topn_qps_c") == ("topn_qps_c8", 7.0)
+    assert bench.best_closed_loop({}, "topn_qps_c") == (None, None)
+
+
+def test_vs_baseline_note_matches_mode(bench):
+    serving = bench.vs_baseline_fields("32 closed-loop clients", 112.4, 0.4)
+    assert serving["vs_baseline"] == round(112.4 / 0.4, 2)
+    assert "serving" in serving["vs_baseline_note"]
+    seq = bench.vs_baseline_fields("sequential", 12.5, 0.4)
+    assert "sequential qps both sides" in seq["vs_baseline_note"]
+    assert bench.vs_baseline_fields("sequential", 12.5, None) == {}
