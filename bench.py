@@ -191,6 +191,15 @@ def main():
             # dict(result) is one C-level copy (atomic under the GIL);
             # dumping the live dict could race a concurrent update
             snapshot = dict(result)
+            # the same metric names the server exports at /metrics
+            # (pilosa_tpu/utils/metrics.py) — whatever the in-process
+            # executor/batcher/stager instrumentation observed this run
+            try:
+                from pilosa_tpu.utils import metrics as _metrics
+
+                snapshot["metrics"] = _metrics.snapshot()
+            except Exception:
+                pass
             # a result without a measured headline must never be
             # persisted over the last COMPLETE measurement
             if not final or snapshot.get("value", 0.0) == 0.0:

@@ -671,12 +671,22 @@ def main():
             except Exception as e:
                 print(f"{fn.__name__} failed: {type(e).__name__}: {e}", file=sys.stderr)
                 all_ok = False
+    # same names as the server's /metrics surface (one shared registry,
+    # pilosa_tpu/utils/metrics.py): the whole gauntlet ran in-process,
+    # so routing/batcher/stager/cache counters cover every config above
+    try:
+        from pilosa_tpu.utils import metrics as _metrics
+
+        gauntlet_metrics = _metrics.snapshot()
+    except Exception:
+        gauntlet_metrics = {}
     print(
         json.dumps(
             {
                 "config": "gauntlet_summary",
                 "all_bit_identical": all_ok,
                 "wall_s": round(time.time() - t0, 1),
+                "metrics": gauntlet_metrics,
             }
         )
     )

@@ -96,6 +96,18 @@ def main(argv=None) -> int:
     p.add_argument("files", nargs="+")
     p.set_defaults(fn=cmd_inspect)
 
+    p = sub.add_parser(
+        "metrics",
+        help="fetch a node's Prometheus /metrics (or recent query traces)",
+    )
+    p.add_argument("--host", default="http://localhost:10101")
+    p.add_argument(
+        "--traces",
+        action="store_true",
+        help="fetch /debug/traces (recent query span trees) instead",
+    )
+    p.set_defaults(fn=cmd_metrics)
+
     p = sub.add_parser("config", help="print the effective configuration")
     p.add_argument("-c", "--config", help="TOML config file")
     p.set_defaults(fn=cmd_config)
@@ -407,6 +419,20 @@ def cmd_inspect(args) -> int:
         for key in b._iter_keys_sorted():
             c = b.containers[key]
             print(f"{key:>12} {names.get(c.typ, '?'):>8} {c.n:>8} {c.size():>8}")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    """Dump a node's observability surface: Prometheus text from
+    /metrics, or the recent-trace ring buffer with --traces."""
+    host = args.host if args.host.startswith("http") else f"http://{args.host}"
+    path = "/debug/traces" if args.traces else "/metrics"
+    with urllib.request.urlopen(host + path, timeout=60) as resp:
+        body = resp.read().decode()
+    if args.traces:
+        print(json.dumps(json.loads(body), indent=2))
+    else:
+        print(body, end="")
     return 0
 
 

@@ -13,6 +13,8 @@ import time
 from collections import OrderedDict
 from typing import Optional
 
+from pilosa_tpu.utils import metrics
+
 # reference cache.go:29-31
 THRESHOLD_FACTOR = 1.1
 # reference field.go:38-44
@@ -115,7 +117,12 @@ class RankCache:
         self._dirty = True
 
     def get(self, id_: int) -> int:
-        return self.entries.get(id_, 0)
+        n = self.entries.get(id_)
+        if n is None:
+            metrics.count(metrics.CACHE_MISSES)
+            return 0
+        metrics.count(metrics.CACHE_HITS)
+        return n
 
     def remove(self, id_: int) -> None:
         if self.entries.pop(id_, None) is not None:
@@ -200,8 +207,10 @@ class LRUCache:
     def get(self, id_: int) -> int:
         n = self._lru.get(id_)
         if n is None:
+            metrics.count(metrics.CACHE_MISSES)
             return 0
         self._lru.move_to_end(id_)
+        metrics.count(metrics.CACHE_HITS)
         return n
 
     def remove(self, id_: int) -> None:

@@ -22,11 +22,13 @@ tunneled chip, whose device→host transfers serialize).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 import numpy as np
 
 from pilosa_tpu import ops
+from pilosa_tpu.utils import metrics, trace
 
 
 def _next_pow2(n: int) -> int:
@@ -129,6 +131,8 @@ class BatchedScorer:
         avg batch 3.4 at c8/c32 on the 1B config, with the RTT channel
         saturated by small batches.
         """
+        sp = trace.current()
+        t0 = time.monotonic()
         slot = _Slot(src)
         with self._lock:
             ent = self._pending.get(key)
@@ -142,7 +146,16 @@ class BatchedScorer:
                 self._dispatching = lead = True
         if lead:
             self._dispatch_loop(own=slot)
-        return slot.finish(self)
+        out = slot.finish(self)
+        wait = time.monotonic() - t0
+        metrics.observe(metrics.BATCHER_SLOT_WAIT_SECONDS, wait)
+        if sp is not None:
+            # backfill a span covering enqueue -> result (the wait was
+            # spent inside finish(), so enter/exit timing can't be used)
+            ev = sp.child(metrics.STAGE_BATCH_SCORE, lead=lead)
+            ev.t0 = t0
+            ev.duration = wait
+        return out
 
     def _rescue(self) -> None:
         """Adopt an orphaned queue (no active dispatcher but pending
@@ -151,6 +164,7 @@ class BatchedScorer:
             if self._dispatching or not self._pending:
                 return
             self._dispatching = True
+        metrics.count(metrics.BATCHER_RESCUES)
         self._dispatch_loop(own=None)
 
     def _dispatch_loop(self, own: Optional[_Slot] = None) -> None:
@@ -234,6 +248,8 @@ class BatchedScorer:
         launched: list[tuple[list[_Slot], object]] = []
         try:
             self.dispatches += 1
+            metrics.count(metrics.BATCHER_DISPATCHES)
+            metrics.observe(metrics.BATCHER_BATCH_SIZE, len(batch))
             if len(batch) == 1:
                 launched.append(
                     (batch, self._single_fn(batch[0].src, mat))

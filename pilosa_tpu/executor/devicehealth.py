@@ -38,6 +38,8 @@ from concurrent.futures import (
 )
 from typing import Callable, Optional
 
+from pilosa_tpu.utils import metrics
+
 
 class DeviceDown(Exception):
     """Raised to the caller when the device is gated off or a guarded
@@ -143,6 +145,7 @@ class DeviceHealth:
         if not started.wait(timeout=min(timeout, self.admission_timeout_s)):
             fut.cancel()
             self.saturations += 1
+            metrics.count(metrics.DEVICEHEALTH_SATURATIONS)
             if self._probe_once():
                 raise DeviceDown("guard pool saturated (device alive)")
             self._trip("guard pool saturated and probe failed")
@@ -159,6 +162,7 @@ class DeviceHealth:
                     # device answers: the call is slow, not stuck —
                     # extend and keep waiting
                     self.slow_calls += 1
+                    metrics.count(metrics.DEVICEHEALTH_SLOW_CALLS)
                     continue
                 self._trip("device probe failed after call deadline")
                 raise DeviceDown("device call timed out and probe failed")
@@ -176,6 +180,7 @@ class DeviceHealth:
                 return
             self._healthy = False
             self.trips += 1
+            metrics.count(metrics.DEVICEHEALTH_TRIPS)
             pool, self._pool = self._pool, None
             if not self._probing:
                 self._probing = True
@@ -222,6 +227,7 @@ class DeviceHealth:
                     self._healthy = True
                     self.restores += 1
                     self._probing = False
+                metrics.count(metrics.DEVICEHEALTH_RESTORES)
                 self._log("device health: restored (trip #%d)", self.trips)
                 return
             # probe hung or failed: thread abandoned, loop again

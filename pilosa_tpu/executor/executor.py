@@ -21,11 +21,14 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from dataclasses import dataclass
 from datetime import datetime
 from typing import Any, Callable, Optional
 
 import numpy as np
+
+from pilosa_tpu.utils import metrics, trace
 
 from pilosa_tpu import SHARD_WIDTH, ops
 from pilosa_tpu.core import Row, TopOptions, VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD
@@ -213,6 +216,35 @@ def _make_stacked_scorer() -> BatchedScorer:
     )
 
 
+def _timed_kernel(kind: str, fn):
+    """Wrap a cached jitted kernel with the compile-vs-execute timing
+    split: the FIRST invocation traces + compiles inside XLA (observed
+    as spmd.compile_seconds), warm invocations are dispatch only
+    (spmd.execute_seconds). When the caller is traced, each invocation
+    also lands as a spmd.kernel span."""
+
+    state = {"first": True}
+
+    def run(*args, **kw):
+        t0 = time.monotonic()
+        out = fn(*args, **kw)
+        dt = time.monotonic() - t0
+        first = state["first"]
+        if first:
+            state["first"] = False
+            metrics.observe(metrics.SPMD_COMPILE_SECONDS, dt, kind=kind)
+        else:
+            metrics.observe(metrics.SPMD_EXECUTE_SECONDS, dt, kind=kind)
+        sp = trace.current()
+        if sp is not None:
+            ev = sp.child(metrics.STAGE_SPMD_KERNEL, kind=kind, first=first)
+            ev.t0 = t0
+            ev.duration = dt
+        return out
+
+    return run
+
+
 class Executor:
     def __init__(
         self,
@@ -307,6 +339,7 @@ class Executor:
                     fn = spmd.topn_scores_sparse_spmd(self.mesh, *statics)
                 else:
                     raise ValueError(kind)
+                fn = _timed_kernel(kind, fn)
                 self._spmd_kernels[key] = fn
             return fn
 
@@ -323,6 +356,19 @@ class Executor:
     # -- entry point (reference Execute, executor.go:83) ---------------------
 
     def execute(
+        self,
+        index_name: str,
+        query,
+        shards: Optional[list[int]] = None,
+        opt: Optional[ExecOptions] = None,
+    ) -> list[Any]:
+        sp = trace.current()
+        if sp is None:  # untraced: no span objects anywhere below
+            return self._execute(index_name, query, shards, opt)
+        with sp.child(metrics.STAGE_EXECUTOR, index=index_name):
+            return self._execute(index_name, query, shards, opt)
+
+    def _execute(
         self,
         index_name: str,
         query,
@@ -362,12 +408,13 @@ class Executor:
                         max_workers=16, thread_name_prefix="pql-read"
                     )
                 pool = self._read_pool  # local ref: close() may null the attr
-            results = list(
-                pool.map(
-                    lambda call: self._execute_call(index_name, call, shards, opt),
-                    query.calls,
-                )
-            )
+            parent = trace.current()  # contextvars don't follow pool workers
+
+            def run_call(call):
+                with trace.activate(parent):
+                    return self._execute_call(index_name, call, shards, opt)
+
+            results = list(pool.map(run_call, query.calls))
         else:
             results = []
             for call in query.calls:
@@ -492,6 +539,14 @@ class Executor:
         self.stager.reset_after_wedge()
 
     def _execute_call(self, index, c: Call, shards, opt) -> Any:
+        metrics.count(metrics.EXECUTOR_CALLS, call=c.name)
+        sp = trace.current()
+        if sp is None:
+            return self._execute_call_guarded(index, c, shards, opt)
+        with sp.child(metrics.STAGE_CALL, call=c.name):
+            return self._execute_call_guarded(index, c, shards, opt)
+
+    def _execute_call_guarded(self, index, c: Call, shards, opt) -> Any:
         """Read calls run under the device health gate when one is
         configured: a wedged accelerator trips the gate and the same
         call re-runs on the CPU roaring path (reads are pure — safe to
@@ -506,13 +561,20 @@ class Executor:
             and c.name not in WRITE_CALLS
             and not self._cpu_forced()
         ):
+            # the guard pool is another thread: hand the active span over
+            parent = trace.current()
             try:
                 return self.health.guard(
-                    lambda: self._execute_call_inner(index, c, shards, opt)
+                    lambda: self._execute_call_inner_on(parent, index, c, shards, opt)
                 )
             except DeviceDown:
-                pass  # gate now closed; fall through to the CPU path
+                # gate now closed; fall through to the CPU path
+                metrics.count(metrics.EXECUTOR_DEVICE_DOWN_FALLBACK)
         return self._execute_call_inner(index, c, shards, opt)
+
+    def _execute_call_inner_on(self, parent, index, c, shards, opt) -> Any:
+        with trace.activate(parent):
+            return self._execute_call_inner(index, c, shards, opt)
 
     def _execute_call_inner(self, index, c: Call, shards, opt) -> Any:
         name = c.name
@@ -556,8 +618,15 @@ class Executor:
                 index, shards, c, opt, map_fn, reduce_fn, zero_factory
             )
         result = zero_factory() if zero_factory else None
+        # captured ONCE: the untraced loop body pays a single branch per
+        # shard, no span objects (ISSUE 1 overhead bound)
+        parent = trace.current()
         for shard in shards:
-            v = map_fn(shard)
+            if parent is not None:
+                with parent.child(metrics.STAGE_MAP_SHARD, shard=shard):
+                    v = map_fn(shard)
+            else:
+                v = map_fn(shard)
             result = v if result is None else reduce_fn(result, v)
         return result
 
@@ -727,6 +796,22 @@ class Executor:
     # -- device path ---------------------------------------------------------
 
     def _use_device(self, index, c: Call, shard: int) -> bool:
+        use = self._use_device_decide(index, c, shard)
+        metrics.count(
+            metrics.EXECUTOR_ROUTE_DEVICE if use else metrics.EXECUTOR_ROUTE_CPU,
+            call=c.name,
+        )
+        sp = trace.current()
+        if sp is not None:
+            sp.event(
+                metrics.STAGE_ROUTE,
+                call=c.name,
+                shard=shard,
+                path="device" if use else "cpu",
+            )
+        return use
+
+    def _use_device_decide(self, index, c: Call, shard: int) -> bool:
         if self.device_policy == "never" or self._cpu_forced():
             return False
         if self.device_policy == "always":
@@ -901,6 +986,22 @@ class Executor:
         return self.cluster is None or opt.remote
 
     def _use_device_batched(self, index, c: Call, shards) -> bool:
+        use = self._use_device_batched_decide(index, c, shards)
+        metrics.count(
+            metrics.EXECUTOR_ROUTE_DEVICE if use else metrics.EXECUTOR_ROUTE_CPU,
+            call=c.name,
+        )
+        sp = trace.current()
+        if sp is not None:
+            sp.event(
+                metrics.STAGE_ROUTE,
+                call=c.name,
+                shards=len(shards),
+                path="device" if use else "cpu",
+            )
+        return use
+
+    def _use_device_batched_decide(self, index, c: Call, shards) -> bool:
         if self.device_policy == "never" or len(shards) < 2 or self._cpu_forced():
             return False
         if self.device_policy == "always":
@@ -933,8 +1034,9 @@ class Executor:
         key = repr(tree)
         fn = self._tree_jits.get(key)
         if fn is None:
-            fn = jax.jit(
-                lambda *ls: ops.count_bits(_eval_tree(tree, ls))[None]
+            fn = _timed_kernel(
+                "tree_count",
+                jax.jit(lambda *ls: ops.count_bits(_eval_tree(tree, ls))[None]),
             )
             self._tree_jits[key] = fn
         return fn
@@ -964,7 +1066,7 @@ class Executor:
                 pc = jax.lax.population_count(acc).astype(jnp.int32)
                 return jnp.sum(pc, axis=tuple(range(1, pc.ndim)))
 
-            fn = jax.jit(run)
+            fn = _timed_kernel("tree_count_batch", jax.jit(run))
             self._tree_batch_jits[key] = fn
         return fn
 
@@ -1120,38 +1222,8 @@ class Executor:
             and self._use_device_batched(index, child, shards)
         ):
             try:
-                batch = self._shard_plan(shards)
-                if self.mesh is not None:
-                    words = self._device_bitmap_stack(index, child, batch)
-                    return int(self._spmd_kernel("count")(words))
-                # One fused program per query-tree structure: boolean
-                # internal nodes trace into a single jit so the whole
-                # chain is one XLA fusion + one dispatch, instead of an
-                # eager op (= a host round-trip on tunneled chips) per
-                # tree node (SURVEY.md §7 step 4).
-                #
-                # Default: per-query dispatch. Measured A/B on the
-                # tunneled chip (c64 closed-loop, warm): direct 671 qps
-                # vs coalesced 235-297 — the tunnel pipelines ~50
-                # independent RPCs while the scorer's drain rounds
-                # serialize on one fetch chain, and the chain kernel is
-                # too cheap (~0.1 ms) for batching to amortize anything
-                # (unlike TopN's matrix scan). PILOSA_CHAIN_BATCH=1
-                # opts into coalescing for deployments where dispatch
-                # COST (not round-trip pipelining) dominates; each slot
-                # carries its own staged leaf snapshot, so coalescing
-                # never changes which data a query counts.
-                leaves, tree = self._tree_leaves(index, child, batch)
-                if self._chain_batch:
-                    key = (
-                        "chain",
-                        repr(tree),
-                        tuple(getattr(a, "shape", None) for a in leaves),
-                    )
-                    res = self.chain_scorer.score(key, tree, tuple(leaves))
-                else:
-                    res = self._tree_count_jit(tree)(*leaves)
-                return int(np.asarray(res).reshape(-1)[0])
+                with trace.child(metrics.STAGE_DEVICE_BATCH, call="Count"):
+                    return self._count_device_batched(index, child, shards)
             except _NotDeviceable:
                 pass
 
@@ -1168,6 +1240,40 @@ class Executor:
             index, shards, c, opt, map_fn, lambda a, b: a + b, zero_factory=lambda: 0
         )
         return int(result or 0)
+
+    def _count_device_batched(self, index, child, shards) -> int:
+        batch = self._shard_plan(shards)
+        if self.mesh is not None:
+            words = self._device_bitmap_stack(index, child, batch)
+            return int(self._spmd_kernel("count")(words))
+        # One fused program per query-tree structure: boolean
+        # internal nodes trace into a single jit so the whole
+        # chain is one XLA fusion + one dispatch, instead of an
+        # eager op (= a host round-trip on tunneled chips) per
+        # tree node (SURVEY.md §7 step 4).
+        #
+        # Default: per-query dispatch. Measured A/B on the
+        # tunneled chip (c64 closed-loop, warm): direct 671 qps
+        # vs coalesced 235-297 — the tunnel pipelines ~50
+        # independent RPCs while the scorer's drain rounds
+        # serialize on one fetch chain, and the chain kernel is
+        # too cheap (~0.1 ms) for batching to amortize anything
+        # (unlike TopN's matrix scan). PILOSA_CHAIN_BATCH=1
+        # opts into coalescing for deployments where dispatch
+        # COST (not round-trip pipelining) dominates; each slot
+        # carries its own staged leaf snapshot, so coalescing
+        # never changes which data a query counts.
+        leaves, tree = self._tree_leaves(index, child, batch)
+        if self._chain_batch:
+            key = (
+                "chain",
+                repr(tree),
+                tuple(getattr(a, "shape", None) for a in leaves),
+            )
+            res = self.chain_scorer.score(key, tree, tuple(leaves))
+        else:
+            res = self._tree_count_jit(tree)(*leaves)
+        return int(np.asarray(res).reshape(-1)[0])
 
     # -- Sum / Min / Max -----------------------------------------------------
 
@@ -1218,39 +1324,11 @@ class Executor:
                     for s in batch
                 )
                 if any(frags):
-                    depth = bsig.bit_depth()
                     try:
-                        if len(c.children) == 1:
-                            filt = self._device_bitmap_stack(
-                                index, c.children[0], batch
+                        with trace.child(metrics.STAGE_DEVICE_BATCH, call="Sum"):
+                            return self._sum_device_batched(
+                                index, c, batch, bsig, frags
                             )
-                            has_filter = True
-                        else:
-                            filt = np.zeros(
-                                (len(batch), _W32), dtype=np.uint32
-                            )
-                            has_filter = False
-                        planes = self.stager.planes_stack(frags, depth)
-                        if self.mesh is not None:
-                            counts = np.asarray(
-                                self._spmd_kernel(
-                                    "plane_counts", depth, has_filter
-                                )(planes, filt)
-                            )
-                        else:
-                            counts = np.asarray(
-                                ops.bsi_plane_counts_batched(
-                                    planes,
-                                    filt,
-                                    bit_depth=depth,
-                                    has_filter=has_filter,
-                                )
-                            )
-                        vsum = sum(int(counts[i]) << i for i in range(depth))
-                        vcount = int(counts[depth])
-                        if vcount == 0:
-                            return ValCount()
-                        return ValCount(vsum + vcount * bsig.min, vcount)
                     except _NotDeviceable:
                         pass
 
@@ -1288,6 +1366,31 @@ class Executor:
         if result is None or result.count == 0:
             return ValCount()
         return result
+
+    def _sum_device_batched(self, index, c: Call, batch, bsig, frags) -> ValCount:
+        depth = bsig.bit_depth()
+        if len(c.children) == 1:
+            filt = self._device_bitmap_stack(index, c.children[0], batch)
+            has_filter = True
+        else:
+            filt = np.zeros((len(batch), _W32), dtype=np.uint32)
+            has_filter = False
+        planes = self.stager.planes_stack(frags, depth)
+        if self.mesh is not None:
+            counts = np.asarray(
+                self._spmd_kernel("plane_counts", depth, has_filter)(planes, filt)
+            )
+        else:
+            counts = np.asarray(
+                ops.bsi_plane_counts_batched(
+                    planes, filt, bit_depth=depth, has_filter=has_filter
+                )
+            )
+        vsum = sum(int(counts[i]) << i for i in range(depth))
+        vcount = int(counts[depth])
+        if vcount == 0:
+            return ValCount()
+        return ValCount(vsum + vcount * bsig.min, vcount)
 
     def _execute_min(self, index, c: Call, shards, opt) -> ValCount:
         return self._execute_minmax(index, c, shards, opt, is_min=True)
@@ -1374,9 +1477,14 @@ class Executor:
             and self._use_device_batched(index, c, shards)
         ):
             try:
-                if self.mesh is not None:
-                    return sort_pairs(self._topn_shards_spmd(index, c, shards, carry))
-                return sort_pairs(self._topn_shards_batched(index, c, shards, carry))
+                with trace.child(metrics.STAGE_DEVICE_BATCH, call="TopN"):
+                    if self.mesh is not None:
+                        return sort_pairs(
+                            self._topn_shards_spmd(index, c, shards, carry)
+                        )
+                    return sort_pairs(
+                        self._topn_shards_batched(index, c, shards, carry)
+                    )
             except _NotDeviceable:
                 pass
 

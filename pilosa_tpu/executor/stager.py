@@ -18,6 +18,7 @@ residency (SURVEY.md §7 hard part 2).
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Optional
 
@@ -25,6 +26,7 @@ import jax
 import numpy as np
 
 from pilosa_tpu import SHARD_WIDTH
+from pilosa_tpu.utils import metrics, trace
 
 
 class _InFlight:
@@ -76,6 +78,7 @@ class DeviceStager:
             if ent is not None:
                 self._cache.move_to_end(key)
                 self.hits += 1
+                metrics.count(metrics.STAGER_HITS)
                 return ent[0]
             epoch = self._epoch
             fl = self._inflight.get(key)
@@ -91,7 +94,15 @@ class DeviceStager:
                 raise fl.error
             return fl.value
         try:
-            value, nbytes = builder()
+            t0 = time.monotonic()
+            sp = trace.current()
+            if sp is None:
+                value, nbytes = builder()
+            else:
+                with sp.child(metrics.STAGE_STAGE) as ssp:
+                    value, nbytes = builder()
+                    ssp.annotate(nbytes=nbytes)
+            metrics.observe(metrics.STAGER_STAGE_SECONDS, time.monotonic() - t0)
         except BaseException as e:
             with self._mu:
                 # identity check mirrors the success path: an
@@ -102,6 +113,7 @@ class DeviceStager:
             fl.error = e
             fl.event.set()
             raise
+        metrics.count(metrics.STAGER_MISSES)
         with self._mu:
             self.misses += 1
             if self._epoch == epoch:
@@ -111,6 +123,7 @@ class DeviceStager:
                     _, (_, old_bytes) = self._cache.popitem(last=False)
                     self._bytes -= old_bytes
                 self._inflight.pop(key, None)
+                metrics.gauge(metrics.STAGER_BYTES, self._bytes)
             elif self._inflight.get(key) is fl:
                 # same epoch-stale builder still registered (no rebuild
                 # raced in): unregister without caching the stale value

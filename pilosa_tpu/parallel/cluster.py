@@ -29,6 +29,7 @@ from typing import Callable, Optional
 from pilosa_tpu.parallel.client import ClientError, InternalClient
 from pilosa_tpu.parallel.hashing import DEFAULT_PARTITION_N, Jmphasher, partition
 from pilosa_tpu.parallel.node import Node
+from pilosa_tpu.utils import metrics, trace
 from pilosa_tpu.utils.errors import NotFoundError
 from pilosa_tpu.parallel.wire import (
     decode_shard_result,
@@ -683,6 +684,9 @@ class Cluster:
         result = zero_factory() if zero_factory else None
         pending = shards
         banned_nodes: set[str] = set()
+        # the pool workers don't inherit this thread's contextvars: hand
+        # the active span (None when untraced) to each leg explicitly
+        parent = trace.current()
         while pending:
             by_node = self._shards_by_node(index, pending, banned_nodes)
             if pending and not by_node:
@@ -693,14 +697,15 @@ class Cluster:
                 if node.id == self.node_id:
                     futures.append(
                         (node, node_shards, self._pool.submit(
-                            self._map_local, node_shards, map_fn, reduce_fn,
-                            zero_factory,
+                            self._map_local_leg, parent, node_shards, map_fn,
+                            reduce_fn, zero_factory,
                         ))
                     )
                 else:
                     futures.append(
                         (node, node_shards, self._pool.submit(
-                            self._map_remote, node, index, c, node_shards
+                            self._map_remote_leg, parent, node, index, c,
+                            node_shards,
                         ))
                     )
             for node, node_shards, fut in futures:
@@ -713,6 +718,7 @@ class Cluster:
                     # — an HTTP error or slow query proves the node is
                     # alive, just unable to serve this request.
                     banned_nodes.add(node.id)
+                    metrics.count(metrics.CLUSTER_REMOTE_ERRORS, node=node.uri)
                     if getattr(e, "transport", isinstance(e, ConnectionError)):
                         self._note_probe(node, False)
                     next_pending.extend(node_shards)
@@ -747,12 +753,42 @@ class Cluster:
             by_id.setdefault(node.id, (node, []))[1].append(shard)
         return list(by_id.values())
 
+    def _map_local_leg(self, parent, shards, map_fn, reduce_fn, zero_factory=None):
+        if parent is None:
+            return self._map_local(shards, map_fn, reduce_fn, zero_factory)
+        with parent.child(metrics.STAGE_MAP_LOCAL, shards=len(shards)):
+            return self._map_local(shards, map_fn, reduce_fn, zero_factory)
+
     def _map_local(self, shards, map_fn, reduce_fn, zero_factory=None):
         result = zero_factory() if zero_factory else None
+        parent = trace.current()  # single branch per shard when untraced
         for shard in shards:
-            v = map_fn(shard)
+            if parent is not None:
+                with parent.child(metrics.STAGE_MAP_SHARD, shard=shard):
+                    v = map_fn(shard)
+            else:
+                v = map_fn(shard)
             result = v if result is None else reduce_fn(result, v)
         return result
+
+    def _map_remote_leg(self, parent, node, index, c, shards):
+        """Remote leg wrapper: per-node fan-out RPC latency lands in
+        cluster.map_remote_seconds (label node) and, when the query is
+        traced, as a cluster.map_remote span."""
+        t0 = time.monotonic()
+        try:
+            if parent is None:
+                return self._map_remote(node, index, c, shards)
+            with parent.child(
+                metrics.STAGE_MAP_REMOTE, node=node.uri, shards=len(shards)
+            ):
+                return self._map_remote(node, index, c, shards)
+        finally:
+            metrics.observe(
+                metrics.CLUSTER_MAP_REMOTE_SECONDS,
+                time.monotonic() - t0,
+                node=node.uri,
+            )
 
     def _map_remote(self, node, index, c, shards):
         """Remote leg: ship the call string; decode the single result

@@ -18,6 +18,7 @@ from pilosa_tpu.core import FieldOptions, Row
 from pilosa_tpu.core.view import VIEW_STANDARD
 from pilosa_tpu.executor import ExecOptions
 from pilosa_tpu.pql import parse
+from pilosa_tpu.utils import metrics, trace
 
 # cluster states (reference cluster.go:42-45)
 STATE_STARTING = "STARTING"
@@ -93,6 +94,7 @@ class API:
         exclude_row_attrs: bool = False,
         exclude_columns: bool = False,
         column_attrs: bool = False,
+        profile: bool = False,
     ) -> dict:
         self._validate("query")
         opt = ExecOptions(
@@ -100,15 +102,22 @@ class API:
             exclude_row_attrs=exclude_row_attrs,
             exclude_columns=exclude_columns,
         )
-        try:
-            q = parse(query)
-        except Exception as e:
-            raise APIError(f"parsing: {e}") from e
-        idx = self.holder.index(index)
-        if idx is None:
-            raise NotFoundError(f"index not found: {index}")
-        results = self.executor.execute(index, q, shards, opt)
+        # root span: forced by profile=true, else admitted by the
+        # tracer's sample rate / slow-query threshold (NOP when off —
+        # the untraced query allocates no span anywhere below)
+        root = trace.TRACER.trace(metrics.STAGE_QUERY, force=profile, index=index)
+        with root:
+            try:
+                q = parse(query)
+            except Exception as e:
+                raise APIError(f"parsing: {e}") from e
+            idx = self.holder.index(index)
+            if idx is None:
+                raise NotFoundError(f"index not found: {index}")
+            results = self.executor.execute(index, q, shards, opt)
         resp: dict = {"results": results}
+        if profile:
+            resp["profile"] = root.to_dict()
         if column_attrs and idx.column_attrs is not None:
             cols = set()
             for r in results:

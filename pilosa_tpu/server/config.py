@@ -8,9 +8,13 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
 from dataclasses import dataclass, field
 from typing import Optional
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11
+    import tomli as tomllib
 
 
 @dataclass
@@ -78,6 +82,13 @@ class Config:
     cache_flush_interval: float = 60.0  # reference holder.go:37 (1m)
     metric: str = "expvar"  # expvar | statsd | none
     metric_host: str = "127.0.0.1:8125"  # statsd UDP address
+    # observability (utils/trace.py): fraction of queries traced into
+    # the /debug/traces ring buffer (0 = off; profile=true always traces)
+    trace_sample_rate: float = 0.0
+    # seconds; > 0 traces EVERY query and logs the full span tree of any
+    # query over the threshold (0 = off). Complementary to
+    # cluster.long-query-time, which logs only the query text.
+    slow_query_time: float = 0.0
     # opt-in diagnostics phone-home endpoint (reference diagnostics.go);
     # empty = disabled
     diagnostics_host: str = ""
@@ -148,6 +159,8 @@ class Config:
             if isinstance(self.mesh_devices, str)
             else f"mesh-devices = {self.mesh_devices}",
             f'metric = "{self.metric}"',
+            f"trace-sample-rate = {self.trace_sample_rate}",
+            f"slow-query-time = {self.slow_query_time}",
             f"anti-entropy-interval = {self.anti_entropy_interval}",
             "",
             "[cluster]",

@@ -20,7 +20,7 @@ from pilosa_tpu.core import Row
 from pilosa_tpu.executor import ValCount
 from pilosa_tpu.server.api import API, APIError
 from pilosa_tpu.utils.errors import NotFoundError as ExecNotFound
-from pilosa_tpu.utils import privateproto, publicproto
+from pilosa_tpu.utils import metrics, privateproto, publicproto, trace
 from pilosa_tpu.utils.stats import NOP_STATS
 
 
@@ -158,7 +158,9 @@ class Handler:
                 r"/internal/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/attr/diff",
                 self.post_row_attr_diff,
             ),
+            Route("GET", r"/metrics", self.get_metrics),
             Route("GET", r"/debug/vars", self.get_debug_vars),
+            Route("GET", r"/debug/traces", self.get_debug_traces),
             # index (with and without trailing slash, as net/http/pprof
             # serves it) plus the thread-dump profile; unknown names 404
             Route("GET", r"/debug/pprof/?", self.get_debug_pprof),
@@ -189,6 +191,7 @@ class Handler:
             exclude_row_attrs = q.get("excludeRowAttrs", ["false"])[0] == "true"
             exclude_columns = q.get("excludeColumns", ["false"])[0] == "true"
             column_attrs = q.get("columnAttrs", ["false"])[0] == "true"
+        profile = q.get("profile", ["false"])[0] == "true"
         t0 = time.monotonic()
         resp = self.api.query(
             index,
@@ -198,16 +201,20 @@ class Handler:
             exclude_row_attrs=exclude_row_attrs,
             exclude_columns=exclude_columns,
             column_attrs=column_attrs,
+            profile=profile,
         )
         dur = time.monotonic() - t0
         # slow-query logging (reference handler.go:257-261)
         if self.long_query_time and dur > self.long_query_time and self.logger:
             self.logger.printf("%.3fs SLOW QUERY %s %s", dur, index, body[:500])
-            self.stats.count("slow_query", 1)
-        self.stats.with_tags(f"index:{index}").timing("query_time", dur)
+            self.stats.count(metrics.SLOW_QUERY, 1)
+        self.stats.with_tags(f"index:{index}").timing(metrics.QUERY_TIME, dur)
         out = {"results": [encode_result(r) for r in resp["results"]]}
         if "columnAttrs" in resp:
             out["columnAttrs"] = resp["columnAttrs"]
+        if "profile" in resp:
+            # JSON-only: the protobuf QueryResponse has no profile field
+            out["profile"] = resp["profile"]
         if req.accepts_proto:
             return RawResponse(
                 publicproto.encode_query_response(
@@ -453,8 +460,23 @@ class Handler:
             )
         }
 
+    def _expvar_snapshot(self) -> dict:
+        """The server's in-process stats snapshot: prefer the always-kept
+        ExpvarStatsClient (lit even when the configured sink is statsd),
+        falling back to whatever snapshot the handler's stats offer."""
+        server = getattr(self.api, "server", None)
+        ev = getattr(server, "_expvar", None)
+        if ev is not None:
+            return ev.snapshot()
+        if hasattr(self.stats, "snapshot"):
+            return self.stats.snapshot()
+        return {}
+
     def get_debug_vars(self, req) -> dict:
-        out = self.stats.snapshot() if hasattr(self.stats, "snapshot") else {}
+        out = self._expvar_snapshot()
+        # process-global registry (executor routing, batcher, stager,
+        # caches, device health, cluster fan-out)
+        out["metrics"] = metrics.snapshot()
         health = getattr(self.api.executor, "health", None)
         if health is not None:
             out["device_health"] = {
@@ -466,6 +488,30 @@ class Handler:
                 "restore_failures": health.restore_failures,
             }
         return out
+
+    def get_metrics(self, req):
+        """Prometheus text exposition: the process-global registry
+        merged with this server's expvar snapshot plus scrape-time
+        freshness gauges (device health, HBM staging residency)."""
+        health = getattr(self.api.executor, "health", None)
+        if health is not None:
+            metrics.gauge(
+                metrics.DEVICEHEALTH_HEALTHY, 1.0 if health.healthy else 0.0
+            )
+        stager = getattr(self.api.executor, "stager", None)
+        if stager is not None:
+            metrics.gauge(metrics.STAGER_BYTES, stager._bytes)
+        text = metrics.render_prometheus(
+            extra_snapshots=[self._expvar_snapshot()]
+        )
+        return RawResponse(
+            text.encode(), "text/plain; version=0.0.4; charset=utf-8"
+        )
+
+    def get_debug_traces(self, req) -> dict:
+        """Recent completed query traces (the tracer's ring buffer) as
+        JSON span trees, newest last."""
+        return {"traces": trace.TRACER.recent()}
 
     def get_debug_pprof(self, req):
         """Live thread stack dump — the CPython analog of the reference's

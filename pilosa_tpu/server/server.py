@@ -23,8 +23,14 @@ from pilosa_tpu import __version__
 from pilosa_tpu.utils.attrstore import new_attr_store
 from pilosa_tpu.utils.diagnostics import DiagnosticsCollector
 from pilosa_tpu.utils.logger import NOP_LOGGER, StandardLogger
+from pilosa_tpu.utils import metrics, trace
 from pilosa_tpu.utils.gcnotify import GCNotifier
-from pilosa_tpu.utils.stats import ExpvarStatsClient, NOP_STATS, StatsDClient
+from pilosa_tpu.utils.stats import (
+    ExpvarStatsClient,
+    MultiStatsClient,
+    NOP_STATS,
+    StatsDClient,
+)
 from pilosa_tpu.utils.translate import TranslateStore
 
 
@@ -74,15 +80,40 @@ class Server:
             else NOP_LOGGER
         )
         # reference server/server.go:353-364 (expvar/statsd/none selection;
-        # unknown names error there too)
+        # unknown names error there too). An in-process ExpvarStatsClient
+        # is ALWAYS kept so /debug/vars and /metrics have a snapshot:
+        # with the statsd sink, stats fan out to both.
+        self._expvar = ExpvarStatsClient()
         if self.config.metric == "expvar":
-            self.stats = ExpvarStatsClient()
+            self.stats = self._expvar
         elif self.config.metric == "statsd":
-            self.stats = StatsDClient(host=self.config.metric_host)
+            self.stats = MultiStatsClient(
+                self._expvar, StatsDClient(host=self.config.metric_host)
+            )
         elif self.config.metric in ("none", "nop", ""):
             self.stats = NOP_STATS
         else:
             raise ValueError(f"invalid metric service: {self.config.metric!r}")
+        # tracer knobs (process-global tracer: the last server configured
+        # in-process wins — one server per process in any real deployment)
+        tracer = trace.TRACER
+        tracer.sample_rate = self.config.trace_sample_rate
+        tracer.slow_threshold = self.config.slow_query_time
+        if self.config.slow_query_time > 0:
+            import json as _json
+
+            logger = self.logger
+
+            def _log_slow(tree: dict) -> None:
+                logger.printf(
+                    "%.3fs SLOW QUERY trace %s",
+                    tree.get("duration_ms", 0.0) / 1000.0,
+                    _json.dumps(tree),
+                )
+
+            tracer.on_slow = _log_slow
+        else:
+            tracer.on_slow = None
         # only hook gc.callbacks when someone consumes the counter
         self.gc_notifier = GCNotifier() if self.stats is not NOP_STATS else None
         self.holder = Holder(
@@ -411,7 +442,7 @@ class Server:
                         t0 = time.monotonic()
                         self.cluster.sync_holder()
                         self.stats.histogram(
-                            "antiEntropyDurationSeconds", time.monotonic() - t0
+                            metrics.ANTI_ENTROPY_SECONDS, time.monotonic() - t0
                         )
                 except Exception as e:
                     self.logger.printf("anti-entropy sync error: %s", e)
@@ -424,17 +455,17 @@ class Server:
                     import resource
 
                     usage = resource.getrusage(resource.RUSAGE_SELF)
-                    self.stats.gauge("maxRSSKB", usage.ru_maxrss)
-                    self.stats.gauge("threads", threading.active_count())
+                    self.stats.gauge(metrics.MAX_RSS_KB, usage.ru_maxrss)
+                    self.stats.gauge(metrics.THREADS, threading.active_count())
                     counts = gc.get_count()
-                    self.stats.gauge("gcGen0", counts[0])
+                    self.stats.gauge(metrics.GC_GEN0, counts[0])
                     cycles = (
                         self.gc_notifier.poll() if self.gc_notifier else 0
                     )
                     if cycles:
                         # reference server.go:702-704 via gcnotify
-                        self.stats.count("garbage_collection", cycles)
-                    self.stats.gauge("openFragments", self._count_fragments())
+                        self.stats.count(metrics.GARBAGE_COLLECTION, cycles)
+                    self.stats.gauge(metrics.OPEN_FRAGMENTS, self._count_fragments())
                 except Exception:
                     pass
 

@@ -1,0 +1,393 @@
+"""Shared metric registry — ONE canonical set of metric names for the
+server's ``/metrics`` Prometheus surface, ``/debug/vars``, the bench
+scripts, and the docs table (docs/administration.md §Metric reference).
+
+Every metric name emitted anywhere in the codebase is declared in
+``METRICS`` below and referenced through the module constants; a unit
+test (tests/test_observability.py) asserts the docs table and this
+registry agree in both directions, so names cannot drift.
+
+The process-global ``REGISTRY`` aggregates counters/gauges/histograms
+from the deep layers (executor routing, batcher, stager, rank caches,
+device health, cluster fan-out) that have no reference to a Server —
+the same model as Prometheus client libraries' default registry. The
+server merges its per-instance expvar snapshot into the rendered
+exposition; bench scripts attach ``snapshot()`` to their JSON output so
+offline runs speak the same names as a live server.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from bisect import bisect_right
+from typing import Optional
+
+# -- log-spaced histogram (shared with stats.ExpvarStatsClient) ------------
+
+# Bucket upper bounds: 8 per decade, 1e-6 .. 1e7 (105 bounds) — covers
+# microsecond timings through multi-hour counts with <=33% relative
+# error per bucket, at a fixed ~1 KB per histogram.
+_HIST_BOUNDS = tuple(10.0 ** (e / 8.0) for e in range(-48, 57))
+
+
+class LogHistogram:
+    """Fixed log-spaced-bucket histogram reporting count/sum/min/max and
+    estimated p50/p95/p99 (bucket upper bound, clamped to [min, max]).
+    Not thread-safe on its own — callers hold their registry lock."""
+
+    __slots__ = ("count", "sum", "min", "max", "buckets")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.buckets = [0] * (len(_HIST_BOUNDS) + 1)
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.sum += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+        self.buckets[bisect_right(_HIST_BOUNDS, value)] += 1
+
+    def quantile(self, q: float) -> float:
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        seen = 0
+        for i, n in enumerate(self.buckets):
+            seen += n
+            if seen >= target and n:
+                hi = _HIST_BOUNDS[i] if i < len(_HIST_BOUNDS) else self.max
+                return max(self.min, min(self.max, hi))
+        return self.max
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+        }
+
+
+# -- canonical metric names ------------------------------------------------
+
+# executor
+EXECUTOR_CALLS = "executor.calls"
+EXECUTOR_ROUTE_DEVICE = "executor.route.device"
+EXECUTOR_ROUTE_CPU = "executor.route.cpu"
+EXECUTOR_DEVICE_DOWN_FALLBACK = "executor.device_down_fallback"
+SPMD_COMPILE_SECONDS = "spmd.compile_seconds"
+SPMD_EXECUTE_SECONDS = "spmd.execute_seconds"
+# batched scorers
+BATCHER_DISPATCHES = "batcher.dispatches"
+BATCHER_BATCH_SIZE = "batcher.batch_size"
+BATCHER_SLOT_WAIT_SECONDS = "batcher.slot_wait_seconds"
+BATCHER_RESCUES = "batcher.rescues"
+# HBM staging
+STAGER_HITS = "stager.hits"
+STAGER_MISSES = "stager.misses"
+STAGER_STAGE_SECONDS = "stager.stage_seconds"
+STAGER_BYTES = "stager.bytes"
+# TopN rank/LRU caches
+CACHE_HITS = "cache.hits"
+CACHE_MISSES = "cache.misses"
+# distributed map-reduce
+CLUSTER_MAP_REMOTE_SECONDS = "cluster.map_remote_seconds"
+CLUSTER_REMOTE_ERRORS = "cluster.remote_errors"
+# device health gate
+DEVICEHEALTH_HEALTHY = "devicehealth.healthy"
+DEVICEHEALTH_TRIPS = "devicehealth.trips"
+DEVICEHEALTH_RESTORES = "devicehealth.restores"
+DEVICEHEALTH_SLOW_CALLS = "devicehealth.slow_calls"
+DEVICEHEALTH_SATURATIONS = "devicehealth.saturations"
+# server-level (emitted through the server's expvar/statsd stats client;
+# merged into /metrics from the expvar snapshot)
+QUERY_TIME = "query_time"
+SLOW_QUERY = "slow_query"
+MAX_RSS_KB = "maxRSSKB"
+THREADS = "threads"
+GC_GEN0 = "gcGen0"
+GARBAGE_COLLECTION = "garbage_collection"
+OPEN_FRAGMENTS = "openFragments"
+ANTI_ENTROPY_SECONDS = "antiEntropyDurationSeconds"
+
+# name -> (prometheus type, help). "summary" renders quantiles + _sum/_count.
+METRICS: dict[str, tuple[str, str]] = {
+    EXECUTOR_CALLS: ("counter", "PQL calls executed, by call type (label: call)"),
+    EXECUTOR_ROUTE_DEVICE: (
+        "counter",
+        "per-shard routing decisions that picked the device path (label: call)",
+    ),
+    EXECUTOR_ROUTE_CPU: (
+        "counter",
+        "per-shard routing decisions that picked the CPU roaring path (label: call)",
+    ),
+    EXECUTOR_DEVICE_DOWN_FALLBACK: (
+        "counter",
+        "read calls re-run on the CPU path after the device health gate tripped",
+    ),
+    SPMD_COMPILE_SECONDS: (
+        "summary",
+        "first invocation (JIT trace + compile) of each cached kernel (label: kind)",
+    ),
+    SPMD_EXECUTE_SECONDS: (
+        "summary",
+        "warm dispatches of cached compiled kernels (label: kind)",
+    ),
+    BATCHER_DISPATCHES: (
+        "counter",
+        "kernel dispatch rounds launched by the batched scorers",
+    ),
+    BATCHER_BATCH_SIZE: ("summary", "coalesced queries per batched kernel launch"),
+    BATCHER_SLOT_WAIT_SECONDS: (
+        "summary",
+        "time a scoring request waited from enqueue to result",
+    ),
+    BATCHER_RESCUES: ("counter", "orphaned batch queues adopted by a blocked waiter"),
+    STAGER_HITS: ("counter", "HBM staging-cache hits"),
+    STAGER_MISSES: ("counter", "HBM staging-cache misses (block built + uploaded)"),
+    STAGER_STAGE_SECONDS: ("summary", "host packing + upload time per staged block"),
+    STAGER_BYTES: ("gauge", "bytes resident in the HBM staging cache"),
+    CACHE_HITS: ("counter", "TopN rank/LRU cache hits"),
+    CACHE_MISSES: ("counter", "TopN rank/LRU cache misses"),
+    CLUSTER_MAP_REMOTE_SECONDS: (
+        "summary",
+        "distributed map-reduce remote leg latency (label: node)",
+    ),
+    CLUSTER_REMOTE_ERRORS: (
+        "counter",
+        "remote map-reduce legs that failed and re-mapped onto replicas (label: node)",
+    ),
+    DEVICEHEALTH_HEALTHY: ("gauge", "1 while the device path is open, 0 while gated"),
+    DEVICEHEALTH_TRIPS: ("counter", "device health gate trips (device gated off)"),
+    DEVICEHEALTH_RESTORES: ("counter", "device health gate restores"),
+    DEVICEHEALTH_SLOW_CALLS: (
+        "counter",
+        "guarded calls past their deadline whose probe cleared the device",
+    ),
+    DEVICEHEALTH_SATURATIONS: ("counter", "guard-pool admission timeouts"),
+    QUERY_TIME: ("summary", "whole-query wall time, server-level (label: index)"),
+    SLOW_QUERY: ("counter", "queries slower than cluster.long-query-time"),
+    MAX_RSS_KB: ("gauge", "process max RSS in KB"),
+    THREADS: ("gauge", "live Python threads"),
+    GC_GEN0: ("gauge", "gc generation-0 object count"),
+    GARBAGE_COLLECTION: ("counter", "completed gc collection cycles"),
+    OPEN_FRAGMENTS: ("gauge", "fragments currently open in the holder"),
+    ANTI_ENTROPY_SECONDS: ("summary", "anti-entropy sweep duration"),
+}
+
+# -- trace stage names (pilosa_tpu/utils/trace.py span names) --------------
+
+STAGE_QUERY = "query"
+STAGE_EXECUTOR = "executor"
+STAGE_CALL = "executor.call"
+STAGE_MAP_SHARD = "executor.map_shard"
+STAGE_ROUTE = "executor.route"
+STAGE_DEVICE_BATCH = "executor.device_batch"
+STAGE_SPMD_KERNEL = "spmd.kernel"
+STAGE_BATCH_SCORE = "batcher.score"
+STAGE_STAGE = "stager.stage"
+STAGE_MAP_REMOTE = "cluster.map_remote"
+STAGE_MAP_LOCAL = "cluster.map_local"
+
+STAGES: dict[str, str] = {
+    STAGE_QUERY: "root span, one per query (API layer)",
+    STAGE_EXECUTOR: "Executor.execute body",
+    STAGE_CALL: "one PQL call dispatch (meta: call)",
+    STAGE_MAP_SHARD: "per-shard map leg (meta: shard)",
+    STAGE_ROUTE: "device-vs-CPU routing decision event (meta: call, shard, path)",
+    STAGE_DEVICE_BATCH: "shard-batched device fast path (Count/Sum/TopN)",
+    STAGE_SPMD_KERNEL: "compiled kernel invocation (meta: kind, first)",
+    STAGE_BATCH_SCORE: "batched-scorer scoring request, enqueue to result",
+    STAGE_STAGE: "HBM staging-cache miss build (meta: nbytes)",
+    STAGE_MAP_REMOTE: "distributed map-reduce remote leg (meta: node)",
+    STAGE_MAP_LOCAL: "distributed map-reduce local leg",
+}
+
+
+# -- registry --------------------------------------------------------------
+
+
+def _labels_key(labels: dict) -> tuple:
+    if not labels:
+        return ()
+    return tuple(sorted(labels.items()))
+
+
+class Registry:
+    """Process-global aggregation: counters/gauges sum or overwrite under
+    one lock; histograms aggregate into LogHistogram buckets. Cheap
+    enough for per-shard counters (~dict update per call)."""
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._counters: dict[tuple, float] = {}
+        self._gauges: dict[tuple, float] = {}
+        self._hists: dict[tuple, LogHistogram] = {}
+
+    def count(self, name: str, value: float = 1, **labels) -> None:
+        k = (name, _labels_key(labels))
+        with self._mu:
+            self._counters[k] = self._counters.get(k, 0) + value
+
+    def gauge(self, name: str, value: float, **labels) -> None:
+        with self._mu:
+            self._gauges[(name, _labels_key(labels))] = value
+
+    def observe(self, name: str, value: float, **labels) -> None:
+        k = (name, _labels_key(labels))
+        with self._mu:
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = LogHistogram()
+            h.observe(value)
+
+    def snapshot(self) -> dict:
+        """JSON-safe flat snapshot: ``name[;k:v,...]`` -> number or
+        histogram summary dict (the expvar key convention, so bench
+        output and /debug/vars read the same way)."""
+        out = {}
+        with self._mu:
+            for (name, lbl), v in self._counters.items():
+                out[_flat_key(name, lbl)] = v
+            for (name, lbl), v in self._gauges.items():
+                out[_flat_key(name, lbl)] = v
+            for (name, lbl), h in self._hists.items():
+                out[_flat_key(name + ".hist", lbl)] = h.summary()
+        return out
+
+    def clear(self) -> None:
+        with self._mu:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+    def _families(self) -> dict:
+        """name -> list[(labels tuple, value-or-LogHistogram)]."""
+        fams: dict[str, list] = {}
+        with self._mu:
+            for (name, lbl), v in self._counters.items():
+                fams.setdefault(name, []).append((lbl, v))
+            for (name, lbl), v in self._gauges.items():
+                fams.setdefault(name, []).append((lbl, v))
+            for (name, lbl), h in self._hists.items():
+                fams.setdefault(name, []).append((lbl, h.summary()))
+        return fams
+
+
+REGISTRY = Registry()
+
+# module-level conveniences (the instrumentation call surface)
+count = REGISTRY.count
+gauge = REGISTRY.gauge
+observe = REGISTRY.observe
+snapshot = REGISTRY.snapshot
+
+
+def _flat_key(name: str, labels: tuple) -> str:
+    if not labels:
+        return name
+    return name + ";" + ",".join(f"{k}:{v}" for k, v in labels)
+
+
+# -- Prometheus text exposition --------------------------------------------
+
+
+def _prom_name(name: str) -> str:
+    s = "".join(ch if ch.isalnum() or ch == "_" else "_" for ch in name)
+    if not s or not (s[0].isalpha() or s[0] == "_"):
+        s = "_" + s
+    return "pilosa_" + s
+
+
+def _prom_label_value(v) -> str:
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _prom_labels(labels: tuple, extra: Optional[tuple] = None) -> str:
+    items = list(labels) + list(extra or ())
+    if not items:
+        return ""
+    body = ",".join(
+        f'{_prom_name(k)[len("pilosa_"):]}="{_prom_label_value(v)}"'
+        for k, v in items
+    )
+    return "{" + body + "}"
+
+
+def _parse_expvar_key(key: str) -> tuple[str, tuple]:
+    """``name[.timing][.hist];t1:v1,t2:v2`` -> (base name, labels)."""
+    name, _, tagstr = key.partition(";")
+    for suffix in (".hist", ".timing"):
+        if name.endswith(suffix):
+            name = name[: -len(suffix)]
+    labels = []
+    if tagstr:
+        for tag in tagstr.split(","):
+            k, sep, v = tag.partition(":")
+            labels.append((k, v) if sep else ("tag", k))
+    return name, tuple(labels)
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, float) and (math.isnan(v) or math.isinf(v)):
+        return "NaN"
+    if isinstance(v, float) and v == int(v):
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def render_prometheus(
+    extra_snapshots: Optional[list[dict]] = None,
+    registry: Optional[Registry] = None,
+) -> str:
+    """Render the global registry (plus optional expvar-style snapshots,
+    e.g. the server's per-instance stats) as Prometheus text exposition.
+    Histogram summaries render as summary-typed families (quantile
+    labels + _sum/_count); everything else as its declared type."""
+    fams: dict[str, list] = (registry if registry is not None else REGISTRY)._families()
+    for snap in extra_snapshots or []:
+        for key, v in snap.items():
+            if isinstance(v, dict) and "count" in v and "sum" in v:
+                name, labels = _parse_expvar_key(key)
+                fams.setdefault(name, []).append((labels, v))
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                name, labels = _parse_expvar_key(key)
+                fams.setdefault(name, []).append((labels, v))
+            # strings (stats .set values) have no Prometheus shape: skip
+
+    lines: list[str] = []
+    for name in sorted(fams):
+        pname = _prom_name(name)
+        typ, help_ = METRICS.get(name, ("gauge", ""))
+        samples = fams[name]
+        if any(isinstance(v, dict) for _, v in samples):
+            typ = "summary"
+        if help_:
+            lines.append(f"# HELP {pname} {help_}")
+        lines.append(f"# TYPE {pname} {typ}")
+        for labels, v in samples:
+            if isinstance(v, dict):
+                for q, kq in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+                    qv = v.get(kq)
+                    if qv is not None:
+                        lines.append(
+                            f"{pname}{_prom_labels(labels, (('quantile', q),))} {_fmt(qv)}"
+                        )
+                lines.append(f"{pname}_sum{_prom_labels(labels)} {_fmt(v['sum'])}")
+                lines.append(f"{pname}_count{_prom_labels(labels)} {_fmt(v['count'])}")
+            else:
+                lines.append(f"{pname}{_prom_labels(labels)} {_fmt(v)}")
+    return "\n".join(lines) + "\n"

@@ -8,6 +8,8 @@ import threading
 import time
 from typing import Optional
 
+from pilosa_tpu.utils.metrics import LogHistogram
+
 
 class NopStatsClient:
     def tags(self) -> list[str]:
@@ -69,12 +71,13 @@ class ExpvarStatsClient:
 
     def histogram(self, name: str, value: float, rate: float = 1.0) -> None:
         with self._mu:
-            k = self._key(name) + ".hist"
-            h = self._root.setdefault(k, {"count": 0, "sum": 0.0, "min": None, "max": None})
-            h["count"] += 1
-            h["sum"] += value
-            h["min"] = value if h["min"] is None else min(h["min"], value)
-            h["max"] = value if h["max"] is None else max(h["max"], value)
+            # .hist rides on the NAME (before the tag suffix), so
+            # "name.timing.hist;tag" parses as base name + labels
+            k = self._key(name + ".hist")
+            h = self._root.get(k)
+            if not isinstance(h, LogHistogram):
+                h = self._root[k] = LogHistogram()
+            h.observe(value)
 
     def set(self, name: str, value: str, rate: float = 1.0) -> None:
         with self._mu:
@@ -84,8 +87,14 @@ class ExpvarStatsClient:
         self.histogram(name + ".timing", value, rate)
 
     def snapshot(self) -> dict:
+        """JSON-safe view: histograms render as count/sum/min/max plus
+        estimated p50/p95/p99 from the fixed log-spaced buckets, so
+        .timing metrics are actionable beyond min/max."""
         with self._mu:
-            return dict(self._root)
+            return {
+                k: (v.summary() if isinstance(v, LogHistogram) else v)
+                for k, v in self._root.items()
+            }
 
     def close(self) -> None:
         pass
@@ -120,6 +129,17 @@ class MultiStatsClient:
     def timing(self, name, value, rate=1.0):
         for c in self.clients:
             c.timing(name, value, rate)
+
+    def snapshot(self) -> dict:
+        """Merged snapshots of every child that aggregates in-process
+        (ExpvarStatsClient); fire-and-forget sinks contribute nothing.
+        Keeps /debug/vars lit when the configured sink is statsd."""
+        out: dict = {}
+        for c in self.clients:
+            snap = getattr(c, "snapshot", None)
+            if snap is not None:
+                out.update(snap())
+        return out
 
     def close(self) -> None:
         for c in self.clients:

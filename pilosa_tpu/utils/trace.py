@@ -1,0 +1,227 @@
+"""Span-based query tracing — the timing tree behind ``profile=true``,
+``GET /debug/traces``, and the slow-query log.
+
+Design constraints (ISSUE 1 acceptance):
+
+* **Zero hot-path cost when off.** A query that isn't traced carries no
+  span: the root is the shared ``NOP_SPAN`` singleton, the contextvar
+  stays ``None``, and every instrumentation site is a single
+  ``current() is None`` branch — no allocation per shard, per call, or
+  per dispatch. A unit test guards this via ``span_count()``.
+* **Cross-thread propagation is explicit.** contextvars don't follow
+  work into thread pools (the executor's read pool, the cluster's
+  map-reduce pool), so pool submitters capture ``current()`` once and
+  re-enter it in the worker via ``activate(span)``.
+* **Bounded memory.** Completed root traces land in a ring buffer
+  (``deque(maxlen=...)``) as plain dicts; an abandoned span tree is
+  garbage like any other object.
+
+Sampling: ``TRACER.sample_rate`` traces that fraction of queries into
+the ring buffer; ``force=True`` (the ``profile=true`` query option)
+always traces; a non-zero ``slow_threshold`` traces every query so the
+span tree exists for whichever ones turn out slow, and fires
+``on_slow`` with the tree dict for those.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import random
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+_current: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "pilosa_tpu_span", default=None
+)
+
+# monotonic count of real Span objects ever created — the overhead
+# guard's probe: tracing disabled must leave this untouched
+_spans_created = 0
+
+
+def span_count() -> int:
+    return _spans_created
+
+
+def current() -> Optional["Span"]:
+    """The active span of this thread/context, or None when untraced."""
+    return _current.get()
+
+
+class _NopSpan:
+    """Shared do-nothing span: every method is a no-op and ``child``
+    returns itself, so untraced code paths can use the same call shapes
+    without allocating."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NopSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def child(self, name: str, **meta) -> "_NopSpan":
+        return self
+
+    def event(self, name: str, **meta) -> None:
+        pass
+
+    def annotate(self, **meta) -> None:
+        pass
+
+    def to_dict(self, base: Optional[float] = None) -> dict:
+        return {}
+
+
+NOP_SPAN = _NopSpan()
+
+
+class Span:
+    """One timed stage. Context-manager enter/exit measures duration and
+    publishes this span as the contextvar current, so nested
+    instrumentation attaches implicitly; ``child()``/``event()`` attach
+    explicitly (usable from any thread — list.append is atomic)."""
+
+    __slots__ = ("name", "meta", "t0", "duration", "children", "_token", "_tracer")
+
+    def __init__(self, name: str, _tracer: Optional["Tracer"] = None, **meta) -> None:
+        global _spans_created
+        _spans_created += 1
+        self.name = name
+        self.meta = meta
+        self.t0 = 0.0
+        self.duration: Optional[float] = None
+        self.children: list[Span] = []
+        self._token = None
+        self._tracer = _tracer
+
+    def child(self, name: str, **meta) -> "Span":
+        sp = Span(name, **meta)
+        self.children.append(sp)
+        return sp
+
+    def event(self, name: str, **meta) -> None:
+        """Zero-duration child (a point annotation, e.g. one routing
+        decision)."""
+        sp = Span(name, **meta)
+        sp.t0 = time.monotonic()
+        sp.duration = 0.0
+        self.children.append(sp)
+
+    def annotate(self, **meta) -> None:
+        self.meta.update(meta)
+
+    def __enter__(self) -> "Span":
+        self.t0 = time.monotonic()
+        self._token = _current.set(self)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.duration = time.monotonic() - self.t0
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        if self._tracer is not None:
+            self._tracer._record(self)
+        return False
+
+    def to_dict(self, base: Optional[float] = None) -> dict:
+        if base is None:
+            base = self.t0
+        out = {
+            "name": self.name,
+            "start_ms": round((self.t0 - base) * 1000.0, 3),
+            "duration_ms": round((self.duration or 0.0) * 1000.0, 3),
+        }
+        if self.meta:
+            out["meta"] = self.meta
+        if self.children:
+            out["children"] = [c.to_dict(base) for c in self.children]
+        return out
+
+
+class _Activation:
+    """Re-enter an existing span in another thread/context without
+    re-timing it (pool workers adopt the submitter's span)."""
+
+    __slots__ = ("_span", "_token")
+
+    def __init__(self, span: Optional[Span]) -> None:
+        self._span = span
+        self._token = None
+
+    def __enter__(self) -> Optional[Span]:
+        if self._span is not None:
+            self._token = _current.set(self._span)
+        return self._span
+
+    def __exit__(self, *exc) -> bool:
+        if self._token is not None:
+            _current.reset(self._token)
+        return False
+
+
+def activate(span: Optional[Span]) -> _Activation:
+    return _Activation(span)
+
+
+def child(name: str, **meta):
+    """Child span of the current span, or NOP_SPAN when untraced — the
+    one-liner instrumentation entry point: ``with trace.child(...)``."""
+    sp = _current.get()
+    if sp is None:
+        return NOP_SPAN
+    return sp.child(name, **meta)
+
+
+class Tracer:
+    """Trace admission + the ring buffer of recent completed traces."""
+
+    def __init__(self, sample_rate: float = 0.0, ring_size: int = 128) -> None:
+        self.sample_rate = sample_rate
+        self.slow_threshold = 0.0  # seconds; >0 traces everything
+        self.on_slow = None  # callable(dict) for traces over threshold
+        self._ring: deque[dict] = deque(maxlen=ring_size)
+        self._mu = threading.Lock()
+        self.traces_recorded = 0
+
+    def trace(self, name: str, force: bool = False, **meta):
+        """A root span (context manager), or NOP_SPAN when this query is
+        not sampled."""
+        if not force and self.slow_threshold <= 0.0:
+            r = self.sample_rate
+            if r <= 0.0 or random.random() >= r:
+                return NOP_SPAN
+        return Span(name, _tracer=self, **meta)
+
+    def _record(self, span: Span) -> None:
+        d = span.to_dict()
+        with self._mu:
+            self._ring.append(d)
+            self.traces_recorded += 1
+        if (
+            self.slow_threshold > 0.0
+            and span.duration is not None
+            and span.duration >= self.slow_threshold
+            and self.on_slow is not None
+        ):
+            try:
+                self.on_slow(d)
+            except Exception:
+                pass  # a logging hook must never fail the query
+
+    def recent(self) -> list[dict]:
+        with self._mu:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._ring.clear()
+
+
+# process-global default tracer; the server applies its config knobs
+# (trace-sample-rate, slow-query-time) here at startup
+TRACER = Tracer()

@@ -87,6 +87,83 @@ def test_statsd_bare_hostname_defaults_port():
     c.close()
 
 
+def test_statsd_closed_socket_swallows_errors():
+    """UDP fire-and-forget: a dead socket must never surface into the
+    serving path (uses the _sock injection point)."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    c = StatsDClient(host="127.0.0.1:9", _sock=sock)
+    sock.close()
+    c.count("x", 1)
+    c.gauge("g", 1.0)
+    c.timing("t", 0.5)
+    c.histogram("h", 2.0)
+    c.set("s", "v")
+    c.close()  # double-close of the injected socket is swallowed too
+
+
+def test_statsd_tagged_child_shares_socket(udp_server):
+    """with_tags returns a view over the SAME socket — closing the
+    parent closes the child; tags ride every metric type."""
+    port = udp_server.getsockname()[1]
+    c = StatsDClient(host=f"127.0.0.1:{port}")
+    t = c.with_tags("shard:3")
+    assert t._sock is c._sock
+    t.timing("q", 2.5)
+    assert _recv(udp_server) == "pilosa.q:2.5|ms|#shard:3"
+    t.gauge("g", 7)
+    assert _recv(udp_server) == "pilosa.g:7|g|#shard:3"
+    c.close()
+
+
+# -- expvar percentile histograms ------------------------------------------
+
+
+def test_expvar_histogram_percentiles():
+    from pilosa_tpu.utils.stats import ExpvarStatsClient
+
+    c = ExpvarStatsClient()
+    for v in range(1, 101):
+        c.histogram("h", float(v))
+    h = c.snapshot()["h.hist"]
+    assert h["count"] == 100
+    assert h["min"] == 1.0 and h["max"] == 100.0
+    assert abs(h["sum"] - 5050.0) < 1e-9
+    # log-spaced buckets: estimates carry bounded relative error
+    assert 40 <= h["p50"] <= 60
+    assert h["p50"] <= h["p95"] <= h["p99"] <= 100.0
+
+
+def test_expvar_timing_reports_percentiles():
+    from pilosa_tpu.utils.stats import ExpvarStatsClient
+
+    c = ExpvarStatsClient(tags=["index:i"])
+    for _ in range(10):
+        c.timing("query_time", 0.25)
+    h = c.snapshot()["query_time.timing.hist;index:i"]
+    assert h["count"] == 10
+    for k in ("p50", "p95", "p99"):
+        assert 0.15 <= h[k] <= 0.35
+
+
+def test_multi_stats_snapshot_keeps_expvar_lit():
+    """satellite: with metric='statsd' the server fans out through a
+    MultiStatsClient whose snapshot merges in-process children, so
+    /debug/vars never goes dark."""
+    from pilosa_tpu.utils.stats import (
+        ExpvarStatsClient,
+        MultiStatsClient,
+        NopStatsClient,
+    )
+
+    ev = ExpvarStatsClient()
+    m = MultiStatsClient(ev, NopStatsClient())
+    m.count("c", 2)
+    m.timing("t", 0.5)
+    snap = m.snapshot()
+    assert snap["c"] == 2
+    assert snap["t.timing.hist"]["count"] == 1
+
+
 # -- gcnotify --------------------------------------------------------------
 
 

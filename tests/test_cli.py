@@ -171,3 +171,33 @@ def test_check_validates_occ_sidecar(tmp_path, capsys):
     os.replace(str(tmp_path / "frag2.occ"), p + ".occ")
     assert main(["check", p]) == 0
     assert "stale" in capsys.readouterr().out
+
+
+def test_metrics_command(tmp_path, server, capsys):
+    """`pilosa_tpu metrics` dumps Prometheus text; --traces dumps the
+    recent-trace ring as JSON."""
+    body = json.dumps({}).encode()
+    urllib.request.urlopen(
+        urllib.request.Request(server.uri + "/index/m", data=body, method="POST")
+    )
+    urllib.request.urlopen(
+        urllib.request.Request(
+            server.uri + "/index/m/field/f", data=body, method="POST"
+        )
+    )
+    urllib.request.urlopen(
+        urllib.request.Request(
+            server.uri + "/index/m/query?profile=true",
+            data=b"Set(1, f=1) Count(Row(f=1))",
+            method="POST",
+        )
+    )
+    rc = main(["metrics", "--host", server.uri])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "pilosa_executor_calls" in out
+    rc = main(["metrics", "--host", server.uri, "--traces"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    traces = json.loads(out)["traces"]
+    assert traces and traces[-1]["name"] == "query"

@@ -1,0 +1,382 @@
+"""Observability surface (ISSUE 1): span tracer, profile=true timing
+trees, /metrics Prometheus exposition, /debug/traces, the slow-query
+trace hook, the tracing-off overhead bound, and docs/name sync.
+
+Everything server-level runs against a real in-process server on :0
+under JAX_PLATFORMS=cpu (the tier-1 environment)."""
+
+import json
+import os
+import re
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import SHARD_WIDTH
+from pilosa_tpu.server import Config, Server
+from pilosa_tpu.utils import metrics, trace
+from pilosa_tpu.utils.trace import Tracer
+
+
+@pytest.fixture()
+def server(tmp_path):
+    cfg = Config(
+        data_dir=str(tmp_path / "data"),
+        bind="127.0.0.1:0",
+        metric="expvar",
+        device_policy="always",  # CPU jax backend exercises the device path
+        device_timeout=0,  # no health gate: keep the test single-purpose
+    )
+    s = Server(cfg)
+    s.open()
+    yield s
+    s.close()
+
+
+def req(server, method, path, body=None, raw=False):
+    url = server.uri + path
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    r = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(r) as resp:
+            payload = resp.read()
+            return resp.status, payload if raw else json.loads(payload or b"{}")
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, payload if raw else json.loads(payload or b"{}")
+
+
+# -- tracer unit behavior ---------------------------------------------------
+
+
+def test_tracer_span_nesting_and_ring_bounds():
+    tr = Tracer(ring_size=3)
+    for i in range(5):
+        with tr.trace("query", force=True, i=i) as root:
+            with root.child("executor"):
+                with trace.current().child("executor.map_shard", shard=7):
+                    pass
+            root.event("executor.route", path="cpu")
+    recent = tr.recent()
+    assert len(recent) == 3  # ring bounded
+    assert tr.traces_recorded == 5
+    d = recent[-1]
+    assert d["name"] == "query" and d["meta"] == {"i": 4}
+    assert [c["name"] for c in d["children"]] == ["executor", "executor.route"]
+    shard_span = d["children"][0]["children"][0]
+    assert shard_span["name"] == "executor.map_shard"
+    assert shard_span["meta"]["shard"] == 7
+    assert d["duration_ms"] >= d["children"][0]["duration_ms"] >= 0
+    # events are zero-duration point annotations
+    assert d["children"][1]["duration_ms"] == 0
+
+
+def test_tracer_off_is_nop_and_allocates_nothing():
+    tr = Tracer(sample_rate=0.0)
+    before = trace.span_count()
+    sp = tr.trace("query")
+    assert sp is trace.NOP_SPAN
+    with sp:
+        assert trace.current() is None
+        assert trace.child("executor") is trace.NOP_SPAN
+        sp.event("x")
+        assert sp.child("y") is sp
+    assert trace.span_count() == before
+    assert tr.recent() == []
+
+
+def test_tracer_sampling(monkeypatch):
+    import random
+
+    tr = Tracer(sample_rate=0.5)
+    monkeypatch.setattr(random, "random", lambda: 0.9)
+    assert tr.trace("query") is trace.NOP_SPAN  # 0.9 >= 0.5 -> dropped
+    monkeypatch.setattr(random, "random", lambda: 0.1)
+    with tr.trace("query"):
+        pass
+    assert len(tr.recent()) == 1
+
+
+def test_slow_query_hook_fires_with_span_tree():
+    tr = Tracer()
+    tr.slow_threshold = 1e-9  # everything is slow
+    seen = []
+    tr.on_slow = seen.append
+    with tr.trace("query") as root:  # threshold > 0 => always traced
+        with root.child("executor"):
+            time.sleep(0.001)
+    assert seen and seen[0]["name"] == "query"
+    assert seen[0]["children"][0]["name"] == "executor"
+    # under-threshold queries record to the ring but don't fire the hook
+    tr.slow_threshold = 60.0
+    with tr.trace("query"):
+        pass
+    assert len(seen) == 1
+    assert len(tr.recent()) == 2
+
+
+def test_activate_adopts_span_across_contexts():
+    tr = Tracer()
+    with tr.trace("query", force=True) as root:
+        pass
+    assert trace.current() is None
+    with trace.activate(root):
+        assert trace.current() is root
+        trace.child("late")
+    assert trace.current() is None
+    assert root.children[-1].name == "late"
+    # activating None is a no-op
+    with trace.activate(None):
+        assert trace.current() is None
+
+
+# -- end-to-end: profile=true, overhead bound -------------------------------
+
+
+def _seed_two_shards(server, index="obs"):
+    req(server, "POST", f"/index/{index}", {})
+    req(server, "POST", f"/index/{index}/field/f", {})
+    rows, cols = [], []
+    for r in range(4):
+        for c in range(6):
+            rows.append(r)
+            cols.append(c * 17 + r)
+            rows.append(r)
+            cols.append(SHARD_WIDTH + c * 13 + r)
+    st, _ = req(
+        server,
+        "POST",
+        f"/index/{index}/field/f/import",
+        {"rowIDs": rows, "columnIDs": cols},
+    )
+    assert st == 200
+    req(server, "POST", "/recalculate-caches")
+
+
+def _span_names(d, out):
+    out.add(d["name"])
+    for c in d.get("children", []):
+        _span_names(c, out)
+    return out
+
+
+def test_profile_query_returns_span_tree(server):
+    _seed_two_shards(server)
+    st, body = req(
+        server, "POST", "/index/obs/query?profile=true", b"Count(Row(f=1))"
+    )
+    assert st == 200, body
+    prof = body["profile"]
+    assert prof["name"] == metrics.STAGE_QUERY
+    assert prof["duration_ms"] > 0
+    names = _span_names(prof, set())
+    # acceptance: at least executor, per-shard map, device-routing stages
+    assert metrics.STAGE_EXECUTOR in names
+    assert metrics.STAGE_MAP_SHARD in names or metrics.STAGE_DEVICE_BATCH in names
+    assert metrics.STAGE_ROUTE in names
+    # every stage name in the tree is documented (satellite: stage names
+    # match the documented set)
+    assert names <= set(metrics.STAGES)
+
+    # a TopN over a source bitmap profiles through the scoring stages too
+    st, body = req(
+        server, "POST", "/index/obs/query?profile=true", b"TopN(f, Row(f=1), n=2)"
+    )
+    assert st == 200, body
+    names = _span_names(body["profile"], set())
+    assert names <= set(metrics.STAGES)
+
+    # without profile=true the response carries no profile key
+    st, body = req(server, "POST", "/index/obs/query", b"Count(Row(f=1))")
+    assert st == 200 and "profile" not in body
+
+
+def test_untraced_hot_path_creates_no_spans(server):
+    """Acceptance overhead bound: sampling off => the instrumented hot
+    path allocates zero Span objects (a single branch per shard)."""
+    _seed_two_shards(server, index="noov")
+    # warm once so lazy pools/jits don't muddy the probe
+    req(server, "POST", "/index/noov/query", b"Count(Row(f=1))")
+    before = trace.span_count()
+    st, body = req(
+        server,
+        "POST",
+        "/index/noov/query",
+        b"Count(Row(f=1)) TopN(f, Row(f=2), n=2) Row(f=3)",
+    )
+    assert st == 200, body
+    assert trace.span_count() == before
+
+
+# -- /metrics ---------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^(?:"
+    r"# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*(?: .*)?"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{.*\})? (?:-?[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|NaN)"
+    r")$"
+)
+
+
+def _assert_prometheus_text(text: str) -> None:
+    families = []
+    for line in text.strip().split("\n"):
+        m = _PROM_LINE.match(line)
+        assert m, f"invalid exposition line: {line!r}"
+        if line.startswith("# TYPE "):
+            families.append(line.split()[2])
+    # one TYPE declaration per family
+    assert len(families) == len(set(families))
+
+
+def test_metrics_endpoint_prometheus_exposition(server):
+    _seed_two_shards(server, index="pm")
+    for q in (
+        b"Count(Row(f=1))",
+        b"TopN(f, Row(f=1), n=2)",
+        b"Row(f=0)",
+    ):
+        st, body = req(server, "POST", "/index/pm/query", q)
+        assert st == 200, body
+    # exercise the CPU routing leg too, so both route families export
+    server.executor.device_policy = "never"
+    try:
+        st, body = req(server, "POST", "/index/pm/query", b"Count(Row(f=2))")
+        assert st == 200, body
+    finally:
+        server.executor.device_policy = "always"
+    st, raw = req(server, "GET", "/metrics", raw=True)
+    assert st == 200
+    text = raw.decode()
+    _assert_prometheus_text(text)
+    # acceptance: query counters by call type
+    assert 'pilosa_executor_calls{call="Count"}' in text
+    assert 'pilosa_executor_calls{call="TopN"}' in text
+    # device-vs-CPU routing counters, one family per decision outcome
+    assert "pilosa_executor_route_device{" in text
+    assert "pilosa_executor_route_cpu{" in text
+    # batcher batch-size histogram (the 2-shard TopN coalesces through
+    # the stacked scorer)
+    assert "pilosa_batcher_batch_size_count" in text
+    assert "pilosa_batcher_batch_size{quantile=" in text
+    # cache hit/miss (TopN pass 2 consults the rank cache by id)
+    assert "pilosa_cache_hits" in text or "pilosa_cache_misses" in text
+    # server-level expvar stats merge in with their quantiles
+    assert 'pilosa_query_time{index="pm",quantile="0.5"}' in text
+    # scrape-time gauges
+    assert "pilosa_stager_bytes" in text
+
+
+def test_render_prometheus_escapes_labels():
+    reg = metrics.Registry()
+    reg.count("executor.calls", call='we"ird\\na{me}')
+    text = metrics.render_prometheus(registry=reg)
+    _assert_prometheus_text(text)
+    assert '\\"' in text
+
+
+def test_log_histogram_quantiles_monotonic():
+    h = metrics.LogHistogram()
+    for v in (0.001, 0.002, 0.004, 0.1, 2.0, 30.0):
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 6
+    assert s["min"] == 0.001 and s["max"] == 30.0
+    assert s["min"] <= s["p50"] <= s["p95"] <= s["p99"] <= s["max"]
+
+
+# -- /debug/traces + /debug/vars -------------------------------------------
+
+
+def test_debug_traces_ring_and_sampled_server(tmp_path):
+    cfg = Config(
+        data_dir=str(tmp_path / "data"),
+        bind="127.0.0.1:0",
+        trace_sample_rate=1.0,
+        device_timeout=0,
+    )
+    s = Server(cfg)
+    s.open()
+    try:
+        req(s, "POST", "/index/tr", {})
+        req(s, "POST", "/index/tr/field/f", {})
+        req(s, "POST", "/index/tr/query", b"Set(1, f=1)")
+        st, body = req(s, "POST", "/index/tr/query", b"Count(Row(f=1))")
+        assert st == 200 and body["results"] == [1]
+        st, body = req(s, "GET", "/debug/traces")
+        assert st == 200 and body["traces"]
+        assert body["traces"][-1]["name"] == "query"
+        names = _span_names(body["traces"][-1], set())
+        assert metrics.STAGE_EXECUTOR in names
+    finally:
+        s.close()
+
+
+def test_debug_vars_lit_with_statsd_sink(tmp_path):
+    """satellite: metric='statsd' must not darken /debug/vars — the
+    server always keeps an in-process expvar client and fans out."""
+    cfg = Config(
+        data_dir=str(tmp_path / "data"),
+        bind="127.0.0.1:0",
+        metric="statsd",
+        metric_host="127.0.0.1:8125",
+        device_timeout=0,
+    )
+    s = Server(cfg)
+    s.open()
+    try:
+        req(s, "POST", "/index/sv", {})
+        req(s, "POST", "/index/sv/field/f", {})
+        req(s, "POST", "/index/sv/query", b"Set(1, f=1)")
+        req(s, "POST", "/index/sv/query", b"Count(Row(f=1))")
+        st, body = req(s, "GET", "/debug/vars")
+        assert st == 200
+        qt = [k for k in body if k.startswith("query_time")]
+        assert qt, f"/debug/vars dark under statsd sink: {sorted(body)[:10]}"
+        # percentile summary shape (satellite: actionable timings)
+        h = body[qt[0]]
+        assert {"count", "sum", "min", "max", "p50", "p95", "p99"} <= set(h)
+        # registry snapshot rides along
+        assert any(k.startswith("executor.calls") for k in body["metrics"])
+    finally:
+        s.close()
+
+
+# -- docs drift guard -------------------------------------------------------
+
+
+def _doc_table_names(section: str) -> dict:
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "docs", "administration.md"
+    )
+    with open(path) as f:
+        text = f.read()
+    assert section in text, f"docs/administration.md lost section {section!r}"
+    chunk = text.split(section, 1)[1]
+    # stop at the next heading
+    chunk = re.split(r"\n#{2,3} ", chunk)[0]
+    rows = re.findall(r"^\| `([^`]+)` \|(?: ([a-z]+) \|)?", chunk, re.M)
+    return {name: typ for name, typ in rows}
+
+
+def test_docs_metric_table_in_sync_with_registry():
+    """Every metric name emitted in code is in the docs table, and the
+    docs table names only metrics that exist — both directions."""
+    doc = _doc_table_names("### Metric reference")
+    code = {name: typ for name, (typ, _) in metrics.METRICS.items()}
+    assert set(doc) == set(code), (
+        f"docs-only: {set(doc) - set(code)}; code-only: {set(code) - set(doc)}"
+    )
+    for name, typ in code.items():
+        assert doc[name] == typ, f"{name}: docs say {doc[name]}, code says {typ}"
+
+
+def test_docs_stage_table_in_sync_with_registry():
+    doc = _doc_table_names("### Trace stages")
+    assert set(doc) == set(metrics.STAGES), (
+        f"docs-only: {set(doc) - set(metrics.STAGES)}; "
+        f"code-only: {set(metrics.STAGES) - set(doc)}"
+    )
