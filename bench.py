@@ -132,24 +132,242 @@ def headline_mode(tall: dict):
     return "sequential", seq
 
 
-def vs_baseline_fields(mode: str, headline: float, cpu_qps) -> dict:
-    """The vs_baseline trio, identical from the live and the
+def vs_baseline_fields(
+    mode: str, headline: float, cpu_qps, cpu_closed_qps=None, seq_qps=None
+) -> dict:
+    """The vs_baseline fields, identical from the live and the
     checkpoint-assembly paths: ratio + denominator + a note stating
-    which convention the ratio uses (serving-vs-host-saturated-CPU for
-    a closed-loop headline; sequential-vs-sequential otherwise)."""
+    which convention the ratio uses. A closed-loop headline divides by
+    the CPU path's best MEASURED throughput (max of its sequential and
+    closed-loop windows — bench_tall measures a short CPU closed loop
+    so the denominator is data, not the asserted "sequential is the
+    1-core ceiling"); the sequential-vs-sequential ratio always rides
+    alongside as vs_baseline_seq when seq_qps is known."""
     if not cpu_qps:
         return {}
-    note = (
-        "headline serving qps vs the CPU full path, whose sequential "
-        "qps is its concurrency ceiling on this 1-core host (CPU-bound)"
-        if mode != "sequential"
-        else "sequential qps both sides (no concurrency window measured)"
+    out = {}
+    base = cpu_qps
+    if mode != "sequential":
+        if cpu_closed_qps:
+            base = max(cpu_qps, cpu_closed_qps)
+            out["baseline_cpu_closed_qps"] = cpu_closed_qps
+            note = (
+                "headline serving qps vs the CPU full path's best "
+                "measured throughput (max of sequential and closed-loop "
+                "windows)"
+            )
+        else:
+            note = (
+                "headline serving qps vs the CPU full path's sequential "
+                "qps (no CPU closed-loop window measured this run)"
+            )
+    else:
+        note = "sequential qps both sides (no concurrency window measured)"
+    out.update(
+        vs_baseline=round(headline / base, 2),
+        baseline_cpu_qps=cpu_qps,
+        vs_baseline_note=note,
     )
-    return {
-        "vs_baseline": round(headline / cpu_qps, 2),
-        "baseline_cpu_qps": cpu_qps,
-        "vs_baseline_note": note,
+    if seq_qps and mode != "sequential":
+        out["vs_baseline_seq"] = round(seq_qps / cpu_qps, 2)
+    return out
+
+
+def _pipeline_serving_probe(budget_s: float) -> dict:
+    """Closed-loop HTTP throughput THROUGH the serving pipeline
+    (ISSUE 2): boots a real server on :0 with the pipeline enabled over
+    a small CPU-path index and drives it with closed-loop HTTP clients.
+    Chip-independent — it measures the serving layer (admission, queue,
+    coalescing, HTTP glue), the part that bounded round 5 at ~120 qps
+    while the kernel sustained thousands. Also runs a short OVERLOAD
+    segment (injected per-query delay + shrunken queue so offered load
+    exceeds capacity) showing goodput holds near unloaded capacity
+    while the excess sheds as 429."""
+    import json as _json
+    import shutil as _shutil
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from pilosa_tpu.server import Config, Server
+
+    out = {
+        "note": (
+            "closed-loop HTTP qps through the serving pipeline on a "
+            "small CPU-path index (chip-independent: measures the "
+            "serving layer, not the kernel)"
+        )
     }
+    tmp = tempfile.mkdtemp(prefix="pilosa_pipeline_probe_")
+    cfg = Config(
+        data_dir=tmp,
+        bind="127.0.0.1:0",
+        device_policy="never",
+        device_timeout=0,
+        metric="none",
+    )
+    s = Server(cfg)
+    s.open()
+    try:
+        def post(path, body):
+            r = urllib.request.Request(s.uri + path, data=body, method="POST")
+            with urllib.request.urlopen(r, timeout=30) as resp:
+                return resp.read()
+
+        post("/index/pb", b"{}")
+        post("/index/pb/field/f", b"{}")
+        rows, cols = [], []
+        for r_ in range(8):
+            for c in range(256):
+                rows.append(r_)
+                cols.append((c * 2654435761 + r_ * 97) % (1 << 20))
+        post(
+            "/index/pb/field/f/import",
+            _json.dumps({"rowIDs": rows, "columnIDs": cols}).encode(),
+        )
+        queries = [f"Count(Row(f={r_}))".encode() for r_ in range(8)]
+
+        def closed_loop(n_clients, seconds):
+            stop = time.perf_counter() + seconds
+            counts = [0] * n_clients
+            shed = [0] * n_clients
+            errors = []
+
+            def client(ci):
+                i = ci
+                try:
+                    while time.perf_counter() < stop and not errors:
+                        try:
+                            post("/index/pb/query", queries[i % len(queries)])
+                            counts[ci] += 1
+                        except urllib.error.HTTPError as e:
+                            if e.code == 429:
+                                shed[ci] += 1
+                            else:
+                                raise
+                        except (ConnectionError, urllib.error.URLError):
+                            # transport-level drop under overload (RST
+                            # before the pipeline could shed politely):
+                            # a shed in effect — count it as one
+                            shed[ci] += 1
+                        i += 1
+                except BaseException as e:
+                    errors.append(e)
+
+            ts = [
+                threading.Thread(target=client, args=(ci,))
+                for ci in range(n_clients)
+            ]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errors:
+                raise errors[0]
+            dt = time.perf_counter() - t0
+            return sum(counts) / dt, sum(shed) / dt
+
+        closed_loop(8, min(2.0, budget_s * 0.15))  # warm
+        qps, _ = closed_loop(8, min(4.0, budget_s * 0.3))
+        out["closed_loop_qps_c8"] = round(qps, 1)
+        if budget_s > 10 and s.pipeline is not None:
+            # Overload segment. Reads won't do: singleflight + gang
+            # batching legitimately ABSORB a read flood (the c8 window
+            # above shows it), so overload is driven with unique writes
+            # — never coalesced or combined, each occupies a worker for
+            # the injected delay — at 4x more clients than workers. The
+            # delay (GIL-released) must dwarf the per-request Python
+            # overhead of this 1-core host, or the GIL — not the worker
+            # pool — becomes the bottleneck, the queue never fills, and
+            # the ratio measures scheduler noise instead of shedding.
+            real = s.executor.execute
+
+            def slow(*a, **k):
+                time.sleep(0.02)
+                return real(*a, **k)
+
+            seq = [0]
+            seq_lock = threading.Lock()
+
+            def write_loop(n_clients, seconds):
+                stop = time.perf_counter() + seconds
+                ok = [0] * n_clients
+                shed = [0] * n_clients
+                errors = []
+
+                def client(ci):
+                    try:
+                        while time.perf_counter() < stop and not errors:
+                            with seq_lock:
+                                seq[0] += 1
+                                col = seq[0]
+                            try:
+                                post(
+                                    "/index/pb/query",
+                                    f"Set({col % (1 << 20)}, f=30)".encode(),
+                                )
+                                ok[ci] += 1
+                            except urllib.error.HTTPError as e:
+                                if e.code == 429:
+                                    shed[ci] += 1
+                                    # brief backoff (well under the
+                                    # advertised Retry-After): a shed
+                                    # client that re-fires instantly
+                                    # melts the 1-core host with 429
+                                    # churn; offered load still far
+                                    # exceeds capacity
+                                    time.sleep(0.01)
+                                else:
+                                    raise
+                            except (ConnectionError, urllib.error.URLError):
+                                shed[ci] += 1
+                    except BaseException as e:
+                        errors.append(e)
+
+                ts = [
+                    threading.Thread(target=client, args=(ci,))
+                    for ci in range(n_clients)
+                ]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                if errors:
+                    raise errors[0]
+                dt = time.perf_counter() - t0
+                return sum(ok) / dt, sum(shed) / dt
+
+            s.executor.execute = slow
+            icq = s.pipeline._classes["interactive"]
+            old_limit = icq.limit
+            icq.limit = 4
+            try:
+                # unloaded = clients == workers (saturated, no queueing)
+                cap, _ = write_loop(8, min(3.0, budget_s * 0.2))
+                good, shed_rate = write_loop(32, min(4.0, budget_s * 0.25))
+            finally:
+                s.executor.execute = real
+                icq.limit = old_limit
+            out["overload"] = {
+                "unloaded_qps_c8": round(cap, 1),
+                "goodput_qps_c32": round(good, 1),
+                "shed_per_s": round(shed_rate, 1),
+                "goodput_vs_unloaded": round(good / cap, 2) if cap else None,
+                "note": (
+                    "unique writes (non-coalescable) + 20 ms/query delay "
+                    "+ interactive queue shrunk to 4, offered load ~4x "
+                    "capacity; goodput should hold near unloaded "
+                    "capacity while the excess sheds as 429"
+                ),
+            }
+        with urllib.request.urlopen(s.uri + "/debug/pipeline", timeout=30) as r:
+            out["debug_pipeline"] = _json.loads(r.read())
+    finally:
+        s.close()
+        _shutil.rmtree(tmp, ignore_errors=True)
+    return out
 
 
 def main():
@@ -276,10 +494,26 @@ def main():
                     )
                     result["value"] = headline
                     result["seq_qps"] = tall["topn_qps"]
-                    result["p50_ms"] = tall["topn_p50_ms"]
+                    # explicitly SEQUENTIAL p50 (one in-flight query,
+                    # RTT-bound on a tunneled chip) — named so the
+                    # artifact can't be misread as closed-loop latency
+                    result["seq_p50_ms"] = tall["topn_p50_ms"]
+                    bk, _ = best_closed_loop(tall, "topn_qps_c")
+                    if mode != "sequential" and bk:
+                        cp = tall.get(
+                            "topn_p50_ms_c" + bk.rsplit("c", 1)[1]
+                        )
+                        if cp is not None:
+                            # per-query latency AT the headline
+                            # concurrency (queueing included)
+                            result["closed_p50_ms"] = cp
                     result.update(
                         vs_baseline_fields(
-                            mode, headline, tall.get("cpu_topn_qps")
+                            mode,
+                            headline,
+                            tall.get("cpu_topn_qps"),
+                            cpu_closed_qps=tall.get("cpu_topn_qps_c4"),
+                            seq_qps=tall.get("topn_qps"),
                         )
                     )
         except Exception as e:  # keep the JSON line flowing
@@ -332,6 +566,22 @@ def main():
     except Exception as e:  # any malformed baseline file — keep the JSON flowing
         print(f"native baseline unavailable: {type(e).__name__}: {e}", file=sys.stderr)
 
+    # ---- serving pipeline probe (ISSUE 2): closed-loop HTTP qps
+    # through the new admission/batching layer + overload shed
+    # behavior. Cheap (~15 s, CPU path) and chip-independent.
+    if os.environ.get("PILOSA_BENCH_PIPELINE", "1") != "0":
+        rem = child_budget - (time.monotonic() - _T_PROC_START)
+        if rem > 60:
+            try:
+                result["serving_pipeline"] = _pipeline_serving_probe(
+                    min(20.0, rem - 35)
+                )
+            except Exception as e:
+                print(
+                    f"pipeline probe failed: {type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
+
     # a fresh same-revision checkpointed kernel is free — use it even
     # when the remaining budget couldn't afford a fresh measurement
     cached_kernel = load_part("kernel")
@@ -357,7 +607,7 @@ def main():
                     "metric": "TopN queries/sec (kernel microbench, single chip)",
                     "value": cached_kernel["kernel_qps"],
                     "vs_baseline": cached_kernel.get("kernel_vs_baseline"),
-                    "p50_ms": cached_kernel.get("kernel_p50_ms"),
+                    "seq_p50_ms": cached_kernel.get("kernel_p50_ms"),
                     "baseline_cpu_qps": cached_kernel.get("kernel_cpu_qps"),
                 }
             )
@@ -567,7 +817,7 @@ def main():
                 ),
                 "value": round(best_qps, 2),
                 "vs_baseline": round(best_qps / cpu_qps, 2),
-                "p50_ms": round(p50, 3),
+                "seq_p50_ms": round(p50, 3),
                 "baseline_cpu_qps": round(cpu_qps, 3),
             }
         )
@@ -803,7 +1053,7 @@ def _guarded_main():
             "value": kern_part["kernel_qps"],
             "unit": "queries/s",
             "vs_baseline": kern_part.get("kernel_vs_baseline"),
-            "p50_ms": kern_part.get("kernel_p50_ms"),
+            "seq_p50_ms": kern_part.get("kernel_p50_ms"),
             "platform": kern_part.get("platform"),
             "assembled_from_checkpoints": True,
             "error": f"final attempt failed ({reason}); kernel part is a "
@@ -827,15 +1077,24 @@ def _guarded_main():
             "seq_qps": tall_part["topn_qps"],
             "unit": "queries/s",
             **vs_baseline_fields(
-                mode, headline, tall_part.get("cpu_topn_qps")
+                mode,
+                headline,
+                tall_part.get("cpu_topn_qps"),
+                cpu_closed_qps=tall_part.get("cpu_topn_qps_c4"),
+                seq_qps=tall_part.get("topn_qps"),
             ),
             "platform": tall_part.get("platform"),
             "tall": tall_part,
-            "p50_ms": tall_part.get("topn_p50_ms"),
+            "seq_p50_ms": tall_part.get("topn_p50_ms"),
             "assembled_from_checkpoints": True,
             "error": f"final attempt failed ({reason}); parts are fresh "
             "same-revision measurements from this session",
         }
+        bk, _ = best_closed_loop(tall_part, "topn_qps_c")
+        if mode != "sequential" and bk:
+            cp = tall_part.get("topn_p50_ms_c" + bk.rsplit("c", 1)[1])
+            if cp is not None:
+                out["closed_p50_ms"] = cp
         if kern_part:
             out.update({k: v for k, v in kern_part.items() if k != "platform"})
         print(json.dumps(attach_fresh(out)))

@@ -148,24 +148,34 @@ def _measure(execute, queries, seconds: float):
     return n / total, lat[len(lat) // 2] * 1000, n
 
 
-def _measure_closed_loop(dev, queries, n_clients: int, budget_s: float) -> float:
+def _measure_closed_loop(
+    dev, queries, n_clients: int, budget_s: float, return_p50: bool = False
+):
     """QPS with ``n_clients`` closed-loop clients: each thread sends its
     next query the moment the previous one returns (how N concurrent
     HTTP clients actually behave). The earlier wave-barrier harness
     (submit N futures, join all, repeat) convoyed the pipeline: the
     slowest query of each wave idled every other client, and the
-    continuous batcher never saw a full queue."""
+    continuous batcher never saw a full queue.
+
+    With ``return_p50=True`` returns ``(qps, p50_ms)`` — the per-query
+    round-trip latency AS EXPERIENCED AT THIS CONCURRENCY (queueing +
+    batching included), which is the latency a serving deployment's
+    clients actually see alongside the closed-loop qps headline."""
     import threading
 
     stop = time.perf_counter() + budget_s
     counts = [0] * n_clients
+    lat: list[list[float]] = [[] for _ in range(n_clients)]
     errors: list[BaseException] = []
 
     def client(ci: int) -> None:
         i = ci  # offset so clients interleave different queries
         try:
             while time.perf_counter() < stop and not errors:
+                t_q = time.perf_counter()
                 dev.execute("tall", queries[i % len(queries)])
+                lat[ci].append(time.perf_counter() - t_q)
                 i += 1
                 counts[ci] += 1
         except BaseException as e:  # surface, don't shrink QPS silently
@@ -181,7 +191,12 @@ def _measure_closed_loop(dev, queries, n_clients: int, budget_s: float) -> float
         t.join()
     if errors:
         raise errors[0]
-    return round(sum(counts) / (time.perf_counter() - t0), 2)
+    qps = round(sum(counts) / (time.perf_counter() - t0), 2)
+    if not return_p50:
+        return qps
+    all_lat = sorted(x for per in lat for x in per)
+    p50_ms = round(all_lat[len(all_lat) // 2] * 1000, 2) if all_lat else None
+    return qps, p50_ms
 
 
 def _scale_from_env() -> tuple[int, int]:
@@ -302,8 +317,15 @@ def run(deadline_s: float = 1e9) -> dict:
         # trips + the executor's continuous micro-batching; sequential
         # qps on a tunneled chip is RTT-bound, this is the number a
         # real serving deployment sees
-        def measure_cn(queries, n, budget_c):
-            return _measure_closed_loop(dev, queries, n, budget_c)
+        def measure_cn(queries, n, budget_c, prefix):
+            # records qps AND the closed-loop p50 at that concurrency
+            # (the latency clients actually see at the headline qps)
+            qps, p50 = _measure_closed_loop(
+                dev, queries, n, budget_c, return_p50=True
+            )
+            if p50 is not None:
+                out[f"{prefix}_p50_ms_c{n}"] = p50
+            return qps
 
         if remaining() > 30:
             # Batch-width compile warm: the stacked/grouped kernels
@@ -337,19 +359,19 @@ def run(deadline_s: float = 1e9) -> dict:
 
         if remaining() > 30:
             d0, q0 = dev.stacked_scorer.dispatches, dev.stacked_scorer.batched_queries
-            out["topn_qps_c8"] = measure_cn(topn, 8, min(remaining() - 15, 20))
+            out["topn_qps_c8"] = measure_cn(topn, 8, min(remaining() - 15, 20), "topn")
             # coalescing telemetry: how many concurrent queries shared a
             # stacked kernel launch during the c8 window
             out["c8_coalesced_queries"] = dev.stacked_scorer.batched_queries - q0
             out["c8_dispatches"] = dev.stacked_scorer.dispatches - d0
             if remaining() > 30:
-                out["chain_qps_c8"] = measure_cn(chains, 8, min(remaining() - 15, 15))
+                out["chain_qps_c8"] = measure_cn(chains, 8, min(remaining() - 15, 15), "chain")
             if remaining() > 40:
                 # deeper concurrency: the BatchedScorer coalesces c32/c64
                 # into wider stacked launches (the serving ceiling on a
                 # tunneled chip, where sequential qps is RTT-bound)
                 out["topn_qps_c32"] = measure_cn(
-                    topn, 32, min(remaining() - 15, 20)
+                    topn, 32, min(remaining() - 15, 20), "topn"
                 )
                 if remaining() > 35:
                     # chains are transport-bound sequentially (one fused
@@ -357,18 +379,18 @@ def run(deadline_s: float = 1e9) -> dict:
                     # answers the chain 10x question
                     # (docs/perf_analysis.md §Chains)
                     out["chain_qps_c32"] = measure_cn(
-                        chains, 32, min(remaining() - 15, 15)
+                        chains, 32, min(remaining() - 15, 15), "chain"
                     )
                 if remaining() > 40:
                     # c64: closed-loop clients at the depth a fleet of
                     # HTTP frontends would drive; the continuous batcher
                     # self-tunes width to the fetch latency
                     out["topn_qps_c64"] = measure_cn(
-                        topn, 64, min(remaining() - 15, 20)
+                        topn, 64, min(remaining() - 15, 20), "topn"
                     )
                 if remaining() > 35:
                     out["chain_qps_c64"] = measure_cn(
-                        chains, 64, min(remaining() - 15, 15)
+                        chains, 64, min(remaining() - 15, 15), "chain"
                     )
         # Latency decomposition: how much of a single query's p50 is
         # tunnel RTT vs host work? One tiny device round-trip bounds
@@ -425,6 +447,14 @@ def run(deadline_s: float = 1e9) -> dict:
             )
             out["cpu_topn_qps"] = round(cpu_topn_qps, 3)
             out["cpu_chain_qps"] = round(cpu_chain_qps, 3)
+            if remaining() > 14:
+                # short CPU CLOSED-LOOP window: the serving-vs-CPU
+                # headline ratio divides a concurrent serving number by
+                # this baseline, so its concurrency ceiling must be
+                # measured, not asserted from "1-core host"
+                out["cpu_topn_qps_c4"] = _measure_closed_loop(
+                    cpu, topn[:2], 4, min(remaining() - 8, 6)
+                )
             out["baseline_note"] = (
                 "CPU = this repo's Python roaring full path; reference Go "
                 "binary unavailable in image (see BASELINE.md)"
@@ -500,6 +530,12 @@ def run_cpu_fresh(deadline_s: float = 300.0) -> dict:
             )
             out["cpu_topn_qps"] = round(qps, 3)
             out["cpu_topn_p50_ms"] = round(p50, 1)
+        if remaining() > 20:
+            # same closed-loop CPU window as run(): the ratio
+            # denominator stays measured even on the device-less path
+            out["cpu_topn_qps_c4"] = _measure_closed_loop(
+                cpu, topn[:2], 4, min(remaining() * 0.3, 6)
+            )
         if remaining() > 15:
             qps, p50, _ = _measure(
                 lambda q: cpu.execute("tall", q), chains[:2],

@@ -42,11 +42,11 @@ def bench_exec(dev, label):
 
 for mb in (1, 32, 64, 128):
     os.environ["PILOSA_CHAIN_MAX_BATCH"] = str(mb)
-    dev = Executor(h, device_policy="always")
     if mb == 1:
-        # max_batch=1 still routes through the scorer leader; to get true
-        # per-query pipelining (the old path), call the tree jit directly
-        # by monkeypatching score to bypass coalescing
+        # true per-query pipelining (the old path): chain batching off,
+        # plus a direct-score shim so not even the scorer leader runs
+        os.environ["PILOSA_CHAIN_BATCH"] = "0"
+        dev = Executor(h, device_policy="always")
         orig = dev.chain_scorer
         class _Direct:
             dispatches = None
@@ -57,4 +57,13 @@ for mb in (1, 32, 64, 128):
         dev.chain_scorer = _Direct()
         bench_exec(dev, "unbatched-pipelined")
     else:
+        # the coalescing gate is read from PILOSA_CHAIN_BATCH at
+        # Executor construction — set it BEFORE building the batched
+        # arm, or the arm silently measures the unbatched path
+        os.environ["PILOSA_CHAIN_BATCH"] = "1"
+        dev = Executor(h, device_policy="always")
         bench_exec(dev, f"batched-mb{mb}")
+        assert dev.chain_scorer.dispatches > 0, (
+            "batched arm never exercised the chain scorer — the "
+            "coalescing gate is not open (PILOSA_CHAIN_BATCH)"
+        )

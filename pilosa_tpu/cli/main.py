@@ -106,6 +106,12 @@ def main(argv=None) -> int:
         action="store_true",
         help="fetch /debug/traces (recent query span trees) instead",
     )
+    p.add_argument(
+        "--pipeline",
+        action="store_true",
+        help="fetch /debug/pipeline (serving-pipeline queue/shed/batch "
+        "snapshot) instead",
+    )
     p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("config", help="print the effective configuration")
@@ -424,12 +430,18 @@ def cmd_inspect(args) -> int:
 
 def cmd_metrics(args) -> int:
     """Dump a node's observability surface: Prometheus text from
-    /metrics, or the recent-trace ring buffer with --traces."""
+    /metrics, the recent-trace ring buffer with --traces, or the
+    serving-pipeline snapshot with --pipeline."""
     host = args.host if args.host.startswith("http") else f"http://{args.host}"
-    path = "/debug/traces" if args.traces else "/metrics"
+    if args.pipeline:
+        path = "/debug/pipeline"
+    elif args.traces:
+        path = "/debug/traces"
+    else:
+        path = "/metrics"
     with urllib.request.urlopen(host + path, timeout=60) as resp:
         body = resp.read().decode()
-    if args.traces:
+    if args.traces or args.pipeline:
         print(json.dumps(json.loads(body), indent=2))
     else:
         print(body, end="")

@@ -152,9 +152,7 @@ class BatchedScorer:
         if sp is not None:
             # backfill a span covering enqueue -> result (the wait was
             # spent inside finish(), so enter/exit timing can't be used)
-            ev = sp.child(metrics.STAGE_BATCH_SCORE, lead=lead)
-            ev.t0 = t0
-            ev.duration = wait
+            sp.record(metrics.STAGE_BATCH_SCORE, t0, wait, lead=lead)
         return out
 
     def _rescue(self) -> None:

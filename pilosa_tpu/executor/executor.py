@@ -54,6 +54,21 @@ AUTO_DEVICE_MIN_CONTAINERS = 64
 # layer maps it to 404 by type; any plain KeyError stays a 500)
 from pilosa_tpu.utils.errors import NotFoundError  # noqa: E402
 
+# Request-deadline seam (server/deadline.py). Imported LAZILY: a
+# top-level import would pull the server package (L6) into this module
+# (L4) at import time and trip the server→executor circular import;
+# resolving once at first use costs one global check per call after.
+_deadline_mod = None
+
+
+def _deadline():
+    global _deadline_mod
+    if _deadline_mod is None:
+        from pilosa_tpu.server import deadline as _m
+
+        _deadline_mod = _m
+    return _deadline_mod
+
 
 @dataclass
 class ValCount:
@@ -237,9 +252,7 @@ def _timed_kernel(kind: str, fn):
             metrics.observe(metrics.SPMD_EXECUTE_SECONDS, dt, kind=kind)
         sp = trace.current()
         if sp is not None:
-            ev = sp.child(metrics.STAGE_SPMD_KERNEL, kind=kind, first=first)
-            ev.t0 = t0
-            ev.duration = dt
+            sp.record(metrics.STAGE_SPMD_KERNEL, t0, dt, kind=kind, first=first)
         return out
 
     return run
@@ -378,6 +391,11 @@ class Executor:
         if isinstance(query, str):
             query = parse(query)
         opt = opt or ExecOptions()
+        # deadline boundary: a request whose deadline passed while it
+        # crossed the API layer is cancelled before any shard work
+        dl = _deadline().current()
+        if dl is not None:
+            dl.check(metrics.STAGE_EXECUTOR)
         idx = self.holder.index(index_name)
         if idx is None:
             raise NotFoundError(f"index not found: {index_name}")
@@ -409,9 +427,10 @@ class Executor:
                     )
                 pool = self._read_pool  # local ref: close() may null the attr
             parent = trace.current()  # contextvars don't follow pool workers
+            pdl = dl  # nor does the request deadline
 
             def run_call(call):
-                with trace.activate(parent):
+                with trace.activate(parent), _deadline().activate(pdl):
                     return self._execute_call(index_name, call, shards, opt)
 
             results = list(pool.map(run_call, query.calls))
@@ -619,9 +638,15 @@ class Executor:
             )
         result = zero_factory() if zero_factory else None
         # captured ONCE: the untraced loop body pays a single branch per
-        # shard, no span objects (ISSUE 1 overhead bound)
+        # shard, no span objects (ISSUE 1 overhead bound); same for the
+        # deadline — one contextvar read, then a monotonic compare per
+        # shard, so expired work stops at the next shard boundary
+        # instead of finishing a result nobody will read
         parent = trace.current()
+        dl = _deadline().current()
         for shard in shards:
+            if dl is not None:
+                dl.check(metrics.STAGE_MAP_SHARD)
             if parent is not None:
                 with parent.child(metrics.STAGE_MAP_SHARD, shard=shard):
                     v = map_fn(shard)

@@ -2,7 +2,9 @@
 
 from pilosa_tpu.server.api import API, APIError, NotFoundError
 from pilosa_tpu.server.config import ClusterConfig, Config, TLSConfig
+from pilosa_tpu.server.deadline import Deadline, DeadlineExceeded
 from pilosa_tpu.server.http_handler import Handler, encode_result, make_http_server
+from pilosa_tpu.server.pipeline import Overloaded, QueryPipeline
 from pilosa_tpu.server.server import Server
 
 __all__ = [
@@ -11,8 +13,12 @@ __all__ = [
     "ClusterConfig",
     "TLSConfig",
     "Config",
+    "Deadline",
+    "DeadlineExceeded",
     "Handler",
     "NotFoundError",
+    "Overloaded",
+    "QueryPipeline",
     "Server",
     "encode_result",
     "make_http_server",

@@ -18,6 +18,7 @@ from pilosa_tpu.core import FieldOptions, Row
 from pilosa_tpu.core.view import VIEW_STANDARD
 from pilosa_tpu.executor import ExecOptions
 from pilosa_tpu.pql import parse
+from pilosa_tpu.server import deadline, pipeline
 from pilosa_tpu.utils import metrics, trace
 
 # cluster states (reference cluster.go:42-45)
@@ -97,6 +98,11 @@ class API:
         profile: bool = False,
     ) -> dict:
         self._validate("query")
+        # deadline boundary: cancel BEFORE the parse — an expired
+        # request must cost the server nothing past this line
+        dl = deadline.current()
+        if dl is not None:
+            dl.check(metrics.STAGE_QUERY)
         opt = ExecOptions(
             remote=remote,
             exclude_row_attrs=exclude_row_attrs,
@@ -107,6 +113,14 @@ class API:
         # the untraced query allocates no span anywhere below)
         root = trace.TRACER.trace(metrics.STAGE_QUERY, force=profile, index=index)
         with root:
+            # when this query came through the serving pipeline, its
+            # admission-queue wait predates the root span — backfill it
+            # so profile=true shows where serving latency went
+            wait = pipeline.current_queue_wait()
+            if wait > 0 and root is not trace.NOP_SPAN:
+                root.record(
+                    metrics.STAGE_PIPELINE_WAIT, root.t0 - wait, wait
+                )
             try:
                 q = parse(query)
             except Exception as e:

@@ -89,6 +89,31 @@ class Config:
     # query over the threshold (0 = off). Complementary to
     # cluster.long-query-time, which logs only the query text.
     slow_query_time: float = 0.0
+    # serving pipeline (server/pipeline.py): the admission/scheduling
+    # layer between HTTP and the executor. Per-class bounded queues +
+    # dedicated worker pools; a full queue sheds 429 + Retry-After.
+    pipeline_enabled: bool = True
+    pipeline_interactive_workers: int = 8
+    pipeline_bulk_workers: int = 2
+    pipeline_internal_workers: int = 8
+    pipeline_interactive_queue: int = 64
+    pipeline_bulk_queue: int = 16
+    pipeline_internal_queue: int = 128
+    # cross-request batching: max homogeneous queued queries combined
+    # into one executor call (1 disables), and an OPTIONAL artificial
+    # wait (seconds) for peers — 0 (default) batches purely from
+    # backlog, so an uncontended query pays no added latency
+    pipeline_batch_max: int = 16
+    pipeline_batch_window: float = 0.0
+    # default per-request deadline in seconds when the client sends
+    # neither a `timeout` param nor an X-Request-Deadline header
+    # (0 = unbounded)
+    pipeline_default_timeout: float = 0.0
+    # Retry-After seconds on a 429 shed
+    pipeline_shed_retry_after: float = 1.0
+    # graceful-drain budget at shutdown: queued + in-flight work gets
+    # this long to complete before being failed 503
+    pipeline_drain_timeout: float = 10.0
     # opt-in diagnostics phone-home endpoint (reference diagnostics.go);
     # empty = disabled
     diagnostics_host: str = ""
@@ -162,6 +187,12 @@ class Config:
             f"trace-sample-rate = {self.trace_sample_rate}",
             f"slow-query-time = {self.slow_query_time}",
             f"anti-entropy-interval = {self.anti_entropy_interval}",
+            f"pipeline-enabled = {'true' if self.pipeline_enabled else 'false'}",
+            f"pipeline-interactive-workers = {self.pipeline_interactive_workers}",
+            f"pipeline-interactive-queue = {self.pipeline_interactive_queue}",
+            f"pipeline-batch-max = {self.pipeline_batch_max}",
+            f"pipeline-default-timeout = {self.pipeline_default_timeout}",
+            f"pipeline-drain-timeout = {self.pipeline_drain_timeout}",
             "",
             "[cluster]",
             f"disabled = {'true' if self.cluster.disabled else 'false'}",

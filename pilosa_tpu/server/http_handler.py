@@ -18,10 +18,23 @@ from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu.core import Row
 from pilosa_tpu.executor import ValCount
+from pilosa_tpu.server import deadline as deadline_mod
 from pilosa_tpu.server.api import API, APIError
+from pilosa_tpu.server.deadline import DeadlineExceeded
+from pilosa_tpu.server.pipeline import (
+    CLASS_BULK,
+    CLASS_INTERACTIVE,
+    CLASS_INTERNAL,
+    Overloaded,
+)
 from pilosa_tpu.utils.errors import NotFoundError as ExecNotFound
 from pilosa_tpu.utils import metrics, privateproto, publicproto, trace
 from pilosa_tpu.utils.stats import NOP_STATS
+
+# conservative write detector for coalescing/batching eligibility: any
+# hit (even a false positive from a quoted key) just forfeits the
+# optimization, never correctness
+_WRITE_CALL_RE = re.compile(r"\b(?:Set\w*|Clear)\s*\(")
 
 
 def _require(body: dict, *keys: str) -> None:
@@ -76,11 +89,23 @@ class Route:
 class Handler:
     """Routing table + request glue, served by ThreadingHTTPServer."""
 
-    def __init__(self, api: API, logger=None, stats=NOP_STATS, long_query_time: float = 0.0) -> None:
+    def __init__(
+        self,
+        api: API,
+        logger=None,
+        stats=NOP_STATS,
+        long_query_time: float = 0.0,
+        pipeline=None,
+        default_timeout: float = 0.0,
+    ) -> None:
         self.api = api
         self.logger = logger
         self.stats = stats
         self.long_query_time = long_query_time
+        # serving pipeline (server/pipeline.py); None = direct execution
+        # (bare handlers in tests, pipeline-enabled = false)
+        self.pipeline = pipeline
+        self.default_timeout = default_timeout
         a = api
         self.routes = [
             # public (reference handler.go:188-231)
@@ -159,6 +184,7 @@ class Handler:
                 self.post_row_attr_diff,
             ),
             Route("GET", r"/metrics", self.get_metrics),
+            Route("GET", r"/debug/pipeline", self.get_debug_pipeline),
             Route("GET", r"/debug/vars", self.get_debug_vars),
             Route("GET", r"/debug/traces", self.get_debug_traces),
             # index (with and without trailing slash, as net/http/pprof
@@ -168,6 +194,17 @@ class Handler:
         ]
 
     # -- route handlers --
+
+    def _submit(self, cls, thunk, dl, signature=None, batch=None):
+        """Run ``thunk`` through the serving pipeline (admission,
+        deadline, coalescing, batching) — or directly, deadline still
+        honored, when no pipeline is wired."""
+        if self.pipeline is not None:
+            return self.pipeline.submit(
+                cls, thunk, deadline=dl, signature=signature, batch=batch
+            )
+        with deadline_mod.activate(dl):
+            return thunk()
 
     def post_query(self, req) -> dict:
         index = req.params["index"]
@@ -192,17 +229,51 @@ class Handler:
             exclude_columns = q.get("excludeColumns", ["false"])[0] == "true"
             column_attrs = q.get("columnAttrs", ["false"])[0] == "true"
         profile = q.get("profile", ["false"])[0] == "true"
+        dl = deadline_mod.from_request(req.headers, q, self.default_timeout)
+        # pipeline classification: remote legs of distributed queries
+        # are internal traffic (their own queue — a user-query flood
+        # must not shed the cluster data plane); everything else is
+        # interactive. Read-only queries coalesce (singleflight) by
+        # exact signature; plain whole-index reads additionally gang
+        # into combined cross-request executions.
+        cls = CLASS_INTERNAL if remote else CLASS_INTERACTIVE
+        signature = None
+        batch = None
+        if not remote and not profile and not _WRITE_CALL_RE.search(body):
+            signature = (
+                "q",
+                index,
+                body,
+                tuple(shards) if shards is not None else None,
+                exclude_row_attrs,
+                exclude_columns,
+                column_attrs,
+            )
+            if shards is None and not column_attrs:
+                batch = {
+                    "key": (index, exclude_row_attrs, exclude_columns),
+                    "index": index,
+                    "query": body,
+                    "kwargs": {
+                        "exclude_row_attrs": exclude_row_attrs,
+                        "exclude_columns": exclude_columns,
+                    },
+                }
+
+        def thunk():
+            return self.api.query(
+                index,
+                body,
+                shards=shards,
+                remote=remote,
+                exclude_row_attrs=exclude_row_attrs,
+                exclude_columns=exclude_columns,
+                column_attrs=column_attrs,
+                profile=profile,
+            )
+
         t0 = time.monotonic()
-        resp = self.api.query(
-            index,
-            body,
-            shards=shards,
-            remote=remote,
-            exclude_row_attrs=exclude_row_attrs,
-            exclude_columns=exclude_columns,
-            column_attrs=column_attrs,
-            profile=profile,
-        )
+        resp = self._submit(cls, thunk, dl, signature=signature, batch=batch)
         dur = time.monotonic() - t0
         # slow-query logging (reference handler.go:257-261)
         if self.long_query_time and dur > self.long_query_time and self.logger:
@@ -262,23 +333,33 @@ class Handler:
                 ]
         else:
             body = json.loads(req.body or b"{}")
+        dl = deadline_mod.from_request(req.headers, req.query, self.default_timeout)
         if body.get("local"):
-            self.api.import_bits_local(
+            # owner-side leg of a routed import: internal traffic
+            self._submit(
+                CLASS_INTERNAL,
+                lambda: self.api.import_bits_local(
+                    req.params["index"],
+                    req.params["field"],
+                    body.get("rowIDs", []),
+                    body.get("columnIDs", []),
+                    timestamps=body.get("timestamps"),
+                ),
+                dl,
+            )
+            return self._import_ok(req)
+        self._submit(
+            CLASS_BULK,
+            lambda: self.api.import_bits(
                 req.params["index"],
                 req.params["field"],
                 body.get("rowIDs", []),
                 body.get("columnIDs", []),
                 timestamps=body.get("timestamps"),
-            )
-            return self._import_ok(req)
-        self.api.import_bits(
-            req.params["index"],
-            req.params["field"],
-            body.get("rowIDs", []),
-            body.get("columnIDs", []),
-            timestamps=body.get("timestamps"),
-            row_keys=body.get("rowKeys"),
-            column_keys=body.get("columnKeys"),
+                row_keys=body.get("rowKeys"),
+                column_keys=body.get("columnKeys"),
+            ),
+            dl,
         )
         return self._import_ok(req)
 
@@ -293,20 +374,29 @@ class Handler:
             body = _decode_proto(publicproto.decode_import_value_request, req.body)
         else:
             body = json.loads(req.body or b"{}")
+        dl = deadline_mod.from_request(req.headers, req.query, self.default_timeout)
         if body.get("local"):
-            self.api.import_values_local(
+            self._submit(
+                CLASS_INTERNAL,
+                lambda: self.api.import_values_local(
+                    req.params["index"],
+                    req.params["field"],
+                    body.get("columnIDs", []),
+                    body.get("values", []),
+                ),
+                dl,
+            )
+            return self._import_ok(req)
+        self._submit(
+            CLASS_BULK,
+            lambda: self.api.import_values(
                 req.params["index"],
                 req.params["field"],
                 body.get("columnIDs", []),
                 body.get("values", []),
-            )
-            return self._import_ok(req)
-        self.api.import_values(
-            req.params["index"],
-            req.params["field"],
-            body.get("columnIDs", []),
-            body.get("values", []),
-            column_keys=body.get("columnKeys"),
+                column_keys=body.get("columnKeys"),
+            ),
+            dl,
         )
         return self._import_ok(req)
 
@@ -412,12 +502,18 @@ class Handler:
 
     def post_fragment_data(self, req) -> dict:
         q = req.query
-        self.api.unmarshal_fragment(
-            _qreq(q, "index"),
-            _qreq(q, "field"),
-            q.get("view", ["standard"])[0],
-            int(_qreq(q, "shard")),
-            req.body,
+        # resize/backup streaming: heavy internal data-plane work, so it
+        # rides the internal admission queue
+        self._submit(
+            CLASS_INTERNAL,
+            lambda: self.api.unmarshal_fragment(
+                _qreq(q, "index"),
+                _qreq(q, "field"),
+                q.get("view", ["standard"])[0],
+                int(_qreq(q, "shard")),
+                req.body,
+            ),
+            deadline_mod.from_request(req.headers, q, self.default_timeout),
         )
         return {}
 
@@ -508,6 +604,13 @@ class Handler:
             text.encode(), "text/plain; version=0.0.4; charset=utf-8"
         )
 
+    def get_debug_pipeline(self, req) -> dict:
+        """Serving-pipeline snapshot: per-class queue depth/limit,
+        busy workers, admissions, sheds, coalesce/batch counters."""
+        if self.pipeline is None:
+            return {"enabled": False}
+        return self.pipeline.stats()
+
     def get_debug_traces(self, req) -> dict:
         """Recent completed query traces (the tracer's ring buffer) as
         JSON span trees, newest last."""
@@ -595,6 +698,7 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
             length = int(self.headers.get("Content-Length") or 0)
             if length:
                 body = self.rfile.read(length)
+            extra_headers = []
             try:
                 result = handler.handle(
                     method,
@@ -610,6 +714,21 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
                     payload = json.dumps(result).encode()
                     ctype = "application/json"
                 self.send_response(200)
+            except Overloaded as e:
+                # admission shed (429, retry later) or draining (503);
+                # Retry-After tells well-behaved clients when to come
+                # back instead of hammering an overloaded server
+                payload, ctype = self._error_payload(str(e))
+                if e.status == 429:
+                    extra_headers.append(
+                        ("Retry-After", str(max(1, round(e.retry_after))))
+                    )
+                self.send_response(e.status)
+            except DeadlineExceeded as e:
+                # the request's deadline passed; work was cancelled at a
+                # stage boundary — 504, like a gateway timeout
+                payload, ctype = self._error_payload(str(e))
+                self.send_response(504)
             except APIError as e:
                 payload, ctype = self._error_payload(str(e))
                 self.send_response(e.status)
@@ -653,6 +772,8 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
                 traceback.print_exc()
                 payload, ctype = self._error_payload(f"internal error: {e}")
                 self.send_response(500)
+            for name, value in extra_headers:
+                self.send_header(name, value)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
@@ -692,4 +813,13 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
         def do_DELETE(self):
             self._run("DELETE")
 
-    return ThreadingHTTPServer((host, port), _Req)
+    class _Srv(ThreadingHTTPServer):
+        # socketserver's default listen backlog is 5: under a closed-loop
+        # client fleet (each request a fresh connection) the SYN queue
+        # overflows and the kernel RSTs connections before the pipeline
+        # can even shed them politely. The pipeline is the admission
+        # layer — the transport backlog just needs to be deep enough to
+        # hand every arrival to it.
+        request_queue_size = 128
+
+    return _Srv((host, port), _Req)

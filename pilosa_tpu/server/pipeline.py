@@ -1,0 +1,477 @@
+"""Serving pipeline — the scheduler between the HTTP transport and the
+executor.
+
+The round-5 measurement was blunt: the TPU kernel sustains thousands of
+queries per second but the serving path delivered ~120, because every
+request went straight from an unbounded ``ThreadingHTTPServer`` thread
+into ``Executor.execute`` with no queue, no deadlines, and no overload
+behavior. Inference-serving systems close this gap with a scheduling
+layer in exactly this position (Clipper-style adaptive batching,
+Orca-style continuous batching); this module is that layer:
+
+* **Bounded admission, per class.** Requests are classed ``interactive``
+  (user queries), ``bulk`` (imports), or ``internal`` (node-to-node
+  legs of distributed queries/imports), each with its own bounded queue
+  and dedicated worker pool — a flood of user queries cannot starve the
+  cluster data plane, and a bulk import cannot starve reads. A full
+  queue sheds the request immediately with ``Overloaded`` (HTTP 429 +
+  ``Retry-After``) instead of piling up threads until the process
+  falls over.
+* **Deadline scheduling.** Each entry carries its request deadline
+  (server/deadline.py); work whose deadline passed while queued is
+  cancelled at dequeue — before the parse, the executor, or any shard
+  map runs — so an overloaded server spends its workers only on
+  requests that can still be answered in time.
+* **Singleflight coalescing.** Identical concurrent read-only queries
+  (same index, text, and options) execute ONCE; duplicates attach to
+  the in-flight leader and share its result without consuming a queue
+  slot or a worker.
+* **Cross-request batching.** When the queue backs up, a worker drains
+  every queued entry with the same batch key (same index + options,
+  read-only) in one gang and executes them as a single combined
+  multi-call query. The executor fans the combined calls through its
+  read pool, where the continuous ``BatchedScorer`` (and, when enabled,
+  the chain-batch gate) coalesces them into batched kernel launches —
+  extending the batching that previously only helped within one HTTP
+  request to the whole queue. There is no artificial wait window by
+  default (``pipeline-batch-window`` can add one): like the scorer,
+  batch width self-tunes to the backlog.
+* **Graceful drain.** ``close()`` stops admission (503), completes
+  queued + in-flight work within ``drain`` seconds, and fails whatever
+  remains — a restart loses no accepted work it had time to finish.
+
+Observability: every decision lands in the process-global metric
+registry (queue depth/wait, sheds, coalesce hits, batch width, deadline
+expiries — docs/administration.md §Metric reference) and in the
+``/debug/pipeline`` snapshot.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+from pilosa_tpu.server import deadline as deadline_mod
+from pilosa_tpu.server.deadline import Deadline, DeadlineExceeded
+from pilosa_tpu.utils import metrics
+
+CLASS_INTERACTIVE = "interactive"
+CLASS_BULK = "bulk"
+CLASS_INTERNAL = "internal"
+CLASSES = (CLASS_INTERACTIVE, CLASS_BULK, CLASS_INTERNAL)
+
+
+class Overloaded(Exception):
+    """Admission refused. ``status`` 429 (queue full — retry after
+    ``retry_after`` seconds) or 503 (server draining)."""
+
+    def __init__(self, message: str, retry_after: float = 1.0, status: int = 429) -> None:
+        super().__init__(message)
+        self.retry_after = retry_after
+        self.status = status
+
+
+# wait time (seconds) the current pipeline worker's entry spent queued;
+# API.query backfills it as a `pipeline.wait` span on the root trace
+_entry_wait: "threading.local" = threading.local()
+
+
+def current_queue_wait() -> float:
+    return getattr(_entry_wait, "value", 0.0)
+
+
+class _Entry:
+    __slots__ = (
+        "cls",
+        "thunk",
+        "signature",
+        "batch_key",
+        "batch_payload",
+        "deadline",
+        "event",
+        "result",
+        "error",
+        "t_enq",
+        "wait_s",
+    )
+
+    def __init__(
+        self,
+        cls: str,
+        thunk: Callable[[], Any],
+        signature=None,
+        batch_key=None,
+        batch_payload=None,
+        deadline: Optional[Deadline] = None,
+    ) -> None:
+        self.cls = cls
+        self.thunk = thunk
+        self.signature = signature
+        self.batch_key = batch_key
+        self.batch_payload = batch_payload
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self.t_enq = 0.0
+        self.wait_s = 0.0
+
+
+class _ClassQueue:
+    """One bounded admission queue + its dedicated workers."""
+
+    __slots__ = (
+        "name",
+        "limit",
+        "workers",
+        "q",
+        "busy",
+        "admitted",
+        "sheds",
+        "completed",
+    )
+
+    def __init__(self, name: str, limit: int, workers: int) -> None:
+        self.name = name
+        self.limit = limit
+        self.workers = workers
+        self.q: deque[_Entry] = deque()
+        self.busy = 0
+        self.admitted = 0
+        self.sheds = 0
+        self.completed = 0
+
+
+def make_query_combiner(api) -> Callable:
+    """Gang executor for homogeneous read-only queries: concatenate the
+    members' PQL (PQL is whitespace-separated calls), run ONE
+    ``api.query``, and split the results back by each member's call
+    count. The combined call list flows through the executor's
+    concurrent read pool, where the batched scorers coalesce the
+    members' kernel work into single launches — cross-request batching
+    through entirely existing machinery. Any error falls back to
+    per-entry execution (the pipeline worker handles that), so a bad
+    member can never fail its gang-mates."""
+    from pilosa_tpu.pql import parse
+
+    def combine(entries: list[_Entry]) -> list[dict]:
+        p = entries[0].batch_payload
+        texts = [e.batch_payload["query"] for e in entries]
+        # per-member call counts; also surfaces a syntax error BEFORE
+        # the combined execution so the fallback gives it a proper 400
+        counts = [len(parse(t).calls) for t in texts]
+        resp = api.query(p["index"], " ".join(texts), **p["kwargs"])
+        results = resp["results"]
+        out, off = [], 0
+        for n in counts:
+            out.append({"results": results[off : off + n]})
+            off += n
+        return out
+
+    return combine
+
+
+class QueryPipeline:
+    """The scheduler. ``submit`` blocks the calling (HTTP) thread until
+    its entry is executed by a class worker, shed, or expired — the
+    transport thread still writes the response, but execution
+    concurrency and queue growth are bounded here."""
+
+    def __init__(
+        self,
+        workers: Optional[dict[str, int]] = None,
+        queue_limits: Optional[dict[str, int]] = None,
+        combine_fn: Optional[Callable] = None,
+        batch_max: int = 16,
+        batch_window: float = 0.0,
+        shed_retry_after: float = 1.0,
+        drain_timeout: float = 10.0,
+    ) -> None:
+        workers = workers or {}
+        queue_limits = queue_limits or {}
+        defaults_w = {CLASS_INTERACTIVE: 8, CLASS_BULK: 2, CLASS_INTERNAL: 8}
+        defaults_q = {CLASS_INTERACTIVE: 64, CLASS_BULK: 16, CLASS_INTERNAL: 128}
+        self._mu = threading.Lock()
+        self._cond = threading.Condition(self._mu)
+        self._classes = {
+            c: _ClassQueue(
+                c,
+                max(1, int(queue_limits.get(c, defaults_q[c]))),
+                max(1, int(workers.get(c, defaults_w[c]))),
+            )
+            for c in CLASSES
+        }
+        self.combine_fn = combine_fn
+        self.batch_max = max(1, int(batch_max))
+        self.batch_window = float(batch_window)
+        self.shed_retry_after = float(shed_retry_after)
+        self.drain_timeout = float(drain_timeout)
+        self._closing = False
+        # signature -> leader entry (singleflight)
+        self._inflight: dict = {}
+        # cross-class counters (ints under _mu; snapshot is consistent)
+        self.coalesce_hits = 0
+        self.batches = 0
+        self.batched_entries = 0
+        self.expired = 0
+        self._threads: list[threading.Thread] = []
+        for c, cq in self._classes.items():
+            for i in range(cq.workers):
+                t = threading.Thread(
+                    target=self._worker, args=(cq,), name=f"pipeline-{c}-{i}",
+                    daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(
+        self,
+        cls: str,
+        thunk: Callable[[], Any],
+        deadline: Optional[Deadline] = None,
+        signature=None,
+        batch: Optional[dict] = None,
+    ) -> Any:
+        """Run ``thunk`` through the pipeline and return its result.
+        Raises Overloaded (shed / draining), DeadlineExceeded, or
+        whatever the thunk raised."""
+        cq = self._classes[cls]
+        entry = _Entry(
+            cls,
+            thunk,
+            signature=signature,
+            batch_key=batch["key"] if batch else None,
+            batch_payload=batch,
+            deadline=deadline,
+        )
+        leader: Optional[_Entry] = None
+        with self._mu:
+            if self._closing:
+                raise Overloaded("server is draining", status=503)
+            if signature is not None:
+                leader = self._inflight.get(signature)
+                if leader is not None:
+                    # duplicate of an in-flight query: attach, consume
+                    # no queue slot, no worker
+                    self.coalesce_hits += 1
+                    metrics.count(metrics.PIPELINE_COALESCE_HITS)
+                else:
+                    self._inflight[signature] = entry
+            if leader is None:
+                if len(cq.q) >= cq.limit:
+                    cq.sheds += 1
+                    metrics.count(metrics.PIPELINE_SHEDS, cls=cls)
+                    if signature is not None:
+                        self._inflight.pop(signature, None)
+                    raise Overloaded(
+                        f"{cls} admission queue full "
+                        f"({len(cq.q)}/{cq.limit}); retry later",
+                        retry_after=self.shed_retry_after,
+                    )
+                entry.t_enq = time.monotonic()
+                cq.q.append(entry)
+                cq.admitted += 1
+                metrics.count(metrics.PIPELINE_ADMITTED, cls=cls)
+                metrics.gauge(metrics.PIPELINE_QUEUE_DEPTH, len(cq.q), cls=cls)
+                self._cond.notify_all()
+        # wait OUTSIDE the lock (workers need it to make progress)
+        return self._await(leader if leader is not None else entry, deadline)
+    def _await(self, entry: _Entry, dl: Optional[Deadline]):
+        """Block until ``entry`` resolves; a waiter whose own deadline
+        passes first stops waiting (its queued work is skipped by the
+        worker's dequeue-time check; a follower simply detaches)."""
+        if dl is None:
+            entry.event.wait()
+        else:
+            while not entry.event.is_set():
+                rem = dl.remaining()
+                if rem <= 0:
+                    dl.check("admission")  # raises (and counts)
+                entry.event.wait(timeout=min(rem, 0.5))
+        if entry.error is not None:
+            raise entry.error
+        return entry.result
+
+    # -- workers -------------------------------------------------------------
+
+    def _worker(self, cq: _ClassQueue) -> None:
+        while True:
+            with self._mu:
+                while not cq.q and not self._closing:
+                    self._cond.wait()
+                if not cq.q:
+                    return  # closing and drained
+                gang = self._dequeue_gang(cq)
+                cq.busy += len(gang)
+                metrics.gauge(metrics.PIPELINE_QUEUE_DEPTH, len(cq.q), cls=cq.name)
+            try:
+                self._run_gang(cq, gang)
+            finally:
+                with self._mu:
+                    cq.busy -= len(gang)
+                    cq.completed += len(gang)
+
+    def _dequeue_gang(self, cq: _ClassQueue) -> list[_Entry]:
+        """Pop the head entry plus every queued peer sharing its batch
+        key (up to batch_max) — the backlog IS the batching window.
+        Caller holds the lock."""
+        head = cq.q.popleft()
+        gang = [head]
+        if head.batch_key is None or self.batch_max < 2 or not self.combine_fn:
+            return gang
+        if cq.q:
+            keep: deque[_Entry] = deque()
+            for e in cq.q:
+                if e.batch_key == head.batch_key and len(gang) < self.batch_max:
+                    gang.append(e)
+                else:
+                    keep.append(e)
+            cq.q.clear()
+            cq.q.extend(keep)
+        return gang
+
+    def _collect_window(self, cq: _ClassQueue, gang: list[_Entry]) -> list[_Entry]:
+        """Optional artificial batching window: wait up to
+        ``batch_window`` for same-key arrivals before executing. Off by
+        default (0) — the continuous design needs no wait under load
+        and a lone query must not pay latency for an empty queue."""
+        if self.batch_window <= 0 or len(gang) >= self.batch_max:
+            return gang
+        stop = time.monotonic() + self.batch_window
+        key = gang[0].batch_key
+        while time.monotonic() < stop and len(gang) < self.batch_max:
+            with self._mu:
+                took = [e for e in cq.q if e.batch_key == key]
+                for e in took[: self.batch_max - len(gang)]:
+                    cq.q.remove(e)
+                    gang.append(e)
+            if len(gang) >= self.batch_max:
+                break
+            time.sleep(min(0.0005, self.batch_window))
+        return gang
+
+    def _run_gang(self, cq: _ClassQueue, gang: list[_Entry]) -> None:
+        if gang and gang[0].batch_key is not None:
+            gang = self._collect_window(cq, gang)
+        now = time.monotonic()
+        live: list[_Entry] = []
+        for e in gang:
+            e.wait_s = now - e.t_enq
+            metrics.observe(metrics.PIPELINE_WAIT_SECONDS, e.wait_s, cls=cq.name)
+            if e.deadline is not None and e.deadline.expired():
+                # expired while queued: cancel BEFORE any parse/executor
+                # work (its waiter already raised or will immediately)
+                with self._mu:
+                    self.expired += 1
+                metrics.count(metrics.PIPELINE_DEADLINE_EXPIRED, stage="queue")
+                self._finish(e, error=DeadlineExceeded("queue"))
+                continue
+            live.append(e)
+        if not live:
+            return
+        if len(live) >= 2 and self.combine_fn is not None:
+            with self._mu:
+                self.batches += 1
+                self.batched_entries += len(live)
+            metrics.count(metrics.PIPELINE_BATCHES)
+            metrics.observe(metrics.PIPELINE_BATCH_WIDTH, len(live))
+            dls = [e.deadline for e in live if e.deadline is not None]
+            gang_dl = min(dls, key=lambda d: d.at) if dls else None
+            try:
+                with deadline_mod.activate(gang_dl):
+                    results = self.combine_fn(live)
+                for e, r in zip(live, results):
+                    self._finish(e, result=r)
+                return
+            except BaseException:
+                # combined execution failed (one bad member, deadline,
+                # anything): fall back to per-entry execution so each
+                # member gets ITS OWN outcome
+                pass
+        for e in live:
+            self._run_one(e)
+
+    def _run_one(self, e: _Entry) -> None:
+        if e.deadline is not None and e.deadline.expired():
+            with self._mu:
+                self.expired += 1
+            metrics.count(metrics.PIPELINE_DEADLINE_EXPIRED, stage="queue")
+            self._finish(e, error=DeadlineExceeded("queue"))
+            return
+        _entry_wait.value = e.wait_s
+        try:
+            with deadline_mod.activate(e.deadline):
+                self._finish(e, result=e.thunk())
+        except BaseException as err:
+            self._finish(e, error=err)
+        finally:
+            _entry_wait.value = 0.0
+
+    def _finish(self, e: _Entry, result=None, error=None) -> None:
+        e.result = result
+        e.error = error
+        if e.signature is not None:
+            with self._mu:
+                if self._inflight.get(e.signature) is e:
+                    del self._inflight[e.signature]
+        e.event.set()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, drain: Optional[float] = None) -> bool:
+        """Graceful drain: stop admission, let the workers complete
+        queued + in-flight work, fail the rest after ``drain`` seconds.
+        Returns True when everything drained in time."""
+        drain = self.drain_timeout if drain is None else drain
+        t0 = time.monotonic()
+        with self._mu:
+            if self._closing:
+                return True
+            self._closing = True
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=max(0.0, drain - (time.monotonic() - t0)))
+        clean = True
+        with self._mu:
+            for cq in self._classes.values():
+                while cq.q:
+                    clean = False
+                    e = cq.q.popleft()
+                    self._finish(
+                        e, error=Overloaded("server shut down", status=503)
+                    )
+        metrics.observe(metrics.PIPELINE_DRAIN_SECONDS, time.monotonic() - t0)
+        return clean and all(not t.is_alive() for t in self._threads)
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The /debug/pipeline snapshot."""
+        with self._mu:
+            return {
+                "enabled": True,
+                "closing": self._closing,
+                "batch_max": self.batch_max,
+                "batch_window_s": self.batch_window,
+                "coalesce_hits": self.coalesce_hits,
+                "coalesce_inflight": len(self._inflight),
+                "batches": self.batches,
+                "batched_entries": self.batched_entries,
+                "deadline_expired": self.expired,
+                "classes": {
+                    c: {
+                        "queue_depth": len(cq.q),
+                        "queue_limit": cq.limit,
+                        "workers": cq.workers,
+                        "busy": cq.busy,
+                        "admitted": cq.admitted,
+                        "sheds": cq.sheds,
+                        "completed": cq.completed,
+                    }
+                    for c, cq in self._classes.items()
+                },
+            }

@@ -154,11 +154,41 @@ class Server:
             ),
         )
         self.api = API(self.holder, self.executor, cluster=cluster, server=self)
+        # serving pipeline (server/pipeline.py): every query/import
+        # request flows through bounded per-class admission queues with
+        # deadline scheduling, singleflight coalescing, and
+        # cross-request batching into the executor's scorers
+        self.pipeline = None
+        if self.config.pipeline_enabled:
+            from pilosa_tpu.server.pipeline import (
+                QueryPipeline,
+                make_query_combiner,
+            )
+
+            self.pipeline = QueryPipeline(
+                workers={
+                    "interactive": self.config.pipeline_interactive_workers,
+                    "bulk": self.config.pipeline_bulk_workers,
+                    "internal": self.config.pipeline_internal_workers,
+                },
+                queue_limits={
+                    "interactive": self.config.pipeline_interactive_queue,
+                    "bulk": self.config.pipeline_bulk_queue,
+                    "internal": self.config.pipeline_internal_queue,
+                },
+                combine_fn=make_query_combiner(self.api),
+                batch_max=self.config.pipeline_batch_max,
+                batch_window=self.config.pipeline_batch_window,
+                shed_retry_after=self.config.pipeline_shed_retry_after,
+                drain_timeout=self.config.pipeline_drain_timeout,
+            )
         self.handler = Handler(
             self.api,
             logger=self.logger,
             stats=self.stats,
             long_query_time=self.config.cluster.long_query_time,
+            pipeline=self.pipeline,
+            default_timeout=self.config.pipeline_default_timeout,
         )
         self.diagnostics = DiagnosticsCollector(
             host=getattr(self.config, "diagnostics_host", ""),
@@ -618,6 +648,17 @@ class Server:
 
     def close(self) -> None:
         self._closed.set()
+        # graceful drain FIRST: stop admitting (new requests get 503),
+        # complete queued + in-flight work within the drain budget, so
+        # a restart loses nothing the server had accepted and could
+        # still finish
+        if self.pipeline is not None:
+            clean = self.pipeline.close()
+            if not clean:
+                self.logger.printf(
+                    "pipeline drain timed out after %.1fs; remaining work failed 503",
+                    self.config.pipeline_drain_timeout,
+                )
         if self.gc_notifier is not None:
             self.gc_notifier.close()
         self.stats.close()

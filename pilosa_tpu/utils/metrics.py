@@ -101,6 +101,16 @@ CACHE_MISSES = "cache.misses"
 # distributed map-reduce
 CLUSTER_MAP_REMOTE_SECONDS = "cluster.map_remote_seconds"
 CLUSTER_REMOTE_ERRORS = "cluster.remote_errors"
+# serving pipeline (server/pipeline.py)
+PIPELINE_ADMITTED = "pipeline.admitted"
+PIPELINE_SHEDS = "pipeline.sheds"
+PIPELINE_QUEUE_DEPTH = "pipeline.queue_depth"
+PIPELINE_WAIT_SECONDS = "pipeline.wait_seconds"
+PIPELINE_COALESCE_HITS = "pipeline.coalesce_hits"
+PIPELINE_BATCHES = "pipeline.batches"
+PIPELINE_BATCH_WIDTH = "pipeline.batch_width"
+PIPELINE_DEADLINE_EXPIRED = "pipeline.deadline_expired"
+PIPELINE_DRAIN_SECONDS = "pipeline.drain_seconds"
 # device health gate
 DEVICEHEALTH_HEALTHY = "devicehealth.healthy"
 DEVICEHEALTH_TRIPS = "devicehealth.trips"
@@ -165,6 +175,42 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter",
         "remote map-reduce legs that failed and re-mapped onto replicas (label: node)",
     ),
+    PIPELINE_ADMITTED: (
+        "counter",
+        "requests admitted to the serving pipeline (label: cls)",
+    ),
+    PIPELINE_SHEDS: (
+        "counter",
+        "requests shed 429 because a class admission queue was full (label: cls)",
+    ),
+    PIPELINE_QUEUE_DEPTH: (
+        "gauge",
+        "current admission-queue depth, per request class (label: cls)",
+    ),
+    PIPELINE_WAIT_SECONDS: (
+        "summary",
+        "time an admitted request waited in the queue before execution (label: cls)",
+    ),
+    PIPELINE_COALESCE_HITS: (
+        "counter",
+        "duplicate concurrent queries that attached to an in-flight execution",
+    ),
+    PIPELINE_BATCHES: (
+        "counter",
+        "cross-request gangs executed as one combined query",
+    ),
+    PIPELINE_BATCH_WIDTH: (
+        "summary",
+        "requests per cross-request combined execution",
+    ),
+    PIPELINE_DEADLINE_EXPIRED: (
+        "counter",
+        "requests cancelled at a stage boundary after their deadline passed (label: stage)",
+    ),
+    PIPELINE_DRAIN_SECONDS: (
+        "summary",
+        "graceful-drain duration at shutdown",
+    ),
     DEVICEHEALTH_HEALTHY: ("gauge", "1 while the device path is open, 0 while gated"),
     DEVICEHEALTH_TRIPS: ("counter", "device health gate trips (device gated off)"),
     DEVICEHEALTH_RESTORES: ("counter", "device health gate restores"),
@@ -186,6 +232,7 @@ METRICS: dict[str, tuple[str, str]] = {
 # -- trace stage names (pilosa_tpu/utils/trace.py span names) --------------
 
 STAGE_QUERY = "query"
+STAGE_PIPELINE_WAIT = "pipeline.wait"
 STAGE_EXECUTOR = "executor"
 STAGE_CALL = "executor.call"
 STAGE_MAP_SHARD = "executor.map_shard"
@@ -199,6 +246,7 @@ STAGE_MAP_LOCAL = "cluster.map_local"
 
 STAGES: dict[str, str] = {
     STAGE_QUERY: "root span, one per query (API layer)",
+    STAGE_PIPELINE_WAIT: "admission-queue wait before execution (backfilled)",
     STAGE_EXECUTOR: "Executor.execute body",
     STAGE_CALL: "one PQL call dispatch (meta: call)",
     STAGE_MAP_SHARD: "per-shard map leg (meta: shard)",

@@ -69,6 +69,9 @@ class _NopSpan:
     def event(self, name: str, **meta) -> None:
         pass
 
+    def record(self, name: str, t0: float, duration: float, **meta) -> "_NopSpan":
+        return self
+
     def annotate(self, **meta) -> None:
         pass
 
@@ -110,6 +113,18 @@ class Span:
         sp.t0 = time.monotonic()
         sp.duration = 0.0
         self.children.append(sp)
+
+    def record(self, name: str, t0: float, duration: float, **meta) -> "Span":
+        """Backfill a completed child span from externally-measured
+        times — for stages whose wait was spent elsewhere (a batcher
+        slot from enqueue to result, a kernel invocation wrapped by the
+        timing cache, the pipeline's admission-queue wait), where
+        enter/exit timing can't be used."""
+        sp = Span(name, **meta)
+        sp.t0 = t0
+        sp.duration = duration
+        self.children.append(sp)
+        return sp
 
     def annotate(self, **meta) -> None:
         self.meta.update(meta)

@@ -50,3 +50,30 @@ def test_vs_baseline_note_matches_mode(bench):
     seq = bench.vs_baseline_fields("sequential", 12.5, 0.4)
     assert "sequential qps both sides" in seq["vs_baseline_note"]
     assert bench.vs_baseline_fields("sequential", 12.5, None) == {}
+
+
+def test_vs_baseline_uses_measured_cpu_closed_loop_denominator(bench):
+    # when a CPU closed-loop window was measured, the serving ratio
+    # divides by the BEST measured CPU throughput, not the asserted
+    # sequential ceiling — the denominator is backed by data
+    out = bench.vs_baseline_fields(
+        "32 closed-loop clients", 112.4, 0.4, cpu_closed_qps=0.5
+    )
+    assert out["vs_baseline"] == round(112.4 / 0.5, 2)
+    assert out["baseline_cpu_closed_qps"] == 0.5
+    assert "measured" in out["vs_baseline_note"]
+    # a degraded closed-loop window never RAISES the ratio
+    out = bench.vs_baseline_fields(
+        "32 closed-loop clients", 112.4, 0.4, cpu_closed_qps=0.3
+    )
+    assert out["vs_baseline"] == round(112.4 / 0.4, 2)
+
+
+def test_vs_baseline_seq_ratio_rides_alongside(bench):
+    out = bench.vs_baseline_fields(
+        "64 closed-loop clients", 132.9, 0.4, seq_qps=12.5
+    )
+    assert out["vs_baseline_seq"] == round(12.5 / 0.4, 2)
+    # sequential mode: the headline IS the sequential ratio already
+    out = bench.vs_baseline_fields("sequential", 12.5, 0.4, seq_qps=12.5)
+    assert "vs_baseline_seq" not in out
