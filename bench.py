@@ -370,6 +370,165 @@ def _pipeline_serving_probe(budget_s: float) -> dict:
     return out
 
 
+def _rw_mix_probe(budget_s: float) -> dict:
+    """Read/write-mix steady state (ISSUE 3): c8 closed-loop TopN/chain
+    reads through the device executor with 1% interleaved single-bit
+    writes, in three arms — read-only (denominator), writes absorbed by
+    delta staging, and writes with delta staging disabled (every write
+    cold-invalidates and the next read re-uploads full blocks). Reports
+    steady-state read qps, re-staged bytes, and delta-apply counts per
+    arm. Chip-independent (the contrast is staging economics, not
+    kernel speed)."""
+    import shutil as _shutil
+    import tempfile
+
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import DeviceStager, Executor
+    from pilosa_tpu.utils import metrics as _metrics
+
+    # 256 rows × 4000 bits: big enough that a full chunk re-stage costs
+    # ~20 ms host packing (the cost a write used to impose on the next
+    # read) while a warm delta apply is ~1-4 ms; at chip scale the gap
+    # is upload-bound and orders of magnitude wider
+    R, BITS = 256, 4000
+    WRITE_FRAC = 0.01
+    tmp = tempfile.mkdtemp(prefix="pilosa_rwmix_")
+    out = {
+        "note": (
+            "c8 closed-loop TopN/chain reads on the device executor, 1% "
+            "single-bit writes; rw_delta absorbs writes as HBM scatter "
+            "deltas, rw_full_restage rebuilds staged blocks per write"
+        ),
+        "write_frac": WRITE_FRAC,
+    }
+    h = Holder(tmp)
+    h.open()
+    try:
+        idx = h.create_index("rw")
+        fld = idx.create_field("f")
+        rng = np.random.default_rng(42)
+        rows, cols = [], []
+        for r_ in range(R):
+            rows += [r_] * BITS
+            cols += rng.integers(0, 1 << 20, size=BITS).tolist()
+        fld.import_bits(rows, cols)
+        queries = [
+            "TopN(f, n=10)",
+            "TopN(f, Row(f=3), n=8)",
+            "Count(Intersect(Row(f=1), Row(f=2)))",
+            "Count(Union(Row(f=4), Row(f=5), Row(f=6)))",
+        ]
+
+        def arm(write_frac, delta_enabled, seconds, nonce):
+            # nonce keys every rng: arms writing the SAME (row, col)
+            # sequence as a previous arm would set already-set bits —
+            # no-op writes that never bump the generation and fake a
+            # write-free steady state
+            ex = Executor(
+                h,
+                device_policy="always",
+                stager=DeviceStager(delta_enabled=delta_enabled),
+            )
+            for q in queries:  # warm: compile + stage
+                ex.execute("rw", q)
+            if write_frac:
+                # absorb the write-path compiles too (delta scatter
+                # kernel shapes / restage packing) so the measured
+                # window is steady state, not first-write JIT
+                wrng = np.random.default_rng(7000 + nonce)
+                for w in range(4):
+                    fld.set_bit(w % 16, int(wrng.integers(0, 1 << 20)))
+                    for q in queries:
+                        ex.execute("rw", q)
+            snap0 = _metrics.snapshot()
+            stop = time.perf_counter() + seconds
+            reads = [0] * 8
+            writes = [0] * 8
+            errors: list = []
+
+            def worker(ci):
+                wr = np.random.default_rng(1000 + nonce * 8 + ci)
+                i = ci
+                try:
+                    while time.perf_counter() < stop and not errors:
+                        if write_frac and wr.random() < write_frac:
+                            # writes land on the rows the read mix keeps
+                            # staged (chain sources + TopN candidates) —
+                            # the worst case for staging, which is the
+                            # point of the probe
+                            fld.set_bit(
+                                int(wr.integers(0, 16)),
+                                int(wr.integers(0, 1 << 20)),
+                            )
+                            writes[ci] += 1
+                        else:
+                            ex.execute("rw", queries[i % len(queries)])
+                            reads[ci] += 1
+                        i += 1
+                except BaseException as e:
+                    errors.append(e)
+
+            ts = [
+                threading.Thread(target=worker, args=(ci,)) for ci in range(8)
+            ]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errors:
+                raise errors[0]
+            dt = time.perf_counter() - t0
+            snap1 = _metrics.snapshot()
+
+            def delta_of(name):
+                tot = 0.0
+                for k, v in snap1.items():
+                    if isinstance(v, dict) or k.split(";")[0] != name:
+                        continue
+                    tot += v - (snap0.get(k) or 0)
+                return tot
+
+            return {
+                "read_qps": round(sum(reads) / dt, 1),
+                "writes_per_s": round(sum(writes) / dt, 1),
+                "delta_applied": int(delta_of("stager.delta_applied")),
+                "delta_fallback": int(delta_of("stager.delta_fallback")),
+                "invalidation_misses": int(
+                    delta_of("stager.misses_invalidation")
+                ),
+                "restaged_bytes": int(delta_of("stager.restaged_bytes")),
+            }
+
+        seg = max(2.0, min(7.0, budget_s / 4))
+        out["read_only"] = arm(0.0, True, seg, nonce=0)
+        out["rw_delta"] = arm(WRITE_FRAC, True, seg, nonce=1)
+        out["rw_full_restage"] = arm(WRITE_FRAC, False, seg, nonce=2)
+        ro = out["read_only"]["read_qps"]
+        if ro:
+            out["rw_delta_vs_read_only"] = round(
+                out["rw_delta"]["read_qps"] / ro, 3
+            )
+            out["rw_full_vs_read_only"] = round(
+                out["rw_full_restage"]["read_qps"] / ro, 3
+            )
+        full = out["rw_full_restage"]
+        nwrites = full["writes_per_s"] * seg
+        if nwrites:
+            # the per-write re-upload burden delta staging removes; on
+            # this CPU rig re-staging only costs host packing, but on a
+            # tunneled chip these bytes ride the host→HBM link — divide
+            # by link bandwidth for the wall-clock a write mix would
+            # add without delta staging
+            out["restaged_bytes_per_write_without_delta"] = int(
+                full["restaged_bytes"] / nwrites
+            )
+    finally:
+        h.close()
+        _shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def main():
     import os
 
@@ -579,6 +738,19 @@ def main():
             except Exception as e:
                 print(
                     f"pipeline probe failed: {type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
+
+    # ---- read/write-mix probe (ISSUE 3): steady-state read qps under
+    # 1% single-bit writes, delta staging vs forced full re-stage.
+    if os.environ.get("PILOSA_BENCH_RWMIX", "1") != "0":
+        rem = child_budget - (time.monotonic() - _T_PROC_START)
+        if rem > 55:
+            try:
+                result["rw_mix"] = _rw_mix_probe(min(28.0, rem - 35))
+            except Exception as e:
+                print(
+                    f"rw_mix probe failed: {type(e).__name__}: {e}",
                     file=sys.stderr,
                 )
 

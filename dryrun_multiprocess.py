@@ -42,6 +42,15 @@ def worker() -> None:
     # backend via jax.config, overriding the env var the parent set —
     # re-assert CPU before the distributed runtime initializes
     jax.config.update("jax_platforms", "cpu")
+    # cross-process collectives on the CPU backend need an explicit
+    # implementation: without gloo selected, XLA raises "Multiprocess
+    # computations aren't implemented on the CPU backend" at dispatch.
+    # Guarded: the flag name is version-dependent and irrelevant on
+    # real multi-host TPU (ICI/DCN collectives need no selection).
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         coordinator_address=f"127.0.0.1:{os.environ[COORD_PORT_ENV]}",
         num_processes=nprocs,

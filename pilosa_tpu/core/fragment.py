@@ -8,9 +8,12 @@ once the op count passes MAX_OP_N (reference fragment.go:62-64,
 (reference fragment.go:330-359).
 
 TPU integration: the fragment is the CPU source of truth; it exports
-packed-word row matrices / BSI plane stacks for HBM staging and keeps a
-``generation`` counter so the device stager can invalidate staged blocks
-on mutation (SURVEY.md §7 step 3).
+packed-word row matrices / BSI plane stacks for HBM staging, keeps a
+``generation`` counter, and logs single-bit mutations in a bounded
+device-delta log so the stager can patch staged blocks forward
+(scatter-update kernels, ops/delta.py) instead of invalidating them on
+every write (SURVEY.md §7 step 3; the device-side analog of the
+reference's op log over the mmapped roaring file).
 """
 
 from __future__ import annotations
@@ -21,6 +24,7 @@ import math
 import mmap
 import os
 import threading
+from collections import deque
 from typing import Iterable, Optional
 
 import numpy as np
@@ -33,6 +37,16 @@ from pilosa_tpu.core import cache as cache_mod
 # reference fragment.go:55-64
 HASH_BLOCK_SIZE = 100
 MAX_OP_N = 2000
+
+# Bound on the per-fragment device-delta log (entries, i.e. single-bit
+# mutations since the oldest replayable snapshot). The log is what lets
+# the HBM stager patch already-resident arrays instead of re-uploading
+# whole blocks on every write (executor/stager.py); once a staged
+# snapshot falls more than this many mutations behind, the stager full-
+# rebuilds anyway, so keeping more buys nothing. Overridable per process
+# via the `stager-delta-log-max` config knob (server/server.py sets the
+# class attribute).
+DELTA_LOG_MAX = 4096
 
 DEFAULT_MIN_THRESHOLD = 1  # reference executor.go defaultMinThreshold
 
@@ -98,6 +112,19 @@ class Fragment:
         self.max_op_n = MAX_OP_N
         self.max_row_id = 0
         self.generation = 0  # bumped on every mutation; device-stager key
+        # Device-delta log: (generation, pos, is_set) per single-bit
+        # mutation, so the HBM stager can replay writes onto staged
+        # arrays instead of rebuilding them (snapshot + delta model).
+        # _delta_floor: staged snapshots at/after this generation can be
+        # patched forward. _delta_synced: the generation the log is
+        # authoritative through — any generation bump that bypasses
+        # _delta_append/_delta_reset (e.g. a raw restore assigning
+        # .generation) desyncs it and deltas_since answers None until
+        # the next tracked mutation re-anchors the log.
+        self.delta_log_max = DELTA_LOG_MAX
+        self._delta_log: deque[tuple[int, int, bool]] = deque()
+        self._delta_floor = 0
+        self._delta_synced = 0
         self.checksums: dict[int, bytes] = {}
         self.mu = threading.RLock()
         self._row_cache: dict[int, Row] = {}
@@ -272,6 +299,7 @@ class Fragment:
         if not self.storage.add(p):
             return False
         self.generation += 1
+        self._delta_append(p, True)
         self.checksums.pop(row_id // HASH_BLOCK_SIZE, None)
         self._increment_op_n()
         row = self._unprotected_row(row_id)
@@ -290,6 +318,7 @@ class Fragment:
         if not self.storage.remove(p):
             return False
         self.generation += 1
+        self._delta_append(p, False)
         self.checksums.pop(row_id // HASH_BLOCK_SIZE, None)
         self._increment_op_n()
         row = self._unprotected_row(row_id)
@@ -304,6 +333,62 @@ class Fragment:
         self.op_n += 1
         if self.op_n > self.max_op_n:
             self.snapshot()
+
+    # -- device-delta log (snapshot + delta staging model) -------------------
+
+    def _delta_append(self, p: int, is_set: bool) -> None:
+        """Record one single-bit mutation; called with mu held, AFTER
+        the generation bump it describes."""
+        if self.generation != self._delta_synced + 1:
+            # untracked generation bumps happened since the last logged
+            # mutation (external restore, etc.) — nothing older than
+            # this write is provably replayable
+            self._delta_log.clear()
+            self._delta_floor = self.generation - 1
+        self._delta_log.append((self.generation, p, is_set))
+        self._delta_synced = self.generation
+        if len(self._delta_log) > self.delta_log_max:
+            dropped_gen, _, _ = self._delta_log.popleft()
+            self._delta_floor = dropped_gen
+
+    def _delta_reset(self) -> None:
+        """Invalidate the log after a wholesale content change (bulk
+        import, block merge, restore): staged snapshots older than the
+        current generation must full-rebuild. Called with mu held,
+        AFTER the generation bump."""
+        self._delta_log.clear()
+        self._delta_floor = self._delta_synced = self.generation
+
+    def delta_reset(self) -> None:
+        """Public form for callers that replace storage outright (e.g.
+        the fragment-restore API) — pairs with their generation bump."""
+        with self.mu:
+            self._delta_reset()
+
+    def deltas_since(
+        self, gen: int
+    ) -> Optional[tuple[np.ndarray, np.ndarray, int]]:
+        """Mutations between snapshot generation ``gen`` and now, as
+        (positions uint64[N], is_set bool[N], current_generation) in log
+        order, or None when the log cannot prove continuity (snapshot
+        older than the truncation floor, an untracked generation bump,
+        or a bulk rewrite since ``gen``). An empty N with a newer
+        current_generation happens only after content-preserving bumps
+        (snapshot()) and is a valid "nothing to replay" answer."""
+        with self.mu:
+            cur = self.generation
+            if cur != self._delta_synced or gen < self._delta_floor or gen > cur:
+                return None
+            entries = [(p, s) for g, p, s in self._delta_log if g > gen]
+            if not entries:
+                return np.empty(0, dtype=np.uint64), np.empty(0, dtype=bool), cur
+            pos = np.fromiter(
+                (p for p, _ in entries), dtype=np.uint64, count=len(entries)
+            )
+            is_set = np.fromiter(
+                (s for _, s in entries), dtype=bool, count=len(entries)
+            )
+            return pos, is_set, cur
 
     # -- BSI value ops (reference fragment.go:467-836) -----------------------
 
@@ -586,6 +671,7 @@ class Fragment:
             positions = np.unique(positions)
             self.storage.merge_positions(add=positions)
             self.generation += 1
+            self._delta_reset()  # bulk rewrite: staged snapshots rebuild
             self._row_cache.clear()
             self.checksums.clear()
             # recount touched rows from container cardinalities in one
@@ -641,6 +727,7 @@ class Fragment:
             )  # bit_depth == 0 (min == max) has no planes
             self.storage.merge_positions(add=set_all, remove=clear_all)
             self.generation += 1
+            self._delta_reset()  # bulk rewrite: staged snapshots rebuild
             self._row_cache.clear()
             self.checksums.clear()
             self._recompute_max_row_id()
@@ -653,6 +740,11 @@ class Fragment:
         (reference snapshot:1425-1468)."""
         with self.mu:
             self.generation += 1
+            if self._delta_synced == self.generation - 1:
+                # content-preserving bump: the snapshot changes the
+                # on-disk base, not the bit set, so staged snapshots
+                # remain patchable — the log stays authoritative
+                self._delta_synced = self.generation
             if not self.path:
                 self.op_n = 0
                 self.storage.op_n = 0
@@ -733,6 +825,7 @@ class Fragment:
             for r, c in zip(rows, cols):
                 self.storage.add_no_oplog(pos(int(r), int(c)))
             self.generation += 1
+            self._delta_reset()  # block merge: staged snapshots rebuild
             self._row_cache.clear()
             self.checksums.clear()
             self._recompute_max_row_id()

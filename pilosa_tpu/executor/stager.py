@@ -1,15 +1,39 @@
 """HBM staging manager — the device-side cache of fragment state.
 
 Fragments are CPU source of truth (roaring + op log); queries run on
-packed-word copies staged in device memory. Entries are keyed by
-(fragment identity, generation): any mutation bumps the fragment's
-generation and the stale staged block is simply re-staged on next use
-(SURVEY.md §7 'Mutations vs staged state').
+packed-word copies staged in device memory. Device state follows a
+SNAPSHOT + DELTA model: entries are keyed by (fragment identity, form)
+and remember the fragment generation their array was built at. A
+mutation no longer cold-invalidates the block — on the next use the
+stager replays the fragment's delta log (core/fragment.py) onto the
+already-resident array with a jit scatter kernel (ops/delta.py),
+falling back to a full rebuild + re-upload only when the log can't
+prove continuity (bulk imports, log truncation) or the delta batch is
+large enough that re-staging is cheaper (``delta_max_ratio``). This is
+the device-side analog of the reference's op-log-over-mmap write
+absorption (reference fragment.go:66-110): one ``set_bit`` costs a
+K-word scatter instead of a 537 MB re-upload of the dense matrix.
 
-Staged forms:
-  * row      — u32[W]            one fragment row
-  * matrix   — u32[R, W]         all non-empty rows (TopN scans)
-  * planes   — u32[D+1, W]       BSI bit planes + not-null
+Staged forms and their delta paths:
+  * row         — u32[W]           scatter into the one row
+  * rows(_p2)   — u32[K, W]        scatter into staged rows; deltas on
+                                   unstaged rows don't touch the block
+  * matrix      — u32[R, W]        scatter while the non-empty row set
+                                   is unchanged; a new/emptied row is a
+                                   shape change → full rebuild
+  * planes      — u32[D+1, W]      scatter into planes 0..D
+  * row_stack / planes_stack       per-shard scatter (re-pinned to the
+                                   entry's sharding afterwards)
+  * sparse_rows / sparse_*_stack   documented fallback: the block-
+                                   sparse layout has no stable scatter
+                                   targets (a delta can land in an
+                                   unstaged container), so a
+                                   generation mismatch full-rebuilds
+
+Every delta apply produces a NEW array (functional update), so batched
+scorers that coalesce on staged-array identity (executor/batcher.py)
+keep working: same object ⇔ same snapshot, and post-update queries key
+on the fresh object.
 
 Eviction is LRU by byte budget — the stager is the scheduler of HBM
 residency (SURVEY.md §7 hard part 2).
@@ -20,22 +44,52 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import numpy as np
 
-from pilosa_tpu import SHARD_WIDTH
+from pilosa_tpu import SHARD_WIDTH, ops
 from pilosa_tpu.utils import metrics, trace
+
+_W32 = SHARD_WIDTH // 32  # u32 words per staged row
 
 
 class _InFlight:
-    __slots__ = ("event", "value", "error")
+    __slots__ = ("event", "value", "error", "gen")
 
     def __init__(self) -> None:
         self.event = threading.Event()
         self.value = None
         self.error: Optional[BaseException] = None
+        self.gen = None  # generation token the published value reflects
+
+
+class _Entry:
+    __slots__ = ("value", "nbytes", "gen")
+
+    def __init__(self, value, nbytes: int, gen) -> None:
+        self.value = value
+        self.nbytes = nbytes
+        self.gen = gen  # int, or tuple of per-fragment ints for stacks
+
+
+def _gen_fresh(have, want) -> bool:
+    """Is a staged snapshot at generation ``have`` acceptable for a
+    reader that observed ``want``? Generations only grow, and a builder
+    records the generation it read BEFORE packing (content is at least
+    that fresh), so >= is the right comparison."""
+    if isinstance(want, tuple):
+        if not isinstance(have, tuple) or len(have) != len(want):
+            return False
+        for h, w in zip(have, want):
+            if w is None or h is None:
+                if h is not w:
+                    return False
+            elif h < w:
+                return False
+        return True
+    return have >= want
 
 
 class DeviceStager:
@@ -46,7 +100,14 @@ class DeviceStager:
     which also keeps BatchedScorer coalescing intact (its key is the
     staged array's identity)."""
 
-    def __init__(self, budget_bytes: int = 8 << 30, device=None, mesh=None) -> None:
+    def __init__(
+        self,
+        budget_bytes: int = 8 << 30,
+        device=None,
+        mesh=None,
+        delta_enabled: bool = True,
+        delta_max_ratio: float = 0.25,
+    ) -> None:
         self.budget_bytes = budget_bytes
         self.device = device
         # When a mesh is configured, shard-major stacks ([S, ...] arrays
@@ -54,7 +115,13 @@ class DeviceStager:
         # the executor's SPMD kernels consume them in place — the HBM
         # form of the reference's shards-spread-over-nodes layout.
         self.mesh = mesh
-        self._cache: OrderedDict[tuple, tuple[object, int]] = OrderedDict()
+        # delta staging: patch resident arrays on generation mismatch
+        # instead of rebuilding; a batch touching more than
+        # delta_max_ratio of the block's words full-rebuilds instead
+        # (the scatter stops winning once it rewrites much of the block)
+        self.delta_enabled = delta_enabled
+        self.delta_max_ratio = delta_max_ratio
+        self._cache: OrderedDict[tuple, _Entry] = OrderedDict()
         self._bytes = 0
         self._mu = threading.Lock()
         self._inflight: dict[tuple, _InFlight] = {}
@@ -64,73 +131,139 @@ class DeviceStager:
         self._epoch = 0
         self.hits = 0
         self.misses = 0
+        self.delta_applies = 0
 
     # -- internal --
 
     def _key(self, frag, kind: str, extra=()) -> tuple:
-        return (id(frag), frag.generation, kind) + tuple(extra)
+        # NOTE: no generation — entries persist across mutations and
+        # track their snapshot generation in _Entry.gen instead
+        return (id(frag), kind) + tuple(extra)
 
-    def _get_or_build(self, key, builder):
-        """builder() -> (value, nbytes); runs at most once per cold key."""
-        fl = None
-        with self._mu:
-            ent = self._cache.get(key)
-            if ent is not None:
-                self._cache.move_to_end(key)
-                self.hits += 1
-                metrics.count(metrics.STAGER_HITS)
-                return ent[0]
-            epoch = self._epoch
-            fl = self._inflight.get(key)
-            if fl is None:
-                fl = _InFlight()
-                self._inflight[key] = fl
-                building = True
-            else:
-                building = False
-        if not building:
-            fl.event.wait()
-            if fl.error is not None:
-                raise fl.error
-            return fl.value
-        try:
-            t0 = time.monotonic()
-            sp = trace.current()
-            if sp is None:
-                value, nbytes = builder()
-            else:
-                with sp.child(metrics.STAGE_STAGE) as ssp:
-                    value, nbytes = builder()
-                    ssp.annotate(nbytes=nbytes)
-            metrics.observe(metrics.STAGER_STAGE_SECONDS, time.monotonic() - t0)
-        except BaseException as e:
+    def _get_or_build(
+        self,
+        key,
+        gen,
+        builder: Callable,
+        delta_fn: Optional[Callable] = None,
+    ):
+        """Return the staged value for ``key``, fresh w.r.t. the
+        caller-observed generation token ``gen``.
+
+        builder() -> (value, nbytes, built_gen); runs when no usable
+        entry exists. delta_fn(old_value, old_gen) -> (value, built_gen,
+        n_updates) or None; runs when an entry exists at an older
+        generation — None falls back to builder() (full re-stage).
+        Both capture built_gen BEFORE reading fragment state, so the
+        recorded generation never overstates the content.
+        """
+        while True:
+            fl = None
+            stale: Optional[_Entry] = None
             with self._mu:
-                # identity check mirrors the success path: an
-                # epoch-stale zombie that raises must not evict a
-                # post-reset rebuild's in-flight entry
-                if self._inflight.get(key) is fl:
+                ent = self._cache.get(key)
+                if ent is not None and _gen_fresh(ent.gen, gen):
+                    self._cache.move_to_end(key)
+                    self.hits += 1
+                    metrics.count(metrics.STAGER_HITS)
+                    return ent.value
+                epoch = self._epoch
+                fl = self._inflight.get(key)
+                if fl is None:
+                    fl = _InFlight()
+                    self._inflight[key] = fl
+                    building = True
+                    stale = ent
+                else:
+                    building = False
+            if not building:
+                fl.event.wait()
+                if fl.error is not None:
+                    raise fl.error
+                if fl.gen is None or _gen_fresh(fl.gen, gen):
+                    return fl.value
+                # the build we joined predates our observed generation:
+                # retry — the fresh cache entry makes the next lap a
+                # cheap hit or delta apply
+                continue
+            try:
+                value = nbytes = built_gen = None
+                if (
+                    stale is not None
+                    and delta_fn is not None
+                    and self.delta_enabled
+                ):
+                    t0 = time.monotonic()
+                    sp = trace.current()
+                    if sp is None:
+                        res = delta_fn(stale.value, stale.gen)
+                    else:
+                        with sp.child(metrics.STAGE_DELTA) as ssp:
+                            res = delta_fn(stale.value, stale.gen)
+                            if res is not None:
+                                ssp.annotate(nupdates=res[2])
+                    if res is not None:
+                        value, built_gen, _n = res
+                        nbytes = stale.nbytes  # delta never changes shape
+                        self.delta_applies += 1
+                        metrics.count(metrics.STAGER_DELTA_APPLIED)
+                        metrics.observe(
+                            metrics.STAGER_DELTA_APPLY_SECONDS,
+                            time.monotonic() - t0,
+                        )
+                if value is None:
+                    t0 = time.monotonic()
+                    sp = trace.current()
+                    if sp is None:
+                        value, nbytes, built_gen = builder()
+                    else:
+                        with sp.child(metrics.STAGE_STAGE) as ssp:
+                            value, nbytes, built_gen = builder()
+                            ssp.annotate(nbytes=nbytes)
+                    metrics.observe(
+                        metrics.STAGER_STAGE_SECONDS, time.monotonic() - t0
+                    )
+                    metrics.count(metrics.STAGER_MISSES)
+                    if stale is None:
+                        metrics.count(metrics.STAGER_MISSES_COLD)
+                    else:
+                        # generation-bump invalidation that could not be
+                        # absorbed as a delta — the bytes we re-uploaded
+                        # are the cost delta staging exists to avoid
+                        metrics.count(metrics.STAGER_MISSES_INVALIDATION)
+                        metrics.count(metrics.STAGER_RESTAGED_BYTES, nbytes)
+                    with self._mu:
+                        self.misses += 1
+            except BaseException as e:
+                with self._mu:
+                    # identity check mirrors the success path: an
+                    # epoch-stale zombie that raises must not evict a
+                    # post-reset rebuild's in-flight entry
+                    if self._inflight.get(key) is fl:
+                        self._inflight.pop(key, None)
+                fl.error = e
+                fl.event.set()
+                raise
+            with self._mu:
+                if self._epoch == epoch:
+                    old = self._cache.pop(key, None)
+                    if old is not None:
+                        self._bytes -= old.nbytes
+                    self._cache[key] = _Entry(value, nbytes, built_gen)
+                    self._bytes += nbytes
+                    while self._bytes > self.budget_bytes and len(self._cache) > 1:
+                        _, old_ent = self._cache.popitem(last=False)
+                        self._bytes -= old_ent.nbytes
                     self._inflight.pop(key, None)
-            fl.error = e
+                    metrics.gauge(metrics.STAGER_BYTES, self._bytes)
+                elif self._inflight.get(key) is fl:
+                    # same epoch-stale builder still registered (no rebuild
+                    # raced in): unregister without caching the stale value
+                    self._inflight.pop(key, None)
+            fl.gen = built_gen
+            fl.value = value
             fl.event.set()
-            raise
-        metrics.count(metrics.STAGER_MISSES)
-        with self._mu:
-            self.misses += 1
-            if self._epoch == epoch:
-                self._cache[key] = (value, nbytes)
-                self._bytes += nbytes
-                while self._bytes > self.budget_bytes and len(self._cache) > 1:
-                    _, (_, old_bytes) = self._cache.popitem(last=False)
-                    self._bytes -= old_bytes
-                self._inflight.pop(key, None)
-                metrics.gauge(metrics.STAGER_BYTES, self._bytes)
-            elif self._inflight.get(key) is fl:
-                # same epoch-stale builder still registered (no rebuild
-                # raced in): unregister without caching the stale value
-                self._inflight.pop(key, None)
-        fl.value = value
-        fl.event.set()
-        return value
+            return value
 
     def _to_device(self, words64: np.ndarray):
         w32 = np.ascontiguousarray(words64).view("<u4")
@@ -152,16 +285,94 @@ class DeviceStager:
             )
         return jax.device_put(w32, self.device)
 
+    # -- delta helpers -------------------------------------------------------
+
+    def _fallback(self, reason: str) -> None:
+        metrics.count(metrics.STAGER_DELTA_FALLBACK, reason=reason)
+
+    def _deltas(self, frag, since_gen):
+        """Fragment delta stream since ``since_gen`` split into row /
+        word-in-row / bit coordinates, or None (+ fallback metric)."""
+        d = frag.deltas_since(since_gen)
+        if d is None:
+            self._fallback("log")
+            return None
+        pos, is_set, gen = d
+        rows = (pos // np.uint64(SHARD_WIDTH)).astype(np.int64)
+        local = (pos % np.uint64(SHARD_WIDTH)).astype(np.int64)
+        return rows, local >> 5, (local & 31), is_set, gen
+
+    def _scatter(self, dev, word_idx, bit_idx, is_set, gen, n_slots_words):
+        """Coalesce + pad + run the delta kernel over a flat word space
+        of ``n_slots_words`` words; returns (new_value, gen, K) or None
+        when the batch is too large to beat a re-stage."""
+        if word_idx.size == 0:
+            return dev, gen, 0
+        idx, om, am = ops.coalesce_bit_updates(word_idx, bit_idx, is_set)
+        if idx.size > int(self.delta_max_ratio * n_slots_words):
+            self._fallback("ratio")
+            return None
+        idx, om, am = ops.pad_updates(idx, om, am, n_slots_words)
+        new = ops.apply_word_updates(dev, idx, om, am)
+        if getattr(dev, "sharding", None) is not None:
+            # stacks staged over a mesh axis must come back with the
+            # entry's placement — scatter output sharding is whatever
+            # GSPMD propagated through the flatten
+            new = jax.device_put(new, dev.sharding)
+        return new, gen, int(idx.size)
+
     # -- staging entry points --
 
     def row(self, frag, row_id: int):
         """u32[W] for one row."""
 
         def build():
+            gen = frag.generation
             words = frag.row_words(row_id)
-            return self._to_device(words), words.nbytes
+            return self._to_device(words), words.nbytes, gen
 
-        return self._get_or_build(self._key(frag, "row", (row_id,)), build)
+        def delta(old, old_gen):
+            d = self._deltas(frag, old_gen)
+            if d is None:
+                return None
+            rows, widx, bidx, is_set, gen = d
+            m = rows == row_id
+            return self._scatter(
+                old, widx[m], bidx[m], is_set[m], gen, _W32
+            )
+
+        return self._get_or_build(
+            self._key(frag, "row", (row_id,)),
+            frag.generation,
+            build,
+            delta,
+        )
+
+    def _delta_for_slots(self, frag, slot_of: dict, n_rows_staged: int):
+        """delta_fn for forms staging a fixed set of rows as [K, W]:
+        slot_of maps row id → block row. Deltas on unmapped rows don't
+        touch the block (they're not staged) and are dropped."""
+
+        def delta(old, old_gen):
+            d = self._deltas(frag, old_gen)
+            if d is None:
+                return None
+            rows, widx, bidx, is_set, gen = d
+            if rows.size:
+                slots = np.fromiter(
+                    (slot_of.get(int(r), -1) for r in rows),
+                    dtype=np.int64,
+                    count=rows.size,
+                )
+                keep = slots >= 0
+                widx = slots[keep] * _W32 + widx[keep]
+                bidx = bidx[keep]
+                is_set = is_set[keep]
+            return self._scatter(
+                old, widx, bidx, is_set, gen, n_rows_staged * _W32
+            )
+
+        return delta
 
     def rows(self, frag, row_ids: tuple[int, ...], pad_pow2: bool = False):
         """u32[K, W] stack of specific rows.
@@ -177,16 +388,26 @@ class DeviceStager:
         from pilosa_tpu.executor.batcher import _next_pow2
 
         kind = "rows_p2" if pad_pow2 else "rows"
+        nrows = len(row_ids)
+        if pad_pow2 and nrows:
+            nrows = _next_pow2(nrows)
 
         def build():
+            gen = frag.generation
             words = frag.packed_rows(list(row_ids))
             if pad_pow2 and len(row_ids):
                 target = _next_pow2(words.shape[0])
                 if target > words.shape[0]:
                     words = np.pad(words, ((0, target - words.shape[0]), (0, 0)))
-            return self._to_device(words), words.nbytes
+            return self._to_device(words), words.nbytes, gen
 
-        return self._get_or_build(self._key(frag, kind, (row_ids,)), build)
+        slot_of = {int(r): k for k, r in enumerate(row_ids)}
+        return self._get_or_build(
+            self._key(frag, kind, (row_ids,)),
+            frag.generation,
+            build,
+            self._delta_for_slots(frag, slot_of, nrows),
+        )
 
     def sparse_rows(self, frag, row_ids: tuple[int, ...]):
         """Block-sparse candidate staging for TopN scoring:
@@ -195,10 +416,15 @@ class DeviceStager:
         (zero blocks aimed at row 0 score 0; callers slice results to
         len(row_ids)). The memory-scalable alternative to rows() —
         bytes staged scale with set containers, not candidates × 128 KB
-        (SURVEY.md §7 hard part 2)."""
+        (SURVEY.md §7 hard part 2).
+
+        No delta path: a mutation can occupy a container the sparse
+        form didn't stage (no scatter target exists), so a generation
+        mismatch always full-rebuilds (counted as delta_fallback)."""
         from pilosa_tpu.executor.batcher import _next_pow2
 
         def build():
+            gen = frag.generation
             blocks, brow, bslot = frag.sparse_row_blocks(list(row_ids))
             num_rows = _next_pow2(max(len(row_ids), 1))
             b = blocks.shape[0]
@@ -214,49 +440,180 @@ class DeviceStager:
                 jax.device_put(bslot, self.device),
                 num_rows,
             )
-            return dev, w32.nbytes + brow.nbytes + bslot.nbytes
+            return dev, w32.nbytes + brow.nbytes + bslot.nbytes, gen
 
-        return self._get_or_build(self._key(frag, "sparse_rows", (row_ids,)), build)
+        return self._get_or_build(
+            self._key(frag, "sparse_rows", (row_ids,)),
+            frag.generation,
+            build,
+            self._sparse_fallback,
+        )
+
+    def _sparse_fallback(self, old, old_gen):
+        """Documented non-path: block-sparse forms always re-stage on a
+        generation mismatch (see sparse_rows)."""
+        self._fallback("sparse_form")
+        return None
 
     def matrix(self, frag):
         """(row_ids, u32[R, W]) for all non-empty rows."""
 
         def build():
+            gen = frag.generation
             ids, words = frag.row_matrix()
             dev = self._to_device(words) if len(ids) else None
-            return (ids, dev), words.nbytes
+            return (ids, dev), words.nbytes, gen
 
-        return self._get_or_build(self._key(frag, "matrix"), build)
+        def delta(old, old_gen):
+            ids, dev = old
+            d = self._deltas(frag, old_gen)
+            if d is None:
+                return None
+            rows, widx, bidx, is_set, gen = d
+            if rows.size == 0:
+                return old, gen, 0
+            if dev is None:
+                # empty matrix gaining rows is a shape change
+                self._fallback("shape")
+                return None
+            slot_of = {int(r): k for k, r in enumerate(ids)}
+            slots = np.fromiter(
+                (slot_of.get(int(r), -1) for r in rows),
+                dtype=np.int64,
+                count=rows.size,
+            )
+            if (slots < 0).any():
+                # a row outside the staged non-empty set changed — the
+                # matrix's row list (and shape) would change on rebuild
+                self._fallback("shape")
+                return None
+            cleared = np.unique(rows[~is_set])
+            if cleared.size and (
+                frag.row_counts_for(cleared.astype(np.uint64)) == 0
+            ).any():
+                # a clear emptied a row: a rebuild would drop it from
+                # the matrix — shape change, patching can't express it
+                self._fallback("shape")
+                return None
+            res = self._scatter(
+                dev,
+                slots * _W32 + widx,
+                bidx,
+                is_set,
+                gen,
+                len(ids) * _W32,
+            )
+            if res is None:
+                return None
+            new_dev, gen, n = res
+            return (ids, new_dev), gen, n
+
+        return self._get_or_build(
+            self._key(frag, "matrix"), frag.generation, build, delta
+        )
 
     def planes(self, frag, bit_depth: int):
         """u32[bit_depth+1, W] BSI plane stack."""
 
         def build():
+            gen = frag.generation
             words = frag.bsi_planes(bit_depth)
-            return self._to_device(words), words.nbytes
+            return self._to_device(words), words.nbytes, gen
 
-        return self._get_or_build(self._key(frag, "planes", (bit_depth,)), build)
+        # plane p is row p; rows above the staged depth aren't in this
+        # block (a deeper write keys a different planes(depth) entry)
+        slot_of = {r: r for r in range(bit_depth + 1)}
+        return self._get_or_build(
+            self._key(frag, "planes", (bit_depth,)),
+            frag.generation,
+            build,
+            self._delta_for_slots(frag, slot_of, bit_depth + 1),
+        )
 
     # -- shard-batched staging (one array covering many fragments) ----------
 
     def _stack_key(self, frags, kind: str, extra=()) -> tuple:
         return (
-            tuple((id(f), f.generation) if f is not None else None for f in frags),
+            tuple(id(f) if f is not None else None for f in frags),
             kind,
         ) + tuple(extra)
+
+    def _stack_gen(self, frags) -> tuple:
+        return tuple(f.generation if f is not None else None for f in frags)
+
+    def _delta_for_stack(self, frags, slot_of_fn, words_per_frag: int):
+        """delta_fn for [S, ...] stacks: per changed fragment, map its
+        deltas through slot_of_fn(row) → word offset within the
+        fragment's words_per_frag slice (or None to drop), then one
+        combined scatter over the flat [S * words_per_frag] space."""
+
+        def delta(old, old_gens):
+            all_w, all_b, all_s = [], [], []
+            new_gens = list(old_gens)
+            for i, f in enumerate(frags):
+                if f is None:
+                    continue
+                if old_gens[i] is None:
+                    # can't happen with stable keys (the key pins which
+                    # positions are None) — full rebuild, defensively
+                    self._fallback("log")
+                    return None
+                if f.generation == old_gens[i]:
+                    continue
+                d = self._deltas(f, old_gens[i])
+                if d is None:
+                    return None
+                rows, widx, bidx, is_set, gen = d
+                new_gens[i] = gen
+                if rows.size == 0:
+                    continue
+                slots = np.fromiter(
+                    (slot_of_fn(int(r)) for r in rows),
+                    dtype=np.int64,
+                    count=rows.size,
+                )
+                keep = slots >= 0
+                if not keep.any():
+                    continue
+                all_w.append(
+                    i * words_per_frag + slots[keep] * _W32 + widx[keep]
+                )
+                all_b.append(bidx[keep])
+                all_s.append(is_set[keep])
+            gen_t = tuple(new_gens)
+            if not all_w:
+                return old, gen_t, 0
+            res = self._scatter(
+                old,
+                np.concatenate(all_w),
+                np.concatenate(all_b),
+                np.concatenate(all_s),
+                gen_t,
+                len(frags) * words_per_frag,
+            )
+            return res
+
+        return delta
 
     def row_stack(self, frags, row_id: int):
         """u32[S, W]: one row across S fragments (None → zeros)."""
 
         def build():
+            gens = self._stack_gen(frags)
             words = np.zeros((len(frags), SHARD_WIDTH // 64), dtype=np.uint64)
             for i, f in enumerate(frags):
                 if f is not None:
                     words[i] = f.row_words(row_id)
-            return self._to_device_sharded(words), words.nbytes
+            return self._to_device_sharded(words), words.nbytes, gens
 
+        delta = self._delta_for_stack(
+            frags, lambda r: 0 if r == row_id else -1, _W32
+        )
         return self._get_or_build(
-            self._stack_key(frags, "row_stack", (row_id,)), build
+            self._stack_key(frags, "row_stack", (row_id,)),
+            self._stack_gen(frags),
+            build,
+            delta,
         )
 
     def sparse_rows_stacked(
@@ -267,10 +624,12 @@ class DeviceStager:
         shard i32[B], num_rows) bundle, where global_row = shard_index
         * chunk + local candidate index. One kernel dispatch then
         scores the whole index's chunk (ops.sparse_intersection_counts_
-        stacked). Returns None when no shard has candidates."""
+        stacked). Returns None when no shard has candidates. No delta
+        path (see sparse_rows)."""
         from pilosa_tpu.executor.batcher import _next_pow2
 
         def build():
+            gens = self._stack_gen(frags)
             all_blocks, rows, slots, shardix = [], [], [], []
             for i, (f, ids) in enumerate(zip(frags, ids_by_shard)):
                 if f is None or not ids:
@@ -284,7 +643,7 @@ class DeviceStager:
                 shardix.append(np.full(bs.size, i, dtype=np.int32))
             num_rows = len(frags) * chunk
             if not all_blocks:
-                return None, 0
+                return None, 0, gens
             blocks = np.concatenate(all_blocks)
             brow = np.concatenate(rows)
             bslot = np.concatenate(slots)
@@ -306,10 +665,13 @@ class DeviceStager:
                 num_rows,
             )
             nbytes = w32.nbytes + brow.nbytes + bslot.nbytes + bshard.nbytes
-            return dev, nbytes
+            return dev, nbytes, gens
 
         return self._get_or_build(
-            self._stack_key(frags, "sparse_stack", (chunk, ids_by_shard)), build
+            self._stack_key(frags, "sparse_stack", (chunk, ids_by_shard)),
+            self._stack_gen(frags),
+            build,
+            self._sparse_fallback,
         )
 
     def sparse_rows_stack(
@@ -322,10 +684,12 @@ class DeviceStager:
         staged scale with set containers, not candidates × 128 KB — the
         sparse analog of rows_stack (SURVEY.md §7 hard part 2). Padding
         blocks are zeros aimed at (row 0, slot 0): they contribute 0 to
-        every intersection. Returns None when no shard has blocks."""
+        every intersection. Returns None when no shard has blocks. No
+        delta path (see sparse_rows)."""
         from pilosa_tpu.executor.batcher import _next_pow2
 
         def build():
+            gens = self._stack_gen(frags)
             per_shard = []
             for f, ids in zip(frags, ids_by_shard):
                 if f is None or not ids:
@@ -337,7 +701,7 @@ class DeviceStager:
                 (p[0].shape[0] for p in per_shard if p is not None), default=0
             )
             if bmax == 0:
-                return None, 0
+                return None, 0, gens
             bmax = _next_pow2(bmax)
             S = len(frags)
             blocks = np.zeros((S, bmax, 1024), dtype=np.uint64)
@@ -368,26 +732,38 @@ class DeviceStager:
                     jax.device_put(brow, self.device),
                     jax.device_put(bslot, self.device),
                 )
-            return dev, w32.nbytes + brow.nbytes + bslot.nbytes
+            return dev, w32.nbytes + brow.nbytes + bslot.nbytes, gens
 
         return self._get_or_build(
-            self._stack_key(frags, "sparse_rows_stack", (k, ids_by_shard)), build
+            self._stack_key(frags, "sparse_rows_stack", (k, ids_by_shard)),
+            self._stack_gen(frags),
+            build,
+            self._sparse_fallback,
         )
 
     def planes_stack(self, frags, bit_depth: int):
         """u32[S, bit_depth+1, W] across S fragments (None → zeros)."""
 
         def build():
+            gens = self._stack_gen(frags)
             words = np.zeros(
                 (len(frags), bit_depth + 1, SHARD_WIDTH // 64), dtype=np.uint64
             )
             for i, f in enumerate(frags):
                 if f is not None:
                     words[i] = f.bsi_planes(bit_depth)
-            return self._to_device_sharded(words), words.nbytes
+            return self._to_device_sharded(words), words.nbytes, gens
 
+        delta = self._delta_for_stack(
+            frags,
+            lambda r: r if r <= bit_depth else -1,
+            (bit_depth + 1) * _W32,
+        )
         return self._get_or_build(
-            self._stack_key(frags, "planes_stack", (bit_depth,)), build
+            self._stack_key(frags, "planes_stack", (bit_depth,)),
+            self._stack_gen(frags),
+            build,
+            delta,
         )
 
     def clear(self) -> None:
@@ -404,8 +780,10 @@ class DeviceStager:
         restore): drop every staged array (handles created by the dead
         runtime may be invalid) and fail out in-flight entries whose
         builders are hung inside dead device calls — new queries
-        rebuild instead of waiting on a zombie forever. Safe because
-        ``_mu`` is never held across a device call."""
+        rebuild instead of waiting on a zombie forever. Dropping the
+        entries also drops their snapshot generations, so no delta can
+        ever replay onto a dead-runtime array. Safe because ``_mu`` is
+        never held across a device call."""
         with self._mu:
             self._cache.clear()
             self._bytes = 0

@@ -1,0 +1,115 @@
+"""Incremental delta-apply kernels — in-place maintenance of staged state.
+
+A mutation used to cold-invalidate every HBM block staged for its
+fragment (the stager keyed entries by generation), so one ``set_bit``
+forced a full host rebuild + re-upload of, e.g., a 537 MB dense matrix.
+The reference absorbs writes with an op log layered over the mmapped
+roaring file (reference fragment.go:66-110); these kernels are the
+device-side analog: the fragment's delta log (core/fragment.py) replays
+onto the already-resident arrays as one scatter update.
+
+Host side, a delta batch collapses to per-word OR / AND-NOT masks
+(``coalesce_bit_updates`` — last op per bit wins, then bits combine per
+word). Device side, ``apply_word_updates`` gathers the touched words,
+applies ``(w | or_mask) & ~andnot_mask``, and scatters them back — one
+fused gather/scatter pass over K words instead of a full-block upload.
+Update counts are padded to powers of two with out-of-range indices
+(scatter ``mode="drop"`` discards them) so the XLA compile cache holds
+log2 distinct kernel shapes, the same bucketing trick as the stager's
+pow2 row padding.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def coalesce_bit_updates(
+    word_idx: np.ndarray, bit_idx: np.ndarray, is_set: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Collapse an ordered bit-delta stream to per-word update masks.
+
+    word_idx[i] is the flat u32-word index of delta i, bit_idx[i] its
+    bit within that word (0..31), is_set[i] True for set / False for
+    clear. Later deltas override earlier ones bit-wise (the log is
+    ordered), so the LAST op per (word, bit) wins; surviving set bits
+    OR-combine into or_mask and surviving clears into andnot_mask.
+
+    Returns (idx i32[K], or_mask u32[K], andnot_mask u32[K]) with idx
+    unique. The new word value is ``(old | or_mask) & ~andnot_mask`` —
+    or_mask and andnot_mask are disjoint by construction, so the apply
+    order inside the kernel doesn't matter.
+    """
+    key = word_idx.astype(np.int64) * 32 + bit_idx.astype(np.int64)
+    # keep the last occurrence of each (word, bit) — same idiom as
+    # fragment.import_value's last-write-wins dedup
+    _, last_rev = np.unique(key[::-1], return_index=True)
+    keep = key.size - 1 - last_rev
+    k = key[keep]
+    s = np.asarray(is_set)[keep]
+    words = k >> 5
+    bits = (k & 31).astype(np.uint32)
+    uniq_words, inv = np.unique(words, return_inverse=True)
+    or_mask = np.zeros(uniq_words.size, dtype=np.uint32)
+    andnot_mask = np.zeros(uniq_words.size, dtype=np.uint32)
+    bitmask = (np.uint32(1) << bits).astype(np.uint32)
+    np.bitwise_or.at(or_mask, inv[s], bitmask[s])
+    np.bitwise_or.at(andnot_mask, inv[~s], bitmask[~s])
+    return uniq_words.astype(np.int32), or_mask, andnot_mask
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def pad_updates(
+    idx: np.ndarray,
+    or_mask: np.ndarray,
+    andnot_mask: np.ndarray,
+    total_words: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad an update batch to the next power of two. Padding rows carry
+    idx = total_words — out of range, so the scatter drops them — and
+    zero masks, so even a clamped gather of them is a no-op."""
+    k = idx.size
+    target = _next_pow2(max(k, 1))
+    if target == k:
+        return idx, or_mask, andnot_mask
+    pad = target - k
+    return (
+        np.concatenate([idx, np.full(pad, total_words, dtype=np.int32)]),
+        np.concatenate([or_mask, np.zeros(pad, dtype=np.uint32)]),
+        np.concatenate([andnot_mask, np.zeros(pad, dtype=np.uint32)]),
+    )
+
+
+@jax.jit
+def apply_word_updates(words, idx, or_mask, andnot_mask):
+    """Scatter-apply per-word masks to a staged block of any shape.
+
+    words: u32[...]; idx i32[K] indexes the FLATTENED word array
+    (out-of-range = padding, dropped by the scatter); returns a new
+    array of the same shape — staged arrays stay immutable, so batched
+    scorers coalescing on array identity see the update as a fresh key.
+    """
+    flat = words.reshape(-1)
+    cur = flat[idx]  # OOB gathers clamp; their updates are dropped below
+    new = (cur | or_mask) & jnp.bitwise_not(andnot_mask)
+    return flat.at[idx].set(new, mode="drop").reshape(words.shape)
+
+
+@jax.jit
+def apply_word_updates_2d(words, shard_idx, word_idx, or_mask, andnot_mask):
+    """Shard-stack form: words u32[S, M] with per-update (shard, word)
+    coordinates, for [S, ...] stacks whose leading dim may be placed
+    over a mesh axis — scattering along the trailing dims avoids the
+    full flatten of the sharded axis. Out-of-range shard_idx (== S)
+    marks padding."""
+    cur = words[shard_idx, word_idx]
+    new = (cur | or_mask) & jnp.bitwise_not(andnot_mask)
+    return words.at[shard_idx, word_idx].set(new, mode="drop")

@@ -495,6 +495,7 @@ class API:
             frag.storage = Bitmap.unmarshal_binary(data)
             frag.storage.op_writer = op_writer
             frag.generation += 1
+            frag._delta_reset()  # wholesale replace: no replayable deltas
             frag._row_cache.clear()
             frag.checksums.clear()
             frag._recompute_max_row_id()

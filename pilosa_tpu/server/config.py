@@ -60,6 +60,18 @@ class Config:
     # TPU execution
     device_policy: str = "auto"  # never | auto | always
     stager_budget_bytes: int = 8 << 30
+    # incremental delta staging (snapshot + delta model): on a fragment
+    # generation bump the stager patches resident HBM blocks with
+    # scatter-update kernels instead of rebuilding + re-uploading them
+    stager_delta_enabled: bool = True
+    # full-rebuild crossover: a delta batch touching more than this
+    # fraction of a staged block's words re-stages instead (the scatter
+    # stops winning once it rewrites much of the block)
+    stager_delta_max_ratio: float = 0.25
+    # per-fragment delta log capacity (single-bit mutations kept since
+    # the oldest replayable snapshot); staged entries older than the
+    # truncation floor full-rebuild on next use
+    stager_delta_log_max: int = 4096
     # device health gate: reads slower than this fall back to the CPU
     # roaring path and gate the device off until a probe answers
     # (executor/devicehealth.py); 0 disables the gate. The default
@@ -180,6 +192,9 @@ class Config:
             f'bind = "{self.bind}"',
             f"max-writes-per-request = {self.max_writes_per_request}",
             f'device-policy = "{self.device_policy}"',
+            f"stager-delta-enabled = {'true' if self.stager_delta_enabled else 'false'}",
+            f"stager-delta-max-ratio = {self.stager_delta_max_ratio}",
+            f"stager-delta-log-max = {self.stager_delta_log_max}",
             f"mesh-devices = {self.mesh_devices!r}"
             if isinstance(self.mesh_devices, str)
             else f"mesh-devices = {self.mesh_devices}",

@@ -125,8 +125,17 @@ class Server:
         self.cluster = cluster
         self.mesh = self._build_mesh()
         self.stager = DeviceStager(
-            budget_bytes=self.config.stager_budget_bytes, mesh=self.mesh
+            budget_bytes=self.config.stager_budget_bytes,
+            mesh=self.mesh,
+            delta_enabled=self.config.stager_delta_enabled,
+            delta_max_ratio=self.config.stager_delta_max_ratio,
         )
+        # the delta log capacity rides on the fragment class (fragments
+        # are created deep inside the holder tree; a process-wide
+        # default is the right scope for a process-wide stager)
+        from pilosa_tpu.core import fragment as fragment_mod
+
+        fragment_mod.DELTA_LOG_MAX = self.config.stager_delta_log_max
         # serving deployments get the device health gate: a wedged
         # accelerator (hung tunnel/PJRT call) degrades reads to the CPU
         # roaring path instead of hanging them, and a background probe

@@ -93,8 +93,15 @@ BATCHER_RESCUES = "batcher.rescues"
 # HBM staging
 STAGER_HITS = "stager.hits"
 STAGER_MISSES = "stager.misses"
+STAGER_MISSES_COLD = "stager.misses_cold"
+STAGER_MISSES_INVALIDATION = "stager.misses_invalidation"
 STAGER_STAGE_SECONDS = "stager.stage_seconds"
 STAGER_BYTES = "stager.bytes"
+STAGER_RESTAGED_BYTES = "stager.restaged_bytes"
+# incremental delta staging (snapshot + delta model, executor/stager.py)
+STAGER_DELTA_APPLIED = "stager.delta_applied"
+STAGER_DELTA_FALLBACK = "stager.delta_fallback"
+STAGER_DELTA_APPLY_SECONDS = "stager.delta_apply_seconds"
 # TopN rank/LRU caches
 CACHE_HITS = "cache.hits"
 CACHE_MISSES = "cache.misses"
@@ -163,8 +170,36 @@ METRICS: dict[str, tuple[str, str]] = {
     BATCHER_RESCUES: ("counter", "orphaned batch queues adopted by a blocked waiter"),
     STAGER_HITS: ("counter", "HBM staging-cache hits"),
     STAGER_MISSES: ("counter", "HBM staging-cache misses (block built + uploaded)"),
+    STAGER_MISSES_COLD: (
+        "counter",
+        "staging misses with no prior entry for the key (first touch)",
+    ),
+    STAGER_MISSES_INVALIDATION: (
+        "counter",
+        "staging misses caused by a fragment generation bump that could "
+        "not be absorbed as a delta (full rebuild + re-upload)",
+    ),
     STAGER_STAGE_SECONDS: ("summary", "host packing + upload time per staged block"),
     STAGER_BYTES: ("gauge", "bytes resident in the HBM staging cache"),
+    STAGER_RESTAGED_BYTES: (
+        "counter",
+        "bytes rebuilt + re-uploaded on invalidation misses — the cost "
+        "delta staging exists to avoid",
+    ),
+    STAGER_DELTA_APPLIED: (
+        "counter",
+        "staged blocks patched in place with scatter-update delta kernels "
+        "instead of rebuilt (snapshot + delta model)",
+    ),
+    STAGER_DELTA_FALLBACK: (
+        "counter",
+        "generation-mismatched blocks that fell back to a full re-stage "
+        "(label: reason = log | ratio | shape | sparse_form)",
+    ),
+    STAGER_DELTA_APPLY_SECONDS: (
+        "summary",
+        "host mask coalesce + device scatter time per delta apply",
+    ),
     CACHE_HITS: ("counter", "TopN rank/LRU cache hits"),
     CACHE_MISSES: ("counter", "TopN rank/LRU cache misses"),
     CLUSTER_MAP_REMOTE_SECONDS: (
@@ -241,6 +276,7 @@ STAGE_DEVICE_BATCH = "executor.device_batch"
 STAGE_SPMD_KERNEL = "spmd.kernel"
 STAGE_BATCH_SCORE = "batcher.score"
 STAGE_STAGE = "stager.stage"
+STAGE_DELTA = "stager.delta_apply"
 STAGE_MAP_REMOTE = "cluster.map_remote"
 STAGE_MAP_LOCAL = "cluster.map_local"
 
@@ -255,6 +291,7 @@ STAGES: dict[str, str] = {
     STAGE_SPMD_KERNEL: "compiled kernel invocation (meta: kind, first)",
     STAGE_BATCH_SCORE: "batched-scorer scoring request, enqueue to result",
     STAGE_STAGE: "HBM staging-cache miss build (meta: nbytes)",
+    STAGE_DELTA: "delta scatter-apply onto a resident block (meta: nupdates)",
     STAGE_MAP_REMOTE: "distributed map-reduce remote leg (meta: node)",
     STAGE_MAP_LOCAL: "distributed map-reduce local leg",
 }

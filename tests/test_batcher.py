@@ -248,7 +248,7 @@ def test_stager_concurrent_cold_miss_stages_once(tmp_path):
         t.join()
     assert all(o is out[0] for o in out)  # one staged array shared
     assert st.misses == 1
-    ent_bytes = sum(nb for _, nb in st._cache.values())
+    ent_bytes = sum(e.nbytes for e in st._cache.values())
     assert st._bytes == ent_bytes  # budget charged exactly once
     h.close()
 

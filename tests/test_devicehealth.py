@@ -109,11 +109,11 @@ class TestDeviceHealthUnit:
         def slow_builder():
             entered.set()
             proceed.wait(timeout=10)
-            return ("stale-handle", 8)
+            return ("stale-handle", 8, 0)
 
         out = {}
         t = threading.Thread(
-            target=lambda: out.update(v=st._get_or_build(("k",), slow_builder))
+            target=lambda: out.update(v=st._get_or_build(("k",), 0, slow_builder))
         )
         t.start()
         entered.wait(timeout=5)
@@ -123,7 +123,7 @@ class TestDeviceHealthUnit:
         # the zombie's value reached its own caller...
         assert out["v"] == "stale-handle"
         # ...but never entered the post-reset cache
-        assert st._get_or_build(("k",), lambda: ("fresh", 8)) == "fresh"
+        assert st._get_or_build(("k",), 0, lambda: ("fresh", 8, 0)) == "fresh"
 
     def test_probe_restores(self):
         hlth = DeviceHealth(

@@ -79,7 +79,7 @@ class TestSparseTopN:
         got = dev.execute("i", q)
         assert want == got
         # the tall sparse candidate set must have taken the sparse path
-        kinds = {k[2] for k in dev.stager._cache if len(k) > 2}
+        kinds = {k[1] for k in dev.stager._cache if len(k) > 1}
         assert "sparse_rows" in kinds
         h.close()
 
@@ -258,6 +258,6 @@ class TestSparseTopN:
         dev = Executor(h, device_policy="always")
         q = "TopN(f, Row(f=1), n=4)"
         assert cpu.execute("i", q) == dev.execute("i", q)
-        kinds = {k[2] for k in dev.stager._cache if len(k) > 2}
+        kinds = {k[1] for k in dev.stager._cache if len(k) > 1}
         assert "sparse_rows" not in kinds
         h.close()

@@ -173,7 +173,9 @@ def test_stack_is_mesh_sharded(spmd_exec, mesh):
     """Staged shard stacks carry a NamedSharding over the mesh axis."""
     spmd_exec.execute("i", "Count(Row(general=1))")
     staged = [
-        v for (key, (v, _)) in spmd_exec.stager._cache.items() if "row_stack" in key
+        e.value
+        for (key, e) in spmd_exec.stager._cache.items()
+        if "row_stack" in key
     ]
     assert staged, "row_stack was not staged"
     sharding = staged[-1].sharding

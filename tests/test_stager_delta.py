@@ -1,0 +1,349 @@
+"""Incremental HBM delta staging (snapshot + delta model).
+
+Every staged form must stay bit-identical across three paths after any
+interleaving of writes and staged reads:
+
+  * the delta path — a shared stager patching resident arrays forward
+  * a forced full re-stage — a fresh stager rebuilding from host
+  * the CPU source of truth — the fragment's packed-word exports
+
+plus byte-accounting invariants under eviction and epoch reset (no
+leaked ``_bytes``, no stale delta replay after ``reset_after_wedge``).
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SHARD_WIDTH
+from pilosa_tpu.core import (
+    FieldOptions,
+    Holder,
+    VIEW_BSI_GROUP_PREFIX,
+    VIEW_STANDARD,
+)
+from pilosa_tpu.core.field import FIELD_TYPE_INT
+from pilosa_tpu.executor import DeviceStager, Executor
+from pilosa_tpu.utils import metrics
+
+W32 = SHARD_WIDTH // 32
+
+
+def _delta_counters(snap=None):
+    snap = snap if snap is not None else metrics.snapshot()
+    out = {"applied": 0.0, "fallback": 0.0, "cold": 0.0, "invalidation": 0.0}
+    for k, v in snap.items():
+        if isinstance(v, dict):
+            continue
+        if k.startswith(metrics.STAGER_DELTA_APPLIED):
+            out["applied"] += v
+        elif k.startswith(metrics.STAGER_DELTA_FALLBACK):
+            out["fallback"] += v
+        elif k.startswith(metrics.STAGER_MISSES_COLD):
+            out["cold"] += v
+        elif k.startswith(metrics.STAGER_MISSES_INVALIDATION):
+            out["invalidation"] += v
+    return out
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    yield h
+    h.close()
+
+
+def _seed_fragment(holder, rows=24, bits_per_row=40, seed=7):
+    idx = holder.create_index("dl")
+    f = idx.create_field("f")
+    rng = np.random.default_rng(seed)
+    rids, cids = [], []
+    for r in range(rows):
+        rids += [r] * bits_per_row
+        cids += rng.integers(0, SHARD_WIDTH, size=bits_per_row).tolist()
+    f.import_bits(rids, cids)
+    return idx, f, holder.fragment("dl", "f", VIEW_STANDARD, 0)
+
+
+def _assert_row_identical(stager, frag, row_id):
+    got = np.asarray(stager.row(frag, row_id))
+    want = frag.row_words(row_id).view("<u4")
+    np.testing.assert_array_equal(got, want)
+
+
+class TestFormsBitIdentical:
+    """Fuzz: random write/read interleavings on one fragment; every
+    staged form answers bit-identically to the CPU full path AND to a
+    forced full re-stage."""
+
+    def test_random_interleaving_all_forms(self, holder):
+        idx, f, frag = _seed_fragment(holder)
+        v = idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT, min=0, max=4000))
+        v.import_values([5, 9, 700], [17, 2000, 3999])
+        vfrag = holder.fragment("dl", "v", VIEW_BSI_GROUP_PREFIX + "v", 0)
+        depth = v.bsi_group("v").bit_depth()
+
+        shared = DeviceStager()  # delta path: entries live across writes
+        rng = np.random.default_rng(1234)
+        staged_rows = (0, 3, 5, 11)
+
+        for step in range(30):
+            op = rng.choice(["set", "clear", "setvalue"])
+            if op == "set":
+                f.set_bit(int(rng.integers(0, 24)), int(rng.integers(0, SHARD_WIDTH)))
+            elif op == "clear":
+                f.clear_bit(int(rng.integers(0, 24)), int(rng.integers(0, SHARD_WIDTH)))
+            else:
+                v.set_value(int(rng.integers(0, 1000)), int(rng.integers(0, 4000)))
+
+            fresh = DeviceStager()  # forced full re-stage oracle
+            # -- row
+            rid = int(rng.integers(0, 24))
+            want_row = frag.row_words(rid).view("<u4")
+            np.testing.assert_array_equal(np.asarray(shared.row(frag, rid)), want_row)
+            np.testing.assert_array_equal(np.asarray(fresh.row(frag, rid)), want_row)
+            # -- rows (padded + unpadded)
+            for pad in (False, True):
+                got = np.asarray(shared.rows(frag, staged_rows, pad_pow2=pad))
+                full = np.asarray(fresh.rows(frag, staged_rows, pad_pow2=pad))
+                np.testing.assert_array_equal(got, full)
+                for k, r in enumerate(staged_rows):
+                    np.testing.assert_array_equal(
+                        got[k], frag.row_words(r).view("<u4")
+                    )
+            # -- matrix
+            ids_s, dev_s = shared.matrix(frag)
+            ids_f, dev_f = fresh.matrix(frag)
+            assert ids_s == ids_f == frag.row_ids()
+            np.testing.assert_array_equal(np.asarray(dev_s), np.asarray(dev_f))
+            # -- planes
+            got_p = np.asarray(shared.planes(vfrag, depth))
+            want_p = vfrag.bsi_planes(depth).view("<u4").reshape(depth + 1, -1)
+            np.testing.assert_array_equal(got_p, want_p)
+            # -- sparse_rows (documented fallback form — still correct)
+            blocks, brow, bslot, _ = shared.sparse_rows(frag, staged_rows)
+            fb, fr, fs, _ = fresh.sparse_rows(frag, staged_rows)
+            np.testing.assert_array_equal(np.asarray(blocks), np.asarray(fb))
+
+        # the shared stager must have actually exercised the delta path
+        assert shared.delta_applies > 0
+
+    def test_stack_forms_bit_identical(self, holder):
+        idx = holder.create_index("st")
+        f = idx.create_field("f")
+        rng = np.random.default_rng(99)
+        rids, cids = [], []
+        for shard in range(2):
+            for r in range(8):
+                rids += [r] * 30
+                cids += (
+                    shard * SHARD_WIDTH
+                    + rng.integers(0, SHARD_WIDTH, size=30)
+                ).tolist()
+        f.import_bits(rids, cids)
+        v = idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT, min=0, max=500))
+        v.import_values([3, SHARD_WIDTH + 8], [77, 431])
+        frags = [
+            holder.fragment("st", "f", VIEW_STANDARD, s) for s in range(2)
+        ]
+        vfrags = [
+            holder.fragment("st", "v", VIEW_BSI_GROUP_PREFIX + "v", s)
+            for s in range(2)
+        ]
+        depth = v.bsi_group("v").bit_depth()
+        shared = DeviceStager()
+
+        for step in range(12):
+            shard = int(rng.integers(0, 2))
+            if rng.random() < 0.5:
+                f.set_bit(
+                    int(rng.integers(0, 8)),
+                    shard * SHARD_WIDTH + int(rng.integers(0, SHARD_WIDTH)),
+                )
+            else:
+                v.set_value(
+                    shard * SHARD_WIDTH + int(rng.integers(0, SHARD_WIDTH)),
+                    int(rng.integers(0, 500)),
+                )
+            fresh = DeviceStager()
+            rid = int(rng.integers(0, 8))
+            got = np.asarray(shared.row_stack(frags, rid))
+            np.testing.assert_array_equal(
+                got, np.asarray(fresh.row_stack(frags, rid))
+            )
+            for s in range(2):
+                np.testing.assert_array_equal(
+                    got[s], frags[s].row_words(rid).view("<u4")
+                )
+            got_p = np.asarray(shared.planes_stack(vfrags, depth))
+            np.testing.assert_array_equal(
+                got_p, np.asarray(fresh.planes_stack(vfrags, depth))
+            )
+        assert shared.delta_applies > 0
+
+
+class TestExecutorReadWriteMix:
+    def test_device_results_match_cpu_under_writes(self, holder):
+        idx, f, frag = _seed_fragment(holder, rows=40, bits_per_row=60)
+        cpu = Executor(holder, device_policy="never")
+        dev = Executor(holder, device_policy="always")
+        rng = np.random.default_rng(5)
+        queries = [
+            "TopN(f, n=6)",
+            "TopN(f, Row(f=3), n=4)",
+            "Count(Intersect(Row(f=1), Row(f=2)))",
+            "Count(Union(Row(f=0), Row(f=5), Row(f=7)))",
+        ]
+        for step in range(15):
+            f.set_bit(int(rng.integers(0, 40)), int(rng.integers(0, SHARD_WIDTH)))
+            for q in queries:
+                assert cpu.execute("dl", q) == dev.execute("dl", q), (step, q)
+        # the executor's stager absorbed writes as deltas, not rebuilds
+        assert dev.stager.delta_applies > 0
+
+
+class TestFallbacks:
+    def test_bulk_import_forces_full_restage(self, holder):
+        idx, f, frag = _seed_fragment(holder)
+        st = DeviceStager()
+        st.row(frag, 0)
+        before = _delta_counters()
+        f.import_bits([0, 0], [17, 18])  # bulk path resets the delta log
+        _assert_row_identical(st, frag, 0)
+        after = _delta_counters()
+        assert after["invalidation"] == before["invalidation"] + 1
+        assert after["fallback"] > before["fallback"]
+
+    def test_log_truncation_falls_back(self, holder):
+        idx, f, frag = _seed_fragment(holder)
+        frag.delta_log_max = 8
+        st = DeviceStager()
+        st.row(frag, 0)
+        for i in range(20):  # > log capacity: snapshot gen falls below floor
+            f.set_bit(0, 1000 + i)
+        before = _delta_counters()
+        _assert_row_identical(st, frag, 0)
+        after = _delta_counters()
+        assert after["invalidation"] == before["invalidation"] + 1
+
+    def test_external_generation_bump_is_not_misread_as_empty_delta(self, holder):
+        """A raw ``generation += 1`` (the fragment-restore path) must
+        fault the log — replaying "no deltas" over replaced content
+        would serve stale bits."""
+        idx, f, frag = _seed_fragment(holder)
+        st = DeviceStager()
+        st.row(frag, 0)
+        with frag.mu:
+            frag.storage.add(17)  # bypasses the log, like a restore
+            frag.generation += 1
+        _assert_row_identical(st, frag, 0)  # full rebuild, fresh bits
+        # and the log re-anchors: the next tracked write delta-applies
+        applied0 = st.delta_applies
+        f.set_bit(0, 99)
+        _assert_row_identical(st, frag, 0)
+        assert st.delta_applies == applied0 + 1
+
+    def test_ratio_zero_always_restages(self, holder):
+        idx, f, frag = _seed_fragment(holder)
+        st = DeviceStager(delta_max_ratio=0.0)
+        st.row(frag, 5)
+        f.set_bit(5, 4242)
+        before = _delta_counters()
+        _assert_row_identical(st, frag, 5)
+        after = _delta_counters()
+        assert after["invalidation"] == before["invalidation"] + 1
+        assert st.delta_applies == 0
+
+    def test_delta_disabled_restages(self, holder):
+        idx, f, frag = _seed_fragment(holder)
+        st = DeviceStager(delta_enabled=False)
+        st.row(frag, 5)
+        f.set_bit(5, 4242)
+        _assert_row_identical(st, frag, 5)
+        assert st.delta_applies == 0
+
+    def test_matrix_shape_change_restages(self, holder):
+        idx, f, frag = _seed_fragment(holder, rows=6)
+        st = DeviceStager()
+        ids0, _ = st.matrix(frag)
+        f.set_bit(500, 1)  # brand-new row: matrix shape changes
+        ids1, dev1 = st.matrix(frag)
+        assert 500 in ids1 and ids1 == frag.row_ids()
+        np.testing.assert_array_equal(
+            np.asarray(dev1)[ids1.index(500)], frag.row_words(500).view("<u4")
+        )
+
+
+class TestByteAccounting:
+    def test_no_leaked_bytes_under_eviction_with_deltas(self, holder):
+        idx, f, frag = _seed_fragment(holder)
+        # budget fits ~2 row blocks (128 KB each): staging several rows
+        # forces continuous eviction while deltas patch survivors
+        st = DeviceStager(budget_bytes=300 * 1024)
+        rng = np.random.default_rng(3)
+        for step in range(40):
+            if rng.random() < 0.3:
+                f.set_bit(int(rng.integers(0, 24)), int(rng.integers(0, SHARD_WIDTH)))
+            rid = int(rng.integers(0, 8))
+            _assert_row_identical(st, frag, rid)
+            with st._mu:
+                ent_bytes = sum(e.nbytes for e in st._cache.values())
+                assert st._bytes == ent_bytes
+                assert st._bytes <= max(
+                    st.budget_bytes, max((e.nbytes for e in st._cache.values()), default=0)
+                )
+
+    def test_refresh_replaces_bytes_not_accumulates(self, holder):
+        idx, f, frag = _seed_fragment(holder)
+        st = DeviceStager()
+        st.row(frag, 0)
+        b0 = st._bytes
+        for i in range(5):
+            f.set_bit(0, 2000 + i)
+            st.row(frag, 0)
+        assert st._bytes == b0  # same block, same footprint, 5 refreshes
+
+    def test_reset_after_wedge_drops_deltas_and_bytes(self, holder):
+        idx, f, frag = _seed_fragment(holder)
+        st = DeviceStager()
+        st.row(frag, 0)
+        f.set_bit(0, 123)
+        st.reset_after_wedge()
+        assert st._bytes == 0 and not st._cache
+        before = _delta_counters()
+        _assert_row_identical(st, frag, 0)  # rebuilt, not delta-replayed
+        after = _delta_counters()
+        assert after["cold"] == before["cold"] + 1
+        assert after["applied"] == before["applied"]
+        ent_bytes = sum(e.nbytes for e in st._cache.values())
+        assert st._bytes == ent_bytes
+
+
+class TestDeltaLogUnit:
+    def test_deltas_since_tracks_and_truncates(self, holder):
+        idx, f, frag = _seed_fragment(holder, rows=2, bits_per_row=4)
+        g0 = frag.generation
+        f.set_bit(0, 10)
+        f.clear_bit(0, 10)
+        pos, is_set, gen = frag.deltas_since(g0)
+        assert pos.tolist() == [10, 10]
+        assert is_set.tolist() == [True, False]
+        assert gen == frag.generation
+        # empty tail
+        pos2, is_set2, _ = frag.deltas_since(frag.generation)
+        assert pos2.size == 0 and is_set2.size == 0
+        # truncation floor
+        frag.delta_log_max = 4
+        for i in range(10):
+            f.set_bit(1, 20 + i)
+        assert frag.deltas_since(g0) is None
+
+    def test_snapshot_preserves_log_continuity(self, holder):
+        idx, f, frag = _seed_fragment(holder, rows=2, bits_per_row=4)
+        g0 = frag.generation
+        f.set_bit(0, 33)
+        frag.snapshot()  # content-preserving generation bump
+        d = frag.deltas_since(g0)
+        assert d is not None
+        pos, is_set, gen = d
+        assert pos.tolist() == [33] and gen == frag.generation
