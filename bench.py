@@ -173,6 +173,64 @@ def vs_baseline_fields(
     return out
 
 
+# -- bench window self-qualification (VERDICT item 4) -----------------------
+# A tunneled-chip window can degrade (slow RTT day, shallow request
+# pipelining) without failing outright; a headline measured in such a
+# window must not silently overwrite the last-good artifact.
+
+# a run whose RTT is this much worse than the last-good's is degraded
+DEGRADED_RTT_FACTOR = 2.5
+# a run achieving under this fraction of the last-good pipelining depth
+# (concurrent round-trips in flight = qps x RTT) is degraded
+DEGRADED_DEPTH_FACTOR = 0.4
+
+
+def window_quality(tall: dict):
+    """Measured quality of the window the headline came from: the
+    sustained device RTT (median of the tiny round-trip probe) and the
+    achieved pipelining depth (headline qps x RTT = concurrent round
+    trips actually in flight). None when the run measured no RTT
+    profile — a run that can't prove its window must not displace one
+    that could."""
+    prof = (tall or {}).get("profile") or {}
+    rtt_ms = prof.get("device_rtt_ms")
+    if not isinstance(rtt_ms, (int, float)) or rtt_ms <= 0:
+        return None
+    mode, qps = headline_mode(tall)
+    if not qps:
+        return None
+    return {
+        "sustained_rtt_ms": rtt_ms,
+        "pipelining_depth": round(qps * rtt_ms / 1000.0, 2),
+        "headline_qps": qps,
+        "headline_mode": mode,
+    }
+
+
+def window_degraded(new_wq, old_wq):
+    """(degraded, reason) for overwriting an artifact whose window was
+    ``old_wq`` with one whose window is ``new_wq``. No old quality
+    record (pre-gating artifact) accepts anything — the first qualified
+    run seeds the baseline."""
+    if not old_wq:
+        return False, None
+    if not new_wq:
+        return True, "no window_quality measured this run (last-good has one)"
+    rtt, old_rtt = new_wq["sustained_rtt_ms"], old_wq["sustained_rtt_ms"]
+    if old_rtt and rtt > old_rtt * DEGRADED_RTT_FACTOR:
+        return True, (
+            f"sustained RTT {rtt:.2f} ms > {DEGRADED_RTT_FACTOR}x "
+            f"last-good {old_rtt:.2f} ms"
+        )
+    depth, old_depth = new_wq["pipelining_depth"], old_wq["pipelining_depth"]
+    if old_depth and depth < old_depth * DEGRADED_DEPTH_FACTOR:
+        return True, (
+            f"pipelining depth {depth:.2f} < {DEGRADED_DEPTH_FACTOR}x "
+            f"last-good {old_depth:.2f}"
+        )
+    return False, None
+
+
 def _pipeline_serving_probe(budget_s: float) -> dict:
     """Closed-loop HTTP throughput THROUGH the serving pipeline
     (ISSUE 2): boots a real server on :0 with the pipeline enabled over
@@ -529,6 +587,134 @@ def _rw_mix_probe(budget_s: float) -> dict:
     return out
 
 
+def _plan_cache_probe(budget_s: float) -> dict:
+    """Plan result cache under Zipf-repeated traffic (ISSUE 4): a
+    TopN/Intersect query mix drawn from a Zipf distribution (the
+    dashboard / hot-query traffic shape the serving stack targets) runs
+    through an executor with and without the generation-stamped result
+    cache. Reports hot vs cold qps, the achieved hit ratio, and bytes
+    resident — then a 1%-write arm proving invalidation correctness:
+    every read in the write arm is compared bit-for-bit against an
+    uncached oracle executor over the same holder, and the arm must
+    observe > 0 generation invalidations. Chip-independent (the
+    contrast is cache economics, not kernel speed)."""
+    import shutil as _shutil
+    import tempfile
+
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.plan.cache import PlanCache
+
+    R, BITS = 128, 2000
+    N_DISTINCT = 48  # distinct queries in the pool
+    ZIPF_A = 1.3  # Zipf exponent: ~85-90% of draws hit the head
+    WRITE_FRAC = 0.01
+    tmp = tempfile.mkdtemp(prefix="pilosa_plancache_")
+    out = {
+        "note": (
+            "Zipf-repeated TopN/Intersect mix through the plan result "
+            "cache (CPU executor; the contrast is cache vs recompute, "
+            "not kernel speed); write arm compares every cached read "
+            "against an uncached oracle"
+        ),
+        "zipf_a": ZIPF_A,
+        "distinct_queries": N_DISTINCT,
+        "write_frac": WRITE_FRAC,
+    }
+    h = Holder(tmp)
+    h.open()
+    try:
+        idx = h.create_index("zc")
+        fld = idx.create_field("f")
+        rng = np.random.default_rng(17)
+        rows, cols = [], []
+        for r_ in range(R):
+            rows += [r_] * BITS
+            cols += rng.integers(0, 1 << 20, size=BITS).tolist()
+        fld.import_bits(rows, cols)
+        pool = []
+        for i in range(N_DISTINCT):
+            a, b, c = i % R, (i * 7 + 1) % R, (i * 13 + 2) % R
+            pool.append(
+                [
+                    f"TopN(f, Row(f={a}), n=10)",
+                    f"Count(Intersect(Row(f={a}), Row(f={b})))",
+                    f"Count(Union(Row(f={a}), Row(f={b}), Row(f={c})))",
+                ][i % 3]
+            )
+        # one fixed Zipf draw sequence, shared by all arms
+        zdraw = (np.random.default_rng(23).zipf(ZIPF_A, size=200_000) - 1) % N_DISTINCT
+
+        def arm(ex, seconds, write_frac=0.0, oracle=None, wnonce=0):
+            wrng = np.random.default_rng(5000 + wnonce)
+            stop = time.perf_counter() + seconds
+            # oracle-checked arms run at the ORACLE's qps, so a pure
+            # time budget can finish before 1% of ops were writes —
+            # writes fire deterministically every 1/write_frac ops and
+            # the arm runs on until a few landed (bounded at 3x budget)
+            hard_stop = time.perf_counter() + seconds * 3
+            every = int(1 / write_frac) if write_frac else 0
+            reads = writes = mismatches = i = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() < stop or (
+                every and writes < 5 and time.perf_counter() < hard_stop
+            ):
+                if every and i % every == every - 1:
+                    # writes land on the rows the hot queries read —
+                    # the worst case for the cache, which is the point
+                    fld.set_bit(
+                        int(wrng.integers(0, 16)),
+                        int(wrng.integers(0, 1 << 20)),
+                    )
+                    writes += 1
+                else:
+                    q = pool[zdraw[i % len(zdraw)]]
+                    (got,) = ex.execute("zc", q)
+                    if oracle is not None:
+                        (want,) = oracle.execute("zc", q)
+                        if str(got) != str(want):
+                            mismatches += 1
+                    reads += 1
+                i += 1
+            dt = time.perf_counter() - t0
+            return reads / dt, writes / dt, mismatches
+
+        cold_ex = Executor(h, device_policy="never")
+        cached_ex = Executor(h, device_policy="never", plan_cache=PlanCache())
+        seg = max(1.5, min(6.0, budget_s / 5))
+        for q in pool[:6]:  # warm both paths' Python/JIT overheads
+            cold_ex.execute("zc", q)
+            cached_ex.execute("zc", q)
+        cold_qps, _, _ = arm(cold_ex, seg)
+        hot_qps, _, _ = arm(cached_ex, seg)
+        st = cached_ex.plan_cache.stats()
+        out["cold_qps"] = round(cold_qps, 1)
+        out["hot_qps"] = round(hot_qps, 1)
+        out["speedup"] = round(hot_qps / cold_qps, 2) if cold_qps else None
+        out["hit_ratio"] = st["hit_ratio"]
+        out["bytes_resident"] = st["bytes"]
+        out["entries"] = st["entries"]
+        # write arm: cached executor + 1% writes, every read checked
+        # bit-for-bit against an uncached oracle on the same holder
+        inv0 = cached_ex.plan_cache.stats()["invalidations"]
+        w_qps, wps, mism = arm(
+            cached_ex, seg, write_frac=WRITE_FRAC, oracle=cold_ex, wnonce=1
+        )
+        st = cached_ex.plan_cache.stats()
+        out["write_arm"] = {
+            # oracle double-execution halves qps; correctness arm, not
+            # a throughput claim
+            "read_qps_with_oracle_check": round(w_qps, 1),
+            "writes_per_s": round(wps, 1),
+            "invalidations": st["invalidations"] - inv0,
+            "result_mismatches_vs_uncached_oracle": mism,
+        }
+    finally:
+        h.close()
+        _shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def main():
     import os
 
@@ -675,6 +861,13 @@ def main():
                             seq_qps=tall.get("topn_qps"),
                         )
                     )
+                    # window self-qualification rides next to the
+                    # headline (VERDICT item 4): sustained RTT +
+                    # achieved pipelining depth, consumed by the
+                    # last-good gating in _guarded_main
+                    wq = window_quality(tall)
+                    if wq is not None:
+                        result["window_quality"] = wq
         except Exception as e:  # keep the JSON line flowing
             print(f"tall bench failed: {type(e).__name__}: {e}", file=sys.stderr)
 
@@ -751,6 +944,21 @@ def main():
             except Exception as e:
                 print(
                     f"rw_mix probe failed: {type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
+
+    # ---- plan-cache probe (ISSUE 4): Zipf-repeated TopN/Intersect mix
+    # through the generation-stamped result cache — hot vs cold qps,
+    # hit ratio, bytes resident, and a 1%-write invalidation-
+    # correctness arm checked against an uncached oracle.
+    if os.environ.get("PILOSA_BENCH_PLANCACHE", "1") != "0":
+        rem = child_budget - (time.monotonic() - _T_PROC_START)
+        if rem > 50:
+            try:
+                result["cached_qps"] = _plan_cache_probe(min(25.0, rem - 30))
+            except Exception as e:
+                print(
+                    f"plan-cache probe failed: {type(e).__name__}: {e}",
                     file=sys.stderr,
                 )
 
@@ -1161,16 +1369,34 @@ def _guarded_main():
             # a deadline-cut partial must never shadow the last
             # COMPLETE real-device measurement. Only a real-device
             # result is worth replaying later; a CPU smoke run must
-            # not masquerade as the TPU number. Write-then-rename so
-            # a killed writer can't truncate the previous good file.
+            # not masquerade as the TPU number. Window gating (VERDICT
+            # item 4): a run measured in a degraded window (slow RTT,
+            # collapsed pipelining depth vs the last-good's recorded
+            # window_quality) keeps ITS OWN JSON line but must not
+            # displace the last-good artifact. Write-then-rename so a
+            # killed writer can't truncate the previous good file.
+            old_wq = None
             try:
-                tmp = LAST_GOOD + ".tmp"
-                with open(tmp, "w") as f:
-                    json.dump(obj, f)
-                    f.write("\n")
-                os.replace(tmp, LAST_GOOD)
-            except OSError as e:
-                print(f"could not persist last-good: {e}", file=sys.stderr)
+                with open(LAST_GOOD) as f:
+                    old_wq = (json.load(f) or {}).get("window_quality")
+            except (OSError, ValueError):
+                pass
+            degraded, why = window_degraded(obj.get("window_quality"), old_wq)
+            if degraded:
+                obj["window_degraded"] = why
+                print(
+                    f"degraded window — keeping prior BENCH_last_good.json: {why}",
+                    file=sys.stderr,
+                )
+            else:
+                try:
+                    tmp = LAST_GOOD + ".tmp"
+                    with open(tmp, "w") as f:
+                        json.dump(obj, f)
+                        f.write("\n")
+                    os.replace(tmp, LAST_GOOD)
+                except OSError as e:
+                    print(f"could not persist last-good: {e}", file=sys.stderr)
         print(json.dumps(obj))
         return
     print(reason, file=sys.stderr)
@@ -1262,6 +1488,9 @@ def _guarded_main():
             "error": f"final attempt failed ({reason}); parts are fresh "
             "same-revision measurements from this session",
         }
+        wq = window_quality(tall_part)
+        if wq is not None:
+            out["window_quality"] = wq
         bk, _ = best_closed_loop(tall_part, "topn_qps_c")
         if mode != "sequential" and bk:
             cp = tall_part.get("topn_p50_ms_c" + bk.rsplit("c", 1)[1])

@@ -112,6 +112,12 @@ def main(argv=None) -> int:
         help="fetch /debug/pipeline (serving-pipeline queue/shed/batch "
         "snapshot) instead",
     )
+    p.add_argument(
+        "--cache",
+        action="store_true",
+        help="fetch /debug/plancache (plan result-cache hit/invalidation/"
+        "bytes snapshot) instead",
+    )
     p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("config", help="print the effective configuration")
@@ -430,21 +436,24 @@ def cmd_inspect(args) -> int:
 
 def cmd_metrics(args) -> int:
     """Dump a node's observability surface: Prometheus text from
-    /metrics, the recent-trace ring buffer with --traces, or the
-    serving-pipeline snapshot with --pipeline."""
+    /metrics, the recent-trace ring buffer with --traces, the
+    serving-pipeline snapshot with --pipeline, or the plan result-cache
+    snapshot with --cache."""
     host = args.host if args.host.startswith("http") else f"http://{args.host}"
-    if args.pipeline:
+    if getattr(args, "cache", False):
+        path = "/debug/plancache"
+    elif args.pipeline:
         path = "/debug/pipeline"
     elif args.traces:
         path = "/debug/traces"
     else:
         path = "/metrics"
+    if path != "/metrics":
+        with urllib.request.urlopen(host + path, timeout=60) as resp:
+            print(json.dumps(json.loads(resp.read().decode()), indent=2))
+        return 0
     with urllib.request.urlopen(host + path, timeout=60) as resp:
-        body = resp.read().decode()
-    if args.traces or args.pipeline:
-        print(json.dumps(json.loads(body), indent=2))
-    else:
-        print(body, end="")
+        print(resp.read().decode(), end="")
     return 0
 
 

@@ -106,6 +106,10 @@ class ExecOptions:
     remote: bool = False
     exclude_row_attrs: bool = False
     exclude_columns: bool = False
+    # plan result cache participation (plan/cache.py): False bypasses
+    # both lookup and insert — the `cache=false` query option, and the
+    # profile=true path (a profiled query must show real execution)
+    cache: bool = True
 
 
 class _NotDeviceable(Exception):
@@ -271,6 +275,7 @@ class Executor:
         mesh=None,
         health=None,
         auto_min_containers: Optional[int] = None,
+        plan_cache=None,
     ) -> None:
         self.holder = holder
         self.cluster = cluster  # None = single-node
@@ -310,6 +315,13 @@ class Executor:
         self.health = health
         if health is not None:
             health.on_restore = self._on_device_restore
+        # generation-stamped query result cache (plan/cache.py). None =
+        # disabled (the default for bare executors, so tests and benches
+        # opt in explicitly); the server wires one per process. Only
+        # consulted for locally-executed reads — on a cluster each
+        # shard owner caches its own remote legs, because only IT can
+        # see its fragments' generations.
+        self.plan_cache = plan_cache
         # fused count-of-tree programs keyed by query structure
         self._tree_jits: dict[str, Any] = {}
         # batched variants keyed by (structure, pow2 width)
@@ -411,7 +423,27 @@ class Executor:
         if self.translate_store is not None and not opt.remote:
             for call in query.calls:
                 self._translate_call(index_name, idx, call)
-        if len(query.calls) > 1 and query.write_call_n() == 0:
+        calls = query.calls
+        if (
+            self.plan_cache is not None
+            and opt.cache
+            and self._local_batchable(opt)
+            and shards
+            and query.write_call_n() == 0
+        ):
+            # CSE against the result cache (plan/planner.py): repeated
+            # bitmap subtrees across this query's calls — which, via the
+            # pipeline's cross-request combiner, may span a whole gang
+            # of coalesced HTTP requests — execute once, and subtrees
+            # already cached feed back in as materialized rows. Local
+            # execution only: __cached placeholders never serialize.
+            from pilosa_tpu.plan import planner
+
+            with trace.child(metrics.STAGE_PLAN_CANON):
+                calls = planner.rewrite_for_cse(
+                    self, index_name, query.calls, shards, opt
+                )
+        if len(calls) > 1 and query.write_call_n() == 0:
             # An all-read request has no cross-call ordering constraints
             # (the reference runs calls serially, executor.go:126-145,
             # but read results are order-independent); running them
@@ -433,15 +465,15 @@ class Executor:
                 with trace.activate(parent), _deadline().activate(pdl):
                     return self._execute_call(index_name, call, shards, opt)
 
-            results = list(pool.map(run_call, query.calls))
+            results = list(pool.map(run_call, calls))
         else:
             results = []
-            for call in query.calls:
+            for call in calls:
                 results.append(self._execute_call(index_name, call, shards, opt))
         if self.translate_store is not None and not opt.remote:
             results = [
                 self._translate_result(index_name, idx, call, r)
-                for call, r in zip(query.calls, results)
+                for call, r in zip(calls, results)
             ]
         return results
 
@@ -556,14 +588,47 @@ class Executor:
         self.stacked_scorer = _make_stacked_scorer()
         self.chain_scorer = _make_chain_scorer(self)
         self.stager.reset_after_wedge()
+        if self.plan_cache is not None:
+            # results computed by the wedged device must not outlive it
+            self.plan_cache.epoch_reset()
 
     def _execute_call(self, index, c: Call, shards, opt) -> Any:
         metrics.count(metrics.EXECUTOR_CALLS, call=c.name)
         sp = trace.current()
         if sp is None:
-            return self._execute_call_guarded(index, c, shards, opt)
+            return self._execute_call_cached(index, c, shards, opt)
         with sp.child(metrics.STAGE_CALL, call=c.name):
+            return self._execute_call_cached(index, c, shards, opt)
+
+    def _execute_call_cached(self, index, c: Call, shards, opt) -> Any:
+        """Whole-call result cache around dispatch (plan/cache.py): a
+        generation-valid entry answers without touching the executor;
+        a miss executes under singleflight and stamps the entry with
+        the pre-build generation vector. Uncacheable calls (writes,
+        attr-dependent reads, malformed args) and non-local execution
+        dispatch straight through."""
+        from pilosa_tpu.pql.ast import WRITE_CALLS
+
+        pc = self.plan_cache
+        if (
+            pc is None
+            or not opt.cache
+            or not self._local_batchable(opt)
+            or shards is None
+            or c.name in WRITE_CALLS
+        ):
             return self._execute_call_guarded(index, c, shards, opt)
+        from pilosa_tpu.plan import planner
+
+        keyinfo = planner.call_cache_key(self, index, c, shards, opt)
+        if keyinfo is None:
+            return self._execute_call_guarded(index, c, shards, opt)
+        key, genvec_fn = keyinfo
+        return pc.get_or_build(
+            key,
+            genvec_fn,
+            lambda: self._execute_call_guarded(index, c, shards, opt),
+        )
 
     def _execute_call_guarded(self, index, c: Call, shards, opt) -> Any:
         """Read calls run under the device health gate when one is
@@ -691,6 +756,13 @@ class Executor:
 
     def _bitmap_call_shard_cpu(self, index, c: Call, shard: int) -> Row:
         name = c.name
+        if name == "__cached":
+            # planner-substituted subtree (plan/planner.py): the
+            # materialized per-shard rows ARE the result
+            seg = c.args["_row"].shard_segment(shard)
+            if seg is None:
+                return Row()
+            return Row.from_segment(shard, seg)
         if name == "Row":
             return self._row_shard(index, c, shard)
         if name == "Difference":
@@ -877,9 +949,34 @@ class Executor:
             total += self._touched_containers(index, child, shard)
         return total
 
+    def _cached_words(self, c: Call, shard: int):
+        """u32[W] packed words for one shard of a ``__cached`` node's
+        row, memoized on the node (a node is query-local, so the memo
+        dies with the query; repeated shards within one query — device
+        single-shard walks — pack once)."""
+        memo = c.args.setdefault("_words", {})
+        w = memo.get(shard)
+        if w is None:
+            w64 = np.zeros(SHARD_WIDTH // 64, dtype=np.uint64)
+            seg = c.args["_row"].shard_segment(shard)
+            if seg is not None:
+                cols = np.asarray(seg.slice_all(), dtype=np.uint64) - np.uint64(
+                    shard * SHARD_WIDTH
+                )
+                np.bitwise_or.at(
+                    w64,
+                    (cols >> np.uint64(6)).astype(np.int64),
+                    np.uint64(1) << (cols & np.uint64(63)),
+                )
+            w = np.ascontiguousarray(w64).view("<u4")
+            memo[shard] = w
+        return w
+
     def _device_bitmap(self, index, c: Call, shard: int):
         """Lower a bitmap call subtree to a device u32[W] word vector."""
         name = c.name
+        if name == "__cached":
+            return self._cached_words(c, shard)
         if name == "Row":
             field_name = c.field_arg()
             f = self.holder.field(index, field_name)
@@ -1106,6 +1203,8 @@ class Executor:
     def _device_bitmap_stack(self, index, c: Call, shards):
         """Lower a bitmap call subtree to u32[S, W] across shards."""
         name = c.name
+        if name == "__cached":
+            return np.stack([self._cached_words(c, s) for s in shards])
         if name == "Row":
             field_name = c.field_arg()
             f = self.holder.field(index, field_name)

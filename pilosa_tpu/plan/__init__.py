@@ -1,0 +1,27 @@
+"""Query planning (L3.5) — the layer between parsing and execution.
+
+Three parts (ISSUE 4):
+
+* ``canon``   — PQL AST canonicalization: flatten nested Union/Intersect,
+                sort commutative operands, normalize argument order, and
+                derive a stable content hash per subtree, so
+                ``Intersect(Row(a), Row(b))`` and
+                ``Intersect(Row(b), Row(a))`` share one identity.
+* ``cache``   — a bounded (byte-accounted, LRU) result cache keyed by
+                ``(canonical hash, shard set, fragment-generation
+                vector)``: a cached entry is valid iff every
+                contributing fragment's generation still matches, so
+                every write path invalidates for free through the
+                generation bumps PR 3 introduced — no TTLs.
+* ``planner`` — cache keys/generation vectors for the executor, plus
+                intra-query and intra-gang common-subexpression
+                elimination: repeated subtrees across the calls of one
+                (possibly pipeline-combined) query execute once, and
+                cached subtree rows feed back into parent ops as staged
+                inputs.
+"""
+
+from pilosa_tpu.plan.cache import PlanCache
+from pilosa_tpu.plan.canon import call_hash, canonicalize, query_signature
+
+__all__ = ["PlanCache", "call_hash", "canonicalize", "query_signature"]

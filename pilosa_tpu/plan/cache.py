@@ -1,0 +1,281 @@
+"""Generation-stamped query result cache — bounded, byte-accounted LRU.
+
+The serving pipeline's singleflight only coalesces *concurrent*
+duplicates; repeated workloads (dashboards, Zipf-skewed TopN traffic)
+re-pay full executor cost on every arrival. This cache closes that gap
+the way prefix/KV caches do for inference serving: results persist
+across requests, and validity is *proved* rather than guessed —
+
+* an entry is keyed by ``(canonical subtree hash, shard set, exec-option
+  bits)`` and stamped with the **fragment-generation vector** observed
+  before its build: one ``(field, view, shard, generation)`` entry per
+  fragment that could contribute to the result;
+* a lookup recomputes the current vector and serves the entry only on
+  an exact match. Every write path (set/clear/bulk import/value
+  import/block merge/restore) already bumps the fragment generation
+  (core/fragment.py, PR 3), so invalidation is free and exact — no TTL
+  heuristics, no stale reads;
+* the vector is captured BEFORE the build, so a write racing a build
+  can only over-invalidate (the entry records a pre-write vector and
+  mismatches on the next lookup), never serve post-write data as
+  pre-write or vice versa.
+
+Values are stored *encoded* (per-shard row segments for bitmap results,
+scalars for Count/Sum/Min/Max, id/count pairs for TopN) and decoded
+into fresh objects on every hit, so callers can mutate what they get
+back (key translation, cross-shard merges) without corrupting the
+cache. Builds are singleflighted per key; ``epoch_reset`` (wired to the
+device-health restore path next to ``DeviceStager.reset_after_wedge``)
+drops everything and fences out builders that started before the wedge.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+from pilosa_tpu.utils import metrics
+
+DEFAULT_MAX_BYTES = 256 << 20
+
+
+class _Entry:
+    __slots__ = ("value", "nbytes", "genvec")
+
+    def __init__(self, value, nbytes: int, genvec) -> None:
+        self.value = value
+        self.nbytes = nbytes
+        self.genvec = genvec
+
+
+# -- value codec ------------------------------------------------------------
+# Encoded forms are immutable-by-convention tuples; Row segments are
+# cloned INTO the cache at insert and OUT of it on every hit, so no
+# live object is ever shared between the cache and a caller.
+
+
+def encode_result(result) -> Optional[tuple[tuple, int]]:
+    """(encoded, nbytes) or None when the result type isn't cacheable.
+    nbytes is an accounting estimate (LRU budget), not an allocation."""
+    from pilosa_tpu.core.row import Row
+    from pilosa_tpu.executor.executor import ValCount
+
+    if isinstance(result, Row):
+        segs = tuple(
+            (shard, seg.clone()) for shard, seg in sorted(result.segments.items())
+        )
+        nbytes = 128 + sum(64 + 8 * seg.count() for _, seg in segs)
+        return ("row", segs), nbytes
+    if isinstance(result, bool):
+        return None  # write results are never cached
+    if isinstance(result, int):
+        return ("int", result), 64
+    if isinstance(result, ValCount):
+        return ("valcount", (result.val, result.count)), 64
+    if result is None:
+        return ("none", None), 32
+    if isinstance(result, list) and all(
+        isinstance(p, dict) and set(p) == {"id", "count"} for p in result
+    ):
+        pairs = tuple((p["id"], p["count"]) for p in result)
+        return ("pairs", pairs), 64 + 16 * len(pairs)
+    return None
+
+
+def decode_result(enc: tuple):
+    """A FRESH result object from an encoded entry."""
+    from pilosa_tpu.core.row import Row
+    from pilosa_tpu.executor.executor import ValCount
+
+    tag, payload = enc
+    if tag == "row":
+        r = Row()
+        for shard, seg in payload:
+            r.segments[shard] = seg.clone()
+        return r
+    if tag == "int":
+        return payload
+    if tag == "valcount":
+        return ValCount(payload[0], payload[1])
+    if tag == "none":
+        return None
+    if tag == "pairs":
+        return [{"id": i, "count": c} for i, c in payload]
+    raise ValueError(f"unknown plan-cache entry tag: {tag!r}")
+
+
+class PlanCache:
+    """Process-wide result cache. One instance per server (the executor
+    holds it); bare executors default to none, so tests and benches opt
+    in explicitly."""
+
+    def __init__(
+        self,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        min_cost: float = 0.0,
+    ) -> None:
+        self.max_bytes = int(max_bytes)
+        # builds cheaper than this (seconds) aren't stored: caching a
+        # 50 us Count costs more in bookkeeping + eviction pressure
+        # than it saves. 0 caches everything (the tested default).
+        self.min_cost = float(min_cost)
+        self._mu = threading.Lock()
+        self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self._building: dict[tuple, threading.Event] = {}
+        self.bytes = 0
+        self.epoch = 0
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+        self.evictions = 0
+        self.inserts = 0
+
+    # -- lookups -------------------------------------------------------------
+
+    def _lookup_locked(self, key, genvec) -> Optional[_Entry]:
+        """Entry for ``key`` valid at ``genvec``, counting hit or
+        invalidation; None on absence (NOT counted — probe-only callers
+        must not skew the miss rate). Caller holds _mu."""
+        e = self._entries.get(key)
+        if e is None:
+            return None
+        if e.genvec != genvec:
+            self._remove_locked(key, e)
+            self.invalidations += 1
+            metrics.count(metrics.PLANCACHE_INVALIDATIONS)
+            return None
+        self._entries.move_to_end(key)
+        self.hits += 1
+        metrics.count(metrics.PLANCACHE_HITS)
+        return e
+
+    def contains(self, key) -> bool:
+        """Presence probe WITHOUT generation validation — a cheap
+        pre-filter so tree walks don't compute a generation vector per
+        node. A True answer may still invalidate at lookup time."""
+        with self._mu:
+            return key in self._entries
+
+    def get(self, key, genvec_fn: Callable[[], tuple]) -> Optional[Any]:
+        """Probe-only lookup: decoded value on a valid hit, else None
+        (no miss counted, no build). The planner uses this to feed
+        already-cached subtree rows into parent ops without forcing a
+        build of every unique subtree it walks."""
+        if not self.contains(key):
+            return None
+        genvec = genvec_fn()
+        with self._mu:
+            e = self._lookup_locked(key, genvec)
+            if e is None:
+                return None
+            value = e.value
+        return decode_result(value)
+
+    def get_or_build(
+        self, key, genvec_fn: Callable[[], tuple], build: Callable[[], Any]
+    ) -> Any:
+        """Serve ``key`` from cache or build it exactly once across
+        concurrent callers (singleflight). The builder's exceptions
+        propagate to the leader; followers retry (and usually become
+        the next leader) rather than inheriting a failure that might
+        have been the leader's deadline, not theirs."""
+        while True:
+            genvec = genvec_fn()
+            with self._mu:
+                e = self._lookup_locked(key, genvec)
+                if e is not None:
+                    value = e.value
+                    return decode_result(value)
+                ev = self._building.get(key)
+                if ev is None:
+                    ev = self._building[key] = threading.Event()
+                    leader = True
+                else:
+                    leader = False
+            if not leader:
+                ev.wait()
+                continue
+            try:
+                epoch0 = self.epoch
+                t0 = time.monotonic()
+                result = build()
+                cost = time.monotonic() - t0
+                self._maybe_insert(key, result, genvec, cost, epoch0)
+                return result
+            finally:
+                with self._mu:
+                    self._building.pop(key, None)
+                ev.set()
+
+    # -- inserts / eviction --------------------------------------------------
+
+    def _maybe_insert(self, key, result, genvec, cost: float, epoch0: int) -> None:
+        self.misses += 1
+        metrics.count(metrics.PLANCACHE_MISSES)
+        if cost < self.min_cost:
+            return
+        enc = encode_result(result)
+        if enc is None:
+            return
+        value, nbytes = enc
+        if nbytes > self.max_bytes:
+            return
+        with self._mu:
+            if self.epoch != epoch0:
+                # an epoch reset (device wedge) happened mid-build: the
+                # result may reflect pre-wedge device state — drop it
+                return
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.bytes -= old.nbytes
+            self._entries[key] = _Entry(value, nbytes, genvec)
+            self.bytes += nbytes
+            self.inserts += 1
+            while self.bytes > self.max_bytes and self._entries:
+                k, e = self._entries.popitem(last=False)
+                self.bytes -= e.nbytes
+                self.evictions += 1
+                metrics.count(metrics.PLANCACHE_EVICTIONS)
+            metrics.gauge(metrics.PLANCACHE_BYTES, self.bytes)
+
+    def _remove_locked(self, key, e: _Entry) -> None:
+        del self._entries[key]
+        self.bytes -= e.nbytes
+        metrics.gauge(metrics.PLANCACHE_BYTES, self.bytes)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def epoch_reset(self) -> None:
+        """Drop everything and fence out in-flight builders. Wired next
+        to ``DeviceStager.reset_after_wedge`` (executor device-health
+        restore) — results computed by a wedged accelerator must not
+        outlive it — and to the recalculate-caches admin op, whose rank
+        reorders can change TopN candidate walks without a generation
+        bump."""
+        with self._mu:
+            self._entries.clear()
+            self.bytes = 0
+            self.epoch += 1
+            metrics.gauge(metrics.PLANCACHE_BYTES, 0)
+
+    def stats(self) -> dict:
+        """The /debug/plancache snapshot."""
+        with self._mu:
+            total = self.hits + self.misses
+            return {
+                "enabled": True,
+                "entries": len(self._entries),
+                "bytes": self.bytes,
+                "max_bytes": self.max_bytes,
+                "min_cost": self.min_cost,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_ratio": round(self.hits / total, 4) if total else None,
+                "invalidations": self.invalidations,
+                "evictions": self.evictions,
+                "inserts": self.inserts,
+                "building": len(self._building),
+                "epoch": self.epoch,
+            }

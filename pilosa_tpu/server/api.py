@@ -96,6 +96,7 @@ class API:
         exclude_columns: bool = False,
         column_attrs: bool = False,
         profile: bool = False,
+        cache: bool = True,
     ) -> dict:
         self._validate("query")
         # deadline boundary: cancel BEFORE the parse — an expired
@@ -107,6 +108,10 @@ class API:
             remote=remote,
             exclude_row_attrs=exclude_row_attrs,
             exclude_columns=exclude_columns,
+            # cache=false bypasses the plan result cache; profile=true
+            # does too — a profiled query must show real execution, not
+            # a cache hit's absence of spans
+            cache=cache and not profile,
         )
         # root span: forced by profile=true, else admitted by the
         # tracer's sample rate / slow-query threshold (NOP when off —
@@ -521,6 +526,11 @@ class API:
                 for v in f.views.values():
                     for frag in v.fragments.values():
                         frag.cache.recalculate()
+        # rank reorders can change TopN candidate walks without any
+        # fragment generation bump — cached TopN results are stale
+        pc = getattr(self.executor, "plan_cache", None)
+        if pc is not None:
+            pc.epoch_reset()
         if self.server is not None:
             self.server.send_sync({"type": "recalculate-caches"})
 

@@ -126,6 +126,18 @@ class Config:
     # graceful-drain budget at shutdown: queued + in-flight work gets
     # this long to complete before being failed 503
     pipeline_drain_timeout: float = 10.0
+    # plan result cache (plan/cache.py): generation-stamped cross-request
+    # result cache between parsing and execution. Entries are keyed by
+    # canonical plan hash + shard set and validated against fragment
+    # generations, so every write path invalidates exactly — no TTLs.
+    plan_cache_enabled: bool = True
+    # LRU byte budget for cached results (per-shard row segments +
+    # scalars); 0 effectively disables storage
+    plan_cache_max_bytes: int = 256 << 20
+    # minimum build cost (seconds) for a result to be stored: filters
+    # out sub-threshold queries whose recompute is cheaper than the
+    # cache bookkeeping. 0 caches everything.
+    plan_cache_min_cost: float = 0.0
     # opt-in diagnostics phone-home endpoint (reference diagnostics.go);
     # empty = disabled
     diagnostics_host: str = ""
@@ -208,6 +220,9 @@ class Config:
             f"pipeline-batch-max = {self.pipeline_batch_max}",
             f"pipeline-default-timeout = {self.pipeline_default_timeout}",
             f"pipeline-drain-timeout = {self.pipeline_drain_timeout}",
+            f"plan-cache-enabled = {'true' if self.plan_cache_enabled else 'false'}",
+            f"plan-cache-max-bytes = {self.plan_cache_max_bytes}",
+            f"plan-cache-min-cost = {self.plan_cache_min_cost}",
             "",
             "[cluster]",
             f"disabled = {'true' if self.cluster.disabled else 'false'}",

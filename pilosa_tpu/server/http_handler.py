@@ -185,6 +185,7 @@ class Handler:
             ),
             Route("GET", r"/metrics", self.get_metrics),
             Route("GET", r"/debug/pipeline", self.get_debug_pipeline),
+            Route("GET", r"/debug/plancache", self.get_debug_plancache),
             Route("GET", r"/debug/vars", self.get_debug_vars),
             Route("GET", r"/debug/traces", self.get_debug_traces),
             # index (with and without trailing slash, as net/http/pprof
@@ -229,34 +230,44 @@ class Handler:
             exclude_columns = q.get("excludeColumns", ["false"])[0] == "true"
             column_attrs = q.get("columnAttrs", ["false"])[0] == "true"
         profile = q.get("profile", ["false"])[0] == "true"
+        cache = q.get("cache", ["true"])[0] != "false"
         dl = deadline_mod.from_request(req.headers, q, self.default_timeout)
         # pipeline classification: remote legs of distributed queries
         # are internal traffic (their own queue — a user-query flood
         # must not shed the cluster data plane); everything else is
         # interactive. Read-only queries coalesce (singleflight) by
-        # exact signature; plain whole-index reads additionally gang
-        # into combined cross-request executions.
+        # CANONICAL plan signature (plan/canon.py) — argument-order-
+        # permuted duplicates like Intersect(Row(a),Row(b)) vs
+        # Intersect(Row(b),Row(a)) share one execution; unparseable
+        # text falls back to the raw bytes so syntax errors still 400
+        # individually. Plain whole-index reads additionally gang into
+        # combined cross-request executions.
         cls = CLASS_INTERNAL if remote else CLASS_INTERACTIVE
         signature = None
         batch = None
         if not remote and not profile and not _WRITE_CALL_RE.search(body):
+            from pilosa_tpu.plan.canon import query_signature
+
+            canon_sig = query_signature(body)
             signature = (
                 "q",
                 index,
-                body,
+                canon_sig if canon_sig is not None else body,
                 tuple(shards) if shards is not None else None,
                 exclude_row_attrs,
                 exclude_columns,
                 column_attrs,
+                cache,
             )
             if shards is None and not column_attrs:
                 batch = {
-                    "key": (index, exclude_row_attrs, exclude_columns),
+                    "key": (index, exclude_row_attrs, exclude_columns, cache),
                     "index": index,
                     "query": body,
                     "kwargs": {
                         "exclude_row_attrs": exclude_row_attrs,
                         "exclude_columns": exclude_columns,
+                        "cache": cache,
                     },
                 }
 
@@ -270,6 +281,7 @@ class Handler:
                 exclude_columns=exclude_columns,
                 column_attrs=column_attrs,
                 profile=profile,
+                cache=cache,
             )
 
         t0 = time.monotonic()
@@ -603,6 +615,14 @@ class Handler:
         return RawResponse(
             text.encode(), "text/plain; version=0.0.4; charset=utf-8"
         )
+
+    def get_debug_plancache(self, req) -> dict:
+        """Plan result-cache snapshot: entries/bytes, hit ratio,
+        invalidations, evictions, epoch (plan/cache.py)."""
+        pc = getattr(self.api.executor, "plan_cache", None)
+        if pc is None:
+            return {"enabled": False}
+        return pc.stats()
 
     def get_debug_pipeline(self, req) -> dict:
         """Serving-pipeline snapshot: per-class queue depth/limit,

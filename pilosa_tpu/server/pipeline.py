@@ -22,10 +22,14 @@ Orca-style continuous batching); this module is that layer:
   cancelled at dequeue — before the parse, the executor, or any shard
   map runs — so an overloaded server spends its workers only on
   requests that can still be answered in time.
-* **Singleflight coalescing.** Identical concurrent read-only queries
-  (same index, text, and options) execute ONCE; duplicates attach to
-  the in-flight leader and share its result without consuming a queue
-  slot or a worker.
+* **Singleflight coalescing.** Equivalent concurrent read-only queries
+  (same index and options, same CANONICAL plan hash — plan/canon.py,
+  wired in by the HTTP handler's signature) execute ONCE; duplicates
+  attach to the in-flight leader and share its result without consuming
+  a queue slot or a worker. Keying on the canonical hash instead of raw
+  text means argument-order-permuted spellings of one query —
+  ``Intersect(Row(a), Row(b))`` vs ``Intersect(Row(b), Row(a))`` —
+  coalesce too.
 * **Cross-request batching.** When the queue backs up, a worker drains
   every queued entry with the same batch key (same index + options,
   read-only) in one gang and executes them as a single combined

@@ -147,6 +147,17 @@ class Server:
             health = DeviceHealth(
                 timeout_s=self.config.device_timeout, logger=self.logger
             )
+        # plan result cache (plan/cache.py): generation-stamped cross-
+        # request result cache; the executor consults it around call
+        # dispatch and the planner substitutes cached subtrees
+        self.plan_cache = None
+        if self.config.plan_cache_enabled:
+            from pilosa_tpu.plan.cache import PlanCache
+
+            self.plan_cache = PlanCache(
+                max_bytes=self.config.plan_cache_max_bytes,
+                min_cost=self.config.plan_cache_min_cost,
+            )
         self.executor = Executor(
             self.holder,
             cluster=cluster,
@@ -161,6 +172,7 @@ class Server:
                 if self.config.auto_device_min_containers > 0
                 else None
             ),
+            plan_cache=self.plan_cache,
         )
         self.api = API(self.holder, self.executor, cluster=cluster, server=self)
         # serving pipeline (server/pipeline.py): every query/import

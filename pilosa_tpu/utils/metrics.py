@@ -105,6 +105,12 @@ STAGER_DELTA_APPLY_SECONDS = "stager.delta_apply_seconds"
 # TopN rank/LRU caches
 CACHE_HITS = "cache.hits"
 CACHE_MISSES = "cache.misses"
+# query plan result cache (plan/cache.py)
+PLANCACHE_HITS = "plancache.hits"
+PLANCACHE_MISSES = "plancache.misses"
+PLANCACHE_INVALIDATIONS = "plancache.invalidations"
+PLANCACHE_EVICTIONS = "plancache.evictions"
+PLANCACHE_BYTES = "plancache.bytes"
 # distributed map-reduce
 CLUSTER_MAP_REMOTE_SECONDS = "cluster.map_remote_seconds"
 CLUSTER_REMOTE_ERRORS = "cluster.remote_errors"
@@ -202,6 +208,24 @@ METRICS: dict[str, tuple[str, str]] = {
     ),
     CACHE_HITS: ("counter", "TopN rank/LRU cache hits"),
     CACHE_MISSES: ("counter", "TopN rank/LRU cache misses"),
+    PLANCACHE_HITS: (
+        "counter",
+        "plan-cache lookups served from a generation-valid cached result",
+    ),
+    PLANCACHE_MISSES: (
+        "counter",
+        "plan-cache lookups that executed the call (no valid entry)",
+    ),
+    PLANCACHE_INVALIDATIONS: (
+        "counter",
+        "cached results dropped because a contributing fragment's "
+        "generation no longer matched the entry's stamp",
+    ),
+    PLANCACHE_EVICTIONS: (
+        "counter",
+        "cached results evicted LRU to stay under plan-cache-max-bytes",
+    ),
+    PLANCACHE_BYTES: ("gauge", "bytes resident in the plan result cache"),
     CLUSTER_MAP_REMOTE_SECONDS: (
         "summary",
         "distributed map-reduce remote leg latency (label: node)",
@@ -268,6 +292,7 @@ METRICS: dict[str, tuple[str, str]] = {
 
 STAGE_QUERY = "query"
 STAGE_PIPELINE_WAIT = "pipeline.wait"
+STAGE_PLAN_CANON = "plan.canon"
 STAGE_EXECUTOR = "executor"
 STAGE_CALL = "executor.call"
 STAGE_MAP_SHARD = "executor.map_shard"
@@ -283,6 +308,7 @@ STAGE_MAP_LOCAL = "cluster.map_local"
 STAGES: dict[str, str] = {
     STAGE_QUERY: "root span, one per query (API layer)",
     STAGE_PIPELINE_WAIT: "admission-queue wait before execution (backfilled)",
+    STAGE_PLAN_CANON: "plan canonicalization + CSE rewrite against the result cache",
     STAGE_EXECUTOR: "Executor.execute body",
     STAGE_CALL: "one PQL call dispatch (meta: call)",
     STAGE_MAP_SHARD: "per-shard map leg (meta: shard)",

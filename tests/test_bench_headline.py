@@ -69,6 +69,55 @@ def test_vs_baseline_uses_measured_cpu_closed_loop_denominator(bench):
     assert out["vs_baseline"] == round(112.4 / 0.4, 2)
 
 
+def test_window_quality_derives_rtt_and_depth(bench):
+    t = {
+        "topn_qps": 12.5,
+        "topn_qps_c64": 100.0,
+        "profile": {"device_rtt_ms": 20.0},
+    }
+    wq = bench.window_quality(t)
+    assert wq["sustained_rtt_ms"] == 20.0
+    # 100 qps x 20 ms RTT = 2 concurrent round-trips in flight
+    assert wq["pipelining_depth"] == 2.0
+    assert wq["headline_qps"] == 100.0
+    # no RTT profile measured -> no quality record
+    assert bench.window_quality({"topn_qps": 12.5}) is None
+    assert bench.window_quality({}) is None
+    assert bench.window_quality(
+        {"topn_qps": 1.0, "profile": {"error": "x"}}
+    ) is None
+
+
+def test_degraded_rtt_refuses_last_good_overwrite(bench):
+    good = {"sustained_rtt_ms": 20.0, "pipelining_depth": 2.0}
+    # mildly worse RTT: fine
+    ok = {"sustained_rtt_ms": 30.0, "pipelining_depth": 2.0}
+    assert bench.window_degraded(ok, good) == (False, None)
+    # RTT past the degradation factor: refused, with the reason
+    bad = {"sustained_rtt_ms": 20.0 * bench.DEGRADED_RTT_FACTOR + 1,
+           "pipelining_depth": 2.0}
+    degraded, why = bench.window_degraded(bad, good)
+    assert degraded and "RTT" in why
+
+
+def test_collapsed_pipelining_depth_refuses_overwrite(bench):
+    good = {"sustained_rtt_ms": 20.0, "pipelining_depth": 10.0}
+    bad = {"sustained_rtt_ms": 20.0,
+           "pipelining_depth": 10.0 * bench.DEGRADED_DEPTH_FACTOR - 0.5}
+    degraded, why = bench.window_degraded(bad, good)
+    assert degraded and "depth" in why
+
+
+def test_window_gating_bootstrap_and_unprovable_runs(bench):
+    wq = {"sustained_rtt_ms": 20.0, "pipelining_depth": 2.0}
+    # no prior quality record (pre-gating artifact): anything may seed
+    assert bench.window_degraded(wq, None) == (False, None)
+    assert bench.window_degraded(None, None) == (False, None)
+    # a run that measured no quality must not displace one that did
+    degraded, why = bench.window_degraded(None, wq)
+    assert degraded and "window_quality" in why
+
+
 def test_vs_baseline_seq_ratio_rides_alongside(bench):
     out = bench.vs_baseline_fields(
         "64 closed-loop clients", 132.9, 0.4, seq_qps=12.5

@@ -1,0 +1,139 @@
+"""Plan canonicalization (plan/canon.py): flatten/sort/normalize rules,
+hash stability and distinctness, and the pipeline signature helper."""
+
+import pytest
+
+from pilosa_tpu.pql import parse
+from pilosa_tpu.pql.ast import Call, Condition
+from pilosa_tpu.plan.canon import (
+    CACHED_CALL,
+    call_hash,
+    canonicalize,
+    query_hash,
+    query_signature,
+)
+
+
+def h(text: str) -> str:
+    (c,) = parse(text).calls
+    return call_hash(c)
+
+
+# -- equivalences -----------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "a,b",
+    [
+        # commutative operand order
+        ("Intersect(Row(f=1), Row(f=2))", "Intersect(Row(f=2), Row(f=1))"),
+        ("Union(Row(f=1), Row(f=2))", "Union(Row(f=2), Row(f=1))"),
+        ("Xor(Row(f=1), Row(f=2))", "Xor(Row(f=2), Row(f=1))"),
+        # associative nesting flattens (Union/Intersect)
+        ("Union(Row(f=1), Union(Row(f=2), Row(f=3)))",
+         "Union(Row(f=1), Row(f=2), Row(f=3))"),
+        ("Union(Union(Row(f=3), Row(f=1)), Row(f=2))",
+         "Union(Row(f=2), Row(f=3), Row(f=1))"),
+        ("Intersect(Intersect(Row(f=1), Row(f=2)), Row(f=3))",
+         "Intersect(Row(f=3), Intersect(Row(f=2), Row(f=1)))"),
+        # permutation deep inside a parent op
+        ("Count(Intersect(Row(a=1), Row(b=2)))",
+         "Count(Intersect(Row(b=2), Row(a=1)))"),
+        # option order
+        ("TopN(f, Row(f=1), n=5, threshold=2)",
+         "TopN(f, Row(f=1), threshold=2, n=5)"),
+        # whitespace / text-level differences
+        ("Count(Row(f=1))", "Count( Row( f = 1 ) )"),
+    ],
+)
+def test_equivalent_spellings_share_hash(a, b):
+    assert h(a) == h(b)
+
+
+@pytest.mark.parametrize(
+    "a,b",
+    [
+        # Difference is NOT commutative
+        ("Difference(Row(f=1), Row(f=2))", "Difference(Row(f=2), Row(f=1))"),
+        # Xor is commutative but NOT flattened into Union/Intersect
+        ("Union(Row(f=1), Xor(Row(f=2), Row(f=3)))",
+         "Union(Row(f=1), Row(f=2), Row(f=3))"),
+        # operand multiplicity matters (Xor(a,a) is empty, not a)
+        ("Xor(Row(f=1), Row(f=1))", "Row(f=1)"),
+        # literal types stay distinct
+        ("TopN(f, n=1)", 'TopN(f, n="1")'),
+        # different calls / fields / rows
+        ("Count(Row(f=1))", "Count(Row(f=2))"),
+        ("Count(Row(f=1))", "Count(Row(g=1))"),
+        ("Union(Row(f=1), Row(f=2))", "Intersect(Row(f=1), Row(f=2))"),
+    ],
+)
+def test_distinct_queries_get_distinct_hashes(a, b):
+    assert h(a) != h(b)
+
+
+def test_hash_is_stable_across_calls():
+    assert h("Count(Intersect(Row(a=1), Row(b=2)))") == h(
+        "Count(Intersect(Row(a=1), Row(b=2)))"
+    )
+
+
+def test_condition_args_hash():
+    a = h("Range(v > 10)")
+    assert a == h("Range(v > 10)")
+    assert a != h("Range(v > 11)")
+    assert a != h("Range(v >= 10)")
+
+
+# -- canonicalize (tree form) ----------------------------------------------
+
+
+def test_canonicalize_flattens_and_sorts_without_mutating_input():
+    (c,) = parse("Union(Row(f=3), Union(Row(f=1), Row(f=2)))").calls
+    before = str(c)
+    canon = canonicalize(c)
+    assert str(c) == before  # input untouched
+    assert canon.name == "Union"
+    assert [k.name for k in canon.children] == ["Row", "Row", "Row"]
+    rows = sorted(k.args["f"] for k in canon.children)
+    assert rows == [1, 2, 3]
+    # canonical form of a canonical tree is itself (idempotent)
+    assert call_hash(canon) == call_hash(c)
+
+
+def test_cached_placeholder_hashes_as_replaced_subtree():
+    (c,) = parse("Count(Intersect(Row(a=1), Row(b=2)))").calls
+    inner = c.children[0]
+    ih = call_hash(inner)
+    rewritten = Call(
+        "Count", dict(c.args), [Call(CACHED_CALL, args={"_h": ih})]
+    )
+    assert call_hash(rewritten) == call_hash(c)
+
+
+def test_write_and_unknown_calls_still_hash():
+    # canonicalization never refuses a tree — cacheability is the
+    # planner's decision, identity is canon's
+    assert h("Set(10, f=1)") != h("Set(10, f=2)")
+    c = Call("Weird", {"x": Condition(">", 3)}, [])
+    assert call_hash(c) == call_hash(c)
+
+
+# -- query-level signature --------------------------------------------------
+
+
+def test_query_hash_is_call_order_sensitive():
+    a = query_hash(parse("Count(Row(f=1)) Count(Row(f=2))"))
+    b = query_hash(parse("Count(Row(f=2)) Count(Row(f=1))"))
+    assert a != b  # results are positional
+
+
+def test_query_signature_coalesces_permutations_and_survives_garbage():
+    s1 = query_signature("Count(Intersect(Row(a=1), Row(b=2)))")
+    s2 = query_signature("Count(Intersect(Row(b=2), Row(a=1)))")
+    assert s1 is not None and s1 == s2
+    assert s1.startswith("pqh:")
+    assert query_signature("NotEvenPQL(((") is None
+    # memoized answers stay consistent
+    assert query_signature("Count(Intersect(Row(a=1), Row(b=2)))") == s1
+    assert query_signature("NotEvenPQL(((") is None

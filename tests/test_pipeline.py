@@ -292,6 +292,51 @@ def test_identical_concurrent_queries_coalesce(tmp_path):
         s.close()
 
 
+def test_permuted_argument_order_queries_coalesce(tmp_path):
+    """Regression (ISSUE 4 satellite 1): singleflight used to key on
+    raw PQL text, so Intersect(Row(a),Row(b)) vs Intersect(Row(b),
+    Row(a)) never coalesced. Keys are now the canonical plan hash —
+    permuted spellings of one query attach to one in-flight leader."""
+    s = make_server(tmp_path)
+    try:
+        seed(s, "perm")
+        orig = s.executor.execute
+
+        def slow(index, query, shards=None, opt=None):
+            time.sleep(0.25)
+            return orig(index, query, shards, opt)
+
+        s.executor.execute = slow
+        spellings = [
+            b"Count(Intersect(Row(f=1), Row(f=2)))",
+            b"Count(Intersect(Row(f=2), Row(f=1)))",
+            b"Count(Intersect( Row(f=2) , Row(f=1) ))",
+        ]
+        results = []
+        lock = threading.Lock()
+
+        def client(ci):
+            st, body, _ = req(
+                s, "POST", "/index/perm/query", spellings[ci % len(spellings)]
+            )
+            with lock:
+                results.append((st, body))
+
+        threads = [threading.Thread(target=client, args=(ci,)) for ci in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        s.executor.execute = orig
+        # Intersect of rows 1 (2 bits/shard) and 2 (3 bits/shard):
+        # identical correct result for every spelling
+        first = results[0][1]
+        assert all(st == 200 and body == first for st, body in results)
+        assert s.pipeline.stats()["coalesce_hits"] >= 1
+    finally:
+        s.close()
+
+
 # -- cross-request batching -------------------------------------------------
 
 
