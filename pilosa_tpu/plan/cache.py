@@ -6,8 +6,11 @@ re-pay full executor cost on every arrival. This cache closes that gap
 the way prefix/KV caches do for inference serving: results persist
 across requests, and validity is *proved* rather than guessed —
 
-* an entry is keyed by ``(canonical subtree hash, shard set, exec-option
-  bits)`` and stamped with the **fragment-generation vector** observed
+* an entry is keyed by ``(index, canonical subtree hash, shard set,
+  exec-option bits)`` — the index name matters: the cache is
+  process-wide and generation vectors carry no index identity, so
+  same-schema indexes would otherwise collide — and stamped with the
+  **fragment-generation vector** observed
   before its build: one ``(field, view, shard, generation)`` entry per
   fragment that could contribute to the result;
 * a lookup recomputes the current vector and serves the entry only on
@@ -205,15 +208,18 @@ class PlanCache:
                 self._maybe_insert(key, result, genvec, cost, epoch0)
                 return result
             finally:
+                # miss accounting lives here, under _mu, so concurrent
+                # leaders don't race the increment and a build that
+                # raises still counts as a miss (it did the work)
                 with self._mu:
+                    self.misses += 1
                     self._building.pop(key, None)
+                metrics.count(metrics.PLANCACHE_MISSES)
                 ev.set()
 
     # -- inserts / eviction --------------------------------------------------
 
     def _maybe_insert(self, key, result, genvec, cost: float, epoch0: int) -> None:
-        self.misses += 1
-        metrics.count(metrics.PLANCACHE_MISSES)
         if cost < self.min_cost:
             return
         enc = encode_result(result)

@@ -112,13 +112,16 @@ def generation_vector(holder, index: str, fields, shards) -> tuple:
                 vec.append((fname, None))
                 continue
             for vname in sorted(fld.views):
-                frags = fld.views[vname].fragments
+                view = fld.views.get(vname)
+                if view is None:
+                    continue  # deleted between the sort and the read
+                frags = view.fragments
                 for s in shards:
                     frag = frags.get(s)
                     if frag is not None:
                         vec.append((fname, vname, s, frag.generation))
         return tuple(vec)
-    except RuntimeError:
+    except (RuntimeError, KeyError):
         # a concurrent schema mutation raced the dict walk: answer with
         # a vector that can never match, so this lookup misses instead
         # of guessing
@@ -145,16 +148,20 @@ def call_cache_key(
         fld = executor.holder.field(index, next(iter(fields)))
         if fld is not None and fld.row_attr_store is not None:
             return None
-    key = (call_hash(c), tuple(shards), _opt_bits(opt, attrless=False))
+    key = (index, call_hash(c), tuple(shards), _opt_bits(opt, attrless=False))
     holder = executor.holder
     return key, lambda: generation_vector(holder, index, fields, shards)
 
 
-def subtree_cache_key(h: str, shards_t: tuple, opt) -> tuple:
+def subtree_cache_key(index: str, h: str, shards_t: tuple, opt) -> tuple:
     """Key for a SUBTREE row entry: always attr-less (nested bitmap
     nodes never attach attrs), so top-level bitmap calls that exclude
-    attrs and nested occurrences of the same subtree share one entry."""
-    return (h, shards_t, _opt_bits(opt, attrless=True))
+    attrs and nested occurrences of the same subtree share one entry.
+    The index name is part of the key (as in call_cache_key): the
+    PlanCache is process-wide and generation vectors carry no index
+    identity, so same-schema indexes with matching generation counts
+    would otherwise serve each other's results."""
+    return (index, h, shards_t, _opt_bits(opt, attrless=True))
 
 
 def rewrite_for_cse(executor, index: str, calls: list, shards, opt) -> list:
@@ -206,7 +213,7 @@ def rewrite_for_cse(executor, index: str, calls: list, shards, opt) -> list:
         row = resolved.get(h)
         if row is not None:
             return row
-        key = subtree_cache_key(h, shards_t, opt)
+        key = subtree_cache_key(index, h, shards_t, opt)
         gv = lambda: generation_vector(holder, index, fields, shards)
         if counts.get(h, 0) >= 2:
             # repeated within this query/gang: build once, share
@@ -242,7 +249,7 @@ def rewrite_for_cse(executor, index: str, calls: list, shards, opt) -> list:
     for c in calls:
         i = info(c)
         if i is not None and pc.contains(
-            (i[0], shards_t, _opt_bits(opt, attrless=False))
+            (index, i[0], shards_t, _opt_bits(opt, attrless=False))
         ):
             # the whole call is (probably) cached — the _execute_call
             # hook will serve it; descending here would waste probes

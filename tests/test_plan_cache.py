@@ -85,6 +85,37 @@ def test_permuted_and_nested_spellings_share_one_entry(holder):
     assert st["misses"] == 2 and st["hits"] == 2
 
 
+def test_same_schema_indexes_never_share_entries(holder):
+    """Regression: cache keys carry the index name. Two indexes with
+    identical field names and matching generation counts (same-schema
+    tenant indexes right after a restart — generations start at 0 per
+    process) must never serve each other's results. One bulk import
+    each keeps the generation vectors identical while the data differs."""
+    holder.create_index("tenant_a").create_field("f").import_bits([1], [10])
+    holder.create_index("tenant_b").create_field("f").import_bits([1, 1], [20, 21])
+    ex, pc = cached_executor(holder)
+    q = "Count(Row(f=1))"
+    assert ex.execute("tenant_a", q) == [1]
+    assert ex.execute("tenant_b", q) == [2]  # the bug served 1 here
+    # and both stay per-index on the hot path
+    assert ex.execute("tenant_a", q) == [1]
+    assert ex.execute("tenant_b", q) == [2]
+    st = pc.stats()
+    assert st["hits"] == 2 and st["misses"] == 2 and st["entries"] == 2
+
+
+def test_failed_build_counts_a_miss(holder):
+    pc = PlanCache()
+
+    def build():
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError):
+        pc.get_or_build(("k",), lambda: ("g",), build)
+    st = pc.stats()
+    assert st["misses"] == 1 and st["entries"] == 0 and st["building"] == 0
+
+
 def test_write_invalidates_and_result_reflects_new_state(holder):
     fld = seed(holder)
     ex, pc = cached_executor(holder)
