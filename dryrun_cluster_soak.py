@@ -1,0 +1,399 @@
+"""Two-process cluster LIVENESS soak (VERDICT r5 weak #5 / §9): the
+HTTP cluster plane — SWIM-style probing, DOWN verdicts, kill + rejoin
+convergence — with nodes in separate OS processes, the timing class the
+in-process loopback tests (tests/test_cluster.py) cannot stress.
+
+Phases, each recorded in CLUSTER_SOAK_r6.json:
+
+  1. **Soak**: two server processes in a static 2-node cluster, probe
+     interval 0.5 s, driven with a closed-loop query load for
+     ``--soak-seconds``; node 0's /status is polled throughout and any
+     non-READY verdict for a live peer is a spurious-DOWN failure.
+  2. **Kill → DOWN**: SIGKILL node 1 mid-load; node 0 must verdict it
+     DOWN within ``down_after × probe_interval`` plus relay margin.
+  3. **Rejoin → READY**: restart node 1 on the same port + data dir;
+     node 0 must clear DOWN (active probe evidence) and both nodes must
+     converge to state NORMAL with cross-shard queries answering again.
+
+    python dryrun_cluster_soak.py                 # full soak + artifact
+    python dryrun_cluster_soak.py --soak-seconds 5 --no-artifact
+
+Worker mode (spawned): PILOSA_SOAK_RANK set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+RANK_ENV = "PILOSA_SOAK_RANK"
+PORTS_ENV = "PILOSA_SOAK_PORTS"
+DATA_ENV = "PILOSA_SOAK_DATA"
+
+PROBE_INTERVAL = 0.5
+PROBE_TIMEOUT = 1.0
+DOWN_AFTER = 3
+
+
+def worker() -> None:
+    rank = int(os.environ[RANK_ENV])
+    ports = [int(p) for p in os.environ[PORTS_ENV].split(",")]
+
+    from pilosa_tpu.server.config import ClusterConfig, Config
+    from pilosa_tpu.server.server import Server
+
+    cfg = Config(
+        data_dir=os.path.join(os.environ[DATA_ENV], f"node{rank}"),
+        bind=f"127.0.0.1:{ports[rank]}",
+        device_policy="never",
+        metric="none",
+        anti_entropy_interval=0,
+        cluster=ClusterConfig(
+            disabled=False,
+            coordinator=(rank == 0),
+            replicas=1,
+            hosts=[f"127.0.0.1:{p}" for p in ports],
+            probe_interval=PROBE_INTERVAL,
+            probe_timeout=PROBE_TIMEOUT,
+            down_after=DOWN_AFTER,
+            status_interval=2.0,
+        ),
+    )
+    srv = Server(cfg)
+    srv.open()
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
+    print(json.dumps({"event": "ready", "rank": rank}), flush=True)
+    while not stop:
+        time.sleep(0.1)
+    srv.close()
+
+
+# -- parent -------------------------------------------------------------------
+
+
+def _free_ports(n: int) -> list[int]:
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _http(port: int, method: str, path: str, body: bytes = b"", timeout: float = 30):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path, body)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _status(port: int) -> dict:
+    status, body = _http(port, "GET", "/status", timeout=5)
+    assert status == 200, status
+    return json.loads(body)
+
+
+def _peer_state(port: int, peer_uri_port: int) -> str:
+    for n in _status(port)["nodes"]:
+        if n["uri"].endswith(f":{peer_uri_port}"):
+            return n["state"]
+    return "?"
+
+
+def _wait_ready(port: int, deadline_s: float = 90) -> None:
+    t_end = time.monotonic() + deadline_s
+    while time.monotonic() < t_end:
+        try:
+            if _http(port, "GET", "/status", timeout=2)[0] == 200:
+                return
+        except OSError:
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(f"node on {port} never came up")
+
+
+def _spawn(rank: int, env: dict, tmp: str, tag: str = ""):
+    """Worker with stdout/stderr spooled to FILES, never pipes: the
+    kill phase makes node 0 log one re-map line per failed remote leg,
+    and an undrained 64 KB pipe would block those logger writes — a
+    total serving wedge that looks like a liveness bug but is pure
+    harness backpressure."""
+    import subprocess
+
+    out = open(os.path.join(tmp, f"node{rank}{tag}.out"), "w+")
+    err = open(os.path.join(tmp, f"node{rank}{tag}.err"), "w+")
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env={**env, RANK_ENV: str(rank)},
+        stdout=out,
+        stderr=err,
+        text=True,
+    )
+    p._outf, p._errf = out, err  # type: ignore[attr-defined]
+    return p
+
+
+def _finish(p, timeout: float):
+    """(stdout, stderr, returncode) after exit; kills on timeout."""
+    import subprocess
+
+    try:
+        p.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        p.wait()
+    out_text = err_text = ""
+    for attr in ("_outf", "_errf"):
+        f = getattr(p, attr, None)
+        if f is None:
+            continue
+        f.flush()
+        f.seek(0)
+        if attr == "_outf":
+            out_text = f.read()
+        else:
+            err_text = f.read()
+        f.close()
+    return out_text, err_text, p.returncode
+
+
+def parent(soak_seconds: float, artifact: bool) -> int:
+    import subprocess
+    import tempfile
+
+    from pilosa_tpu import SHARD_WIDTH
+
+    summary: dict = {
+        "what": (
+            "2-process cluster liveness soak: SWIM probe plane under "
+            "closed-loop load across OS processes — no spurious DOWN for "
+            "a live peer, bounded DOWN verdict after SIGKILL, and "
+            "post-restart convergence back to READY/NORMAL (the timing "
+            "class in-process loopback tests cannot stress)"
+        ),
+        "probe_interval_s": PROBE_INTERVAL,
+        "probe_timeout_s": PROBE_TIMEOUT,
+        "down_after": DOWN_AFTER,
+        "soak_seconds": soak_seconds,
+    }
+    ok = True
+    with tempfile.TemporaryDirectory() as tmp:
+        ports = _free_ports(2)
+        env = {
+            k: v
+            for k, v in os.environ.items()
+            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+        }
+        env.update(
+            JAX_PLATFORMS="cpu",
+            **{PORTS_ENV: ",".join(map(str, ports)), DATA_ENV: tmp},
+        )
+        procs = {r: _spawn(r, env, tmp) for r in range(2)}
+        try:
+            for p in ports:
+                _wait_ready(p)
+            # schema + data spanning both nodes' shard ownership
+            _http(ports[0], "POST", "/index/s", b"")
+            _http(ports[0], "POST", "/index/s/field/f", b"")
+            sets = []
+            for shard in range(4):
+                base = shard * SHARD_WIDTH
+                sets += [f"Set({base + i}, f={i % 4})" for i in range(50)]
+            for i in range(0, len(sets), 100):
+                status, body = _http(
+                    ports[0],
+                    "POST",
+                    "/index/s/query",
+                    " ".join(sets[i : i + 100]).encode(),
+                )
+                assert status == 200, (status, body[:200])
+
+            # -- phase 1: soak under load, assert no spurious DOWN -----
+            stop_load = threading.Event()
+            load_counts = {"ok": 0, "err": 0}
+
+            def load():
+                qs = [b"Count(Row(f=1))", b"TopN(f, n=3)", b"Count(Row(f=2))"]
+                i = 0
+                while not stop_load.is_set():
+                    try:
+                        s, _ = _http(
+                            ports[i % 2], "POST", "/index/s/query", qs[i % 3]
+                        )
+                        load_counts["ok" if s == 200 else "err"] += 1
+                    except OSError:
+                        load_counts["err"] += 1
+                    i += 1
+
+            threads = [threading.Thread(target=load, daemon=True) for _ in range(4)]
+            for t in threads:
+                t.start()
+            # spurious verdict = DOWN for a live peer. SUSPECT is the
+            # SWIM design's self-healing intermediate (one slow probe
+            # under CPU contention) and is recorded informationally —
+            # only an unwarranted DOWN mis-routes query planning.
+            spurious = []
+            suspects = 0
+            t_end = time.monotonic() + soak_seconds
+            while time.monotonic() < t_end:
+                s01 = _peer_state(ports[0], ports[1])
+                s10 = _peer_state(ports[1], ports[0])
+                for name, s in (
+                    ("node0_sees_node1", s01),
+                    ("node1_sees_node0", s10),
+                ):
+                    if s == "DOWN":
+                        spurious.append((name, s))
+                    elif s != "READY":
+                        suspects += 1
+                time.sleep(PROBE_INTERVAL / 2)
+            soak_ok = not spurious
+            ok &= soak_ok
+            summary["soak"] = {
+                "ok": soak_ok,
+                "spurious_down_verdicts": spurious[:20],
+                "suspect_sightings": suspects,
+                "load_queries_ok": load_counts["ok"],
+                "load_queries_err": load_counts["err"],
+            }
+
+            # -- phase 2: SIGKILL node 1 mid-load → bounded DOWN -------
+            procs[1].kill()
+            _finish(procs[1], timeout=30)
+            t_kill = time.monotonic()
+            # generous bound: down_after failed probe rounds, each up to
+            # probe_timeout + indirect-relay round-trips, plus scheduling
+            bound_s = DOWN_AFTER * (PROBE_INTERVAL + PROBE_TIMEOUT * 3) + 5
+            verdict_s = None
+            while time.monotonic() - t_kill < bound_s:
+                if _peer_state(ports[0], ports[1]) == "DOWN":
+                    verdict_s = time.monotonic() - t_kill
+                    break
+                time.sleep(PROBE_INTERVAL / 2)
+            stop_load.set()
+            for t in threads:
+                t.join(timeout=5)
+            down_ok = verdict_s is not None
+            # informational: does node 0 still answer with its peer
+            # dead? (cross-shard legs may legitimately fail or block on
+            # the dead owner right after the verdict — liveness of the
+            # PROBE plane is what this dryrun gates on)
+            try:
+                s, _ = _http(
+                    ports[0], "POST", "/index/s/query", b"Count(Row(f=1))",
+                    timeout=60,
+                )
+                serves = s in (200, 500)
+            except OSError:
+                serves = False
+            ok &= down_ok
+            summary["kill"] = {
+                "ok": down_ok,
+                "down_verdict_seconds": round(verdict_s, 2) if verdict_s else None,
+                "bound_seconds": round(bound_s, 2),
+                "node0_serves_after_kill": serves,
+            }
+
+            # -- phase 3: restart node 1 → convergence back ------------
+            procs[1] = _spawn(1, env, tmp, tag="_restart")
+            _wait_ready(ports[1])
+            t_join = time.monotonic()
+            converged_s = None
+            while time.monotonic() - t_join < 60:
+                try:
+                    if (
+                        _peer_state(ports[0], ports[1]) == "READY"
+                        and _peer_state(ports[1], ports[0]) == "READY"
+                        and _status(ports[0])["state"] == "NORMAL"
+                        and _status(ports[1])["state"] == "NORMAL"
+                    ):
+                        converged_s = time.monotonic() - t_join
+                        break
+                except (OSError, AssertionError):
+                    pass
+                time.sleep(PROBE_INTERVAL / 2)
+            rejoin_ok = converged_s is not None
+            # cross-shard queries answer on both nodes post-rejoin —
+            # bounded retry: remote legs right after a restart can ride
+            # out one slow round (startup status sync, cold holder)
+            q_ok = True
+            first_200_s = None
+            last_attempts = {}
+            if rejoin_ok:
+                for p in ports:
+                    t0 = time.monotonic()
+                    good = False
+                    while time.monotonic() - t0 < 90:
+                        try:
+                            s, body = _http(
+                                p, "POST", "/index/s/query",
+                                b"Count(Row(f=1))", timeout=30,
+                            )
+                            last_attempts[p] = (s, body.decode(errors="replace")[:200])
+                            if s == 200:
+                                good = True
+                                break
+                        except OSError as e:
+                            last_attempts[p] = ("oserror", repr(e)[:200])
+                        time.sleep(0.5)
+                    if good and first_200_s is None:
+                        first_200_s = time.monotonic() - t0
+                    q_ok &= good
+            ok &= rejoin_ok and q_ok
+            summary["rejoin"] = {
+                "ok": rejoin_ok and q_ok,
+                "converged_seconds": round(converged_s, 2) if converged_s else None,
+                "queries_after_rejoin_ok": q_ok,
+                "first_query_200_seconds": round(first_200_s, 2)
+                if first_200_s is not None
+                else None,
+                "last_attempts": {str(k): v for k, v in last_attempts.items()},
+            }
+        finally:
+            for r, p in procs.items():
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+            for r, p in procs.items():
+                out, err, rc = _finish(p, timeout=30)
+                if not ok:
+                    print(f"-- node {r} rc={rc}\n{err[-2000:]}", file=sys.stderr)
+
+    summary["ok"] = bool(ok)
+    print(json.dumps(summary, indent=2))
+    if artifact:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "CLUSTER_SOAK_r6.json"
+        )
+        with open(path, "w") as f:
+            json.dump(summary, f, indent=2)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    if os.environ.get(RANK_ENV) is not None:
+        worker()
+    else:
+        import argparse
+
+        ap = argparse.ArgumentParser()
+        ap.add_argument("--soak-seconds", type=float, default=30.0)
+        ap.add_argument("--no-artifact", action="store_true")
+        a = ap.parse_args()
+        sys.exit(parent(a.soak_seconds, artifact=not a.no_artifact))

@@ -41,6 +41,30 @@ def main(argv=None) -> int:
         "--mesh-devices",
         help="SPMD mesh size over the shard axis: a count or 'all' (default off)",
     )
+    p.add_argument(
+        "--distributed",
+        action="store_true",
+        default=None,
+        help="multihost serving: jax.distributed bootstrap + gang-dispatched "
+        "SPMD over one global mesh (rank 0 serves HTTP; other ranks follow)",
+    )
+    p.add_argument(
+        "--coordinator-address",
+        help="jax.distributed coordinator host:port (same on every rank; "
+        "rank 0 hosts it)",
+    )
+    p.add_argument(
+        "--process-id",
+        type=int,
+        default=None,
+        help="this rank's process id, 0..N-1 (0 = serving leader)",
+    )
+    p.add_argument(
+        "--num-processes",
+        type=int,
+        default=None,
+        help="total process count in the multihost deployment",
+    )
     p.add_argument("--cluster-disabled", action="store_true", default=None)
     p.add_argument("--coordinator", action="store_true", default=None)
     p.add_argument("--coordinator-host")
@@ -172,6 +196,15 @@ def cmd_server(args) -> int:
         cfg.tls.certificate_key_path = args.tls_certificate_key
     if args.tls_skip_verify is not None:
         cfg.tls.skip_verify = args.tls_skip_verify
+    if args.distributed is not None:
+        cfg.distributed_enabled = args.distributed
+    if args.coordinator_address:
+        cfg.distributed_coordinator = args.coordinator_address
+        cfg.distributed_enabled = True
+    if args.process_id is not None:
+        cfg.distributed_process_id = args.process_id
+    if args.num_processes is not None:
+        cfg.distributed_num_processes = args.num_processes
 
     server = Server(cfg)
     server.open()
@@ -179,8 +212,15 @@ def cmd_server(args) -> int:
     signal.signal(signal.SIGINT, lambda *_: stop.append(1))
     signal.signal(signal.SIGTERM, lambda *_: stop.append(1))
     try:
-        while not stop:
-            time.sleep(0.2)
+        if server.multihost is not None and server.multihost.rank != 0:
+            # follower rank: the worker loop IS the serving loop —
+            # blocks until the leader's poison pill (clean shutdown)
+            # or leader loss (deadline-fenced abort)
+            reason = server.serve_follower()
+            print(f"multihost follower stopped: {reason}", file=sys.stderr)
+        else:
+            while not stop:
+                time.sleep(0.2)
     finally:
         server.close()
     return 0

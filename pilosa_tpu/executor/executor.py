@@ -110,6 +110,11 @@ class ExecOptions:
     # both lookup and insert — the `cache=false` query option, and the
     # profile=true path (a profiled query must show real execution)
     cache: bool = True
+    # run a multi-call query's calls serially instead of through the
+    # read pool. Gang-dispatched multihost execution requires it: every
+    # rank must issue collectives in the identical order, and a thread
+    # pool's interleaving is not deterministic across processes
+    serial: bool = False
 
 
 class _NotDeviceable(Exception):
@@ -315,6 +320,14 @@ class Executor:
         self.health = health
         if health is not None:
             health.on_restore = self._on_device_restore
+        # multihost gang runtime (parallel/multihost.py). When set (the
+        # server wires it on the leader rank of a jax.distributed
+        # deployment), non-remote queries entering execute() are routed
+        # through the gang: the descriptor broadcasts to every rank and
+        # all processes enter the identical execution in lockstep —
+        # required because this executor's mesh spans processes, so any
+        # SPMD kernel IS a multi-process collective program.
+        self.gang = None
         # generation-stamped query result cache (plan/cache.py). None =
         # disabled (the default for bare executors, so tests and benches
         # opt in explicitly); the server wires one per process. Only
@@ -387,6 +400,31 @@ class Executor:
         shards: Optional[list[int]] = None,
         opt: Optional[ExecOptions] = None,
     ) -> list[Any]:
+        gang = self.gang
+        if (
+            gang is not None
+            and not (opt is not None and opt.remote)
+            and gang.should_dispatch()
+        ):
+            # multihost leader: broadcast the descriptor so every rank
+            # enters this execution in lockstep (the mesh spans
+            # processes — executing here alone would deadlock the first
+            # collective). The gang thread re-enters execute() with the
+            # in-gang flag set and falls through to the normal path.
+            from pilosa_tpu.parallel import multihost
+
+            desc = multihost.query_descriptor(
+                index_name,
+                query if isinstance(query, str) else str(query),
+                shards,
+                opt or ExecOptions(),
+            )
+            dl = _deadline().current()
+            sp = trace.current()
+            if sp is None:
+                return gang.dispatch(desc, deadline=dl)
+            with sp.child(metrics.STAGE_GANG, plan=desc.payload.get("plan")):
+                return gang.dispatch(desc, deadline=dl)
         sp = trace.current()
         if sp is None:  # untraced: no span objects anywhere below
             return self._execute(index_name, query, shards, opt)
@@ -443,7 +481,7 @@ class Executor:
                 calls = planner.rewrite_for_cse(
                     self, index_name, query.calls, shards, opt
                 )
-        if len(calls) > 1 and query.write_call_n() == 0:
+        if len(calls) > 1 and query.write_call_n() == 0 and not opt.serial:
             # An all-read request has no cross-call ordering constraints
             # (the reference runs calls serially, executor.go:126-145,
             # but read results are order-independent); running them
@@ -945,8 +983,45 @@ class Executor:
                     total += frag.sparse_block_count(
                         list(range(bsig.bit_depth() + 1))
                     )
+        elif c.name == "Range":
+            # time-range form: the row is read once per quantum view in
+            # the span, so the cost estimate sums containers across
+            # views. Without this branch the estimate was 0 and the
+            # auto policy NEVER routed time ranges to the (existing)
+            # shard-stacked device lowering — the CPU roaring union was
+            # the only path that ever ran (VERDICT §6).
+            total += self._time_range_containers(index, c, shard)
         for child in c.children:
             total += self._touched_containers(index, child, shard)
+        return total
+
+    def _time_range_containers(self, index, c: Call, shard: int) -> int:
+        """Touched-container estimate for a time-range Range() — the
+        queried row's container count summed over every quantum view in
+        [start, end]. Malformed args estimate 0 (the execution path
+        raises the real error)."""
+        try:
+            field_name = c.field_arg()
+            row_id, ok = c.uint_arg(field_name)
+            start_str, ok1 = c.string_arg("_start")
+            end_str, ok2 = c.string_arg("_end")
+            if not (ok and ok1 and ok2):
+                return 0
+            f = self.holder.field(index, field_name)
+            if f is None:
+                return 0
+            q = f.time_quantum()
+            if not q:
+                return 0
+            start = datetime.strptime(start_str, TIME_FORMAT)
+            end = datetime.strptime(end_str, TIME_FORMAT)
+        except ValueError:
+            return 0
+        total = 0
+        for view in views_by_time_range(VIEW_STANDARD, start, end, q):
+            frag = self.holder.fragment(index, field_name, view, shard)
+            if frag is not None:
+                total += frag.sparse_block_count([row_id])
         return total
 
     def _cached_words(self, c: Call, shard: int):

@@ -276,13 +276,9 @@ class DeviceStager:
         executor's shard plan, so that only happens off the SPMD path)."""
         w32 = np.ascontiguousarray(words64).view("<u4")
         if self.mesh is not None and w32.shape[0] % self.mesh.devices.size == 0:
-            from jax.sharding import NamedSharding, PartitionSpec
+            from pilosa_tpu.parallel.spmd import put_sharded
 
-            from pilosa_tpu.parallel.spmd import SHARD_AXIS
-
-            return jax.device_put(
-                w32, NamedSharding(self.mesh, PartitionSpec(SHARD_AXIS))
-            )
+            return put_sharded(self.mesh, w32)
         return jax.device_put(w32, self.device)
 
     # -- delta helpers -------------------------------------------------------
@@ -308,6 +304,16 @@ class DeviceStager:
         when the batch is too large to beat a re-stage."""
         if word_idx.size == 0:
             return dev, gen, 0
+        sh = getattr(dev, "sharding", None)
+        if sh is not None and any(
+            d.process_index != jax.process_index() for d in sh.device_set
+        ):
+            # multi-process (jax.distributed) sharded stacks full-rebuild
+            # on a generation mismatch: the post-scatter re-pin would be
+            # a cross-host reshard, and the rebuild path already places
+            # globally via make_array_from_callback
+            self._fallback("multihost")
+            return None
         idx, om, am = ops.coalesce_bit_updates(word_idx, bit_idx, is_set)
         if idx.size > int(self.delta_max_ratio * n_slots_words):
             self._fallback("ratio")
@@ -716,15 +722,12 @@ class DeviceStager:
                 bslot[i, : bs.size] = bs
             w32 = np.ascontiguousarray(blocks).view("<u4").reshape(S, bmax, 2048)
             if self.mesh is not None and S % self.mesh.devices.size == 0:
-                from jax.sharding import NamedSharding, PartitionSpec
+                from pilosa_tpu.parallel.spmd import put_sharded
 
-                from pilosa_tpu.parallel.spmd import SHARD_AXIS
-
-                sharding = NamedSharding(self.mesh, PartitionSpec(SHARD_AXIS))
                 dev = (
-                    jax.device_put(w32, sharding),
-                    jax.device_put(brow, sharding),
-                    jax.device_put(bslot, sharding),
+                    put_sharded(self.mesh, w32),
+                    put_sharded(self.mesh, brow),
+                    put_sharded(self.mesh, bslot),
                 )
             else:
                 dev = (

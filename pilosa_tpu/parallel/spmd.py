@@ -53,10 +53,28 @@ def shard_spec() -> P:
     return P(SHARD_AXIS)
 
 
+def mesh_is_multiprocess(mesh: Mesh) -> bool:
+    """True when the mesh places shards on devices owned by another
+    process (a jax.distributed global mesh)."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
 def put_sharded(mesh: Mesh, arr: np.ndarray):
     """Place a [S, ...] host array with the leading dim split over the
-    mesh — the HBM staging step for a shard batch."""
-    return jax.device_put(arr, NamedSharding(mesh, P(SHARD_AXIS)))
+    mesh — the HBM staging step for a shard batch.
+
+    On a multi-process (jax.distributed) mesh, ``device_put`` cannot
+    target non-addressable devices; every process holds the identical
+    full host array (the gang replays the same staging on every rank),
+    so each process contributes its addressable slices via
+    ``make_array_from_callback`` and the result is one global array."""
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    if mesh_is_multiprocess(mesh):
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+    return jax.device_put(arr, sharding)
 
 
 # -- SPMD kernels ------------------------------------------------------------

@@ -246,6 +246,27 @@ class API:
 
     # -- imports (reference api.Import:652-696) --
 
+    def _gang_import(self, op: str, payload: dict) -> bool:
+        """Multihost leader: broadcast an import descriptor so every
+        rank's holder replays the identical mutation; True when the
+        gang handled it (the leader thread and every follower re-enter
+        this method with the gang flag set and fall through to the
+        local body). timestamps may be datetimes on internal callers —
+        gang payloads are JSON, so those callers (cluster legs) never
+        run in multihost mode."""
+        mh = getattr(self.server, "multihost", None) if self.server else None
+        if mh is None or not mh.should_dispatch():
+            return False
+        from pilosa_tpu.parallel.multihost import (
+            Descriptor,
+            KIND_IMPORT,
+            KIND_IMPORT_VALUES,
+        )
+
+        kind = KIND_IMPORT if op == "import" else KIND_IMPORT_VALUES
+        mh.dispatch(Descriptor(kind, payload), deadline=deadline.current())
+        return True
+
     def import_bits(
         self,
         index: str,
@@ -257,6 +278,19 @@ class API:
         column_keys: Optional[list[str]] = None,
     ) -> None:
         self._validate("import")
+        if self._gang_import(
+            "import",
+            {
+                "index": index,
+                "field": field,
+                "row_ids": list(row_ids),
+                "column_ids": list(column_ids),
+                "timestamps": list(timestamps) if timestamps else None,
+                "row_keys": list(row_keys) if row_keys else None,
+                "column_keys": list(column_keys) if column_keys else None,
+            },
+        ):
+            return
         idx = self.holder.index(index)
         if idx is None:
             raise NotFoundError(f"index not found: {index}")
@@ -318,6 +352,17 @@ class API:
         column_keys: Optional[list[str]] = None,
     ) -> None:
         self._validate("import_value")
+        if self._gang_import(
+            "import_values",
+            {
+                "index": index,
+                "field": field,
+                "column_ids": list(column_ids),
+                "values": list(values),
+                "column_keys": list(column_keys) if column_keys else None,
+            },
+        ):
+            return
         f = self.holder.field(index, field)
         if f is None:
             raise NotFoundError(f"field not found: {field}")

@@ -86,6 +86,35 @@ class Config:
     # executor lowers multi-shard Count/Sum/TopN through ICI
     # collectives (parallel/spmd.py); "all" = every visible device
     mesh_devices: int | str = 0
+    # multihost serving (parallel/multihost.py): jax.distributed
+    # bootstrap + gang-dispatched SPMD execution over ONE global mesh
+    # spanning processes. Rank 0 serves HTTP; follower ranks run the
+    # gang worker loop and replay every state-bearing operation.
+    distributed_enabled: bool = False
+    # jax.distributed coordinator "host:port"; every rank must name the
+    # same address (rank 0 hosts the coordination service)
+    distributed_coordinator: str = ""
+    # this rank's process id (0 = leader) and the total process count;
+    # -1/0 fall back to the PILOSA_TPU_MH_* env the launcher sets
+    distributed_process_id: int = -1
+    distributed_num_processes: int = 0
+    # select the gloo CPU collective implementation (required for
+    # cross-process collectives on the CPU backend; irrelevant — and
+    # skipped if the knob doesn't exist — on real multi-host TPU)
+    distributed_gloo: bool = True
+    # gang control-channel frame size in bytes (one broadcast per frame;
+    # large imports span multiple frames)
+    distributed_frame_bytes: int = 65536
+    # leader idle-tick interval (seconds): keeps follower loops fed and
+    # measures broadcast latency while the gang is idle; 0 disables
+    distributed_idle_interval: float = 2.0
+    # gang-death verdict: a dispatch (or idle tick) not completing
+    # within this many seconds degrades the runtime to the local mesh
+    # and fails the request 503
+    distributed_dispatch_timeout: float = 30.0
+    # follower-side bound on leader silence before the worker loop
+    # aborts cleanly instead of waiting forever
+    distributed_leader_timeout: float = 120.0
     # cluster
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     # TLS on the listener + internal client (reference server.go:166-240)
@@ -210,6 +239,10 @@ class Config:
             f"mesh-devices = {self.mesh_devices!r}"
             if isinstance(self.mesh_devices, str)
             else f"mesh-devices = {self.mesh_devices}",
+            f"distributed-enabled = {'true' if self.distributed_enabled else 'false'}",
+            f'distributed-coordinator = "{self.distributed_coordinator}"',
+            f"distributed-num-processes = {self.distributed_num_processes}",
+            f"distributed-dispatch-timeout = {self.distributed_dispatch_timeout}",
             f'metric = "{self.metric}"',
             f"trace-sample-rate = {self.trace_sample_rate}",
             f"slow-query-time = {self.slow_query_time}",

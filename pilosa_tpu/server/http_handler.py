@@ -27,6 +27,7 @@ from pilosa_tpu.server.pipeline import (
     CLASS_INTERNAL,
     Overloaded,
 )
+from pilosa_tpu.parallel.multihost import GangUnavailable
 from pilosa_tpu.utils.errors import NotFoundError as ExecNotFound
 from pilosa_tpu.utils import metrics, privateproto, publicproto, trace
 from pilosa_tpu.utils.stats import NOP_STATS
@@ -185,6 +186,7 @@ class Handler:
             ),
             Route("GET", r"/metrics", self.get_metrics),
             Route("GET", r"/debug/pipeline", self.get_debug_pipeline),
+            Route("GET", r"/debug/multihost", self.get_debug_multihost),
             Route("GET", r"/debug/plancache", self.get_debug_plancache),
             Route("GET", r"/debug/vars", self.get_debug_vars),
             Route("GET", r"/debug/traces", self.get_debug_traces),
@@ -624,6 +626,16 @@ class Handler:
             return {"enabled": False}
         return pc.stats()
 
+    def get_debug_multihost(self, req) -> dict:
+        """Multihost gang snapshot: rank/world, degraded flag, queue
+        depth, follower loop counters (parallel/multihost.py)."""
+        mh = getattr(getattr(self.api, "server", None), "multihost", None)
+        if mh is None:
+            return {"enabled": False}
+        out = mh.stats()
+        out["enabled"] = True
+        return out
+
     def get_debug_pipeline(self, req) -> dict:
         """Serving-pipeline snapshot: per-class queue depth/limit,
         busy workers, admissions, sheds, coalesce/batch counters."""
@@ -743,6 +755,15 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
                     extra_headers.append(
                         ("Retry-After", str(max(1, round(e.retry_after))))
                     )
+                self.send_response(e.status)
+            except GangUnavailable as e:
+                # multihost gang dead (follower loss): bounded clean
+                # failure — the runtime already degraded to the local
+                # mesh, so a retry executes locally
+                payload, ctype = self._error_payload(str(e))
+                extra_headers.append(
+                    ("Retry-After", str(max(1, round(e.retry_after))))
+                )
                 self.send_response(e.status)
             except DeadlineExceeded as e:
                 # the request's deadline passed; work was cancelled at a

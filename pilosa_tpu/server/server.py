@@ -123,6 +123,26 @@ class Server:
         )
         self.translate_store = TranslateStore(os.path.join(data_dir, ".keys"))
         self.cluster = cluster
+        # multihost serving (parallel/multihost.py): bootstrap the
+        # jax.distributed runtime BEFORE the mesh is built, so
+        # jax.devices() below is the GLOBAL device set spanning every
+        # process. Rank 0 is the serving leader; followers replay.
+        self.multihost = None
+        self._mh_rank, self._mh_world = 0, 1
+        if self.config.distributed_enabled:
+            from pilosa_tpu.parallel import multihost as multihost_mod
+
+            self._mh_rank, self._mh_world = multihost_mod.initialize_distributed(
+                self.config.distributed_coordinator,
+                self.config.distributed_num_processes,
+                self.config.distributed_process_id,
+                use_gloo=self.config.distributed_gloo,
+            )
+            self.logger.printf(
+                "multihost: rank %d/%d initialized",
+                self._mh_rank,
+                self._mh_world,
+            )
         self.mesh = self._build_mesh()
         self.stager = DeviceStager(
             budget_bytes=self.config.stager_budget_bytes,
@@ -141,7 +161,15 @@ class Server:
         # roaring path instead of hanging them, and a background probe
         # restores the device path when it answers again
         health = None
-        if self.config.device_policy != "never" and self.config.device_timeout > 0:
+        if self.config.distributed_enabled:
+            # gang determinism: the health guard runs calls through a
+            # worker pool with per-call timeouts — a rank-0-only trip
+            # or pool-timeout would change which collectives execute
+            # and deadlock the mesh. The gang's own deadline fencing
+            # (dispatch timeout → degrade-to-local-mesh) is the
+            # recovery story in distributed mode.
+            pass
+        elif self.config.device_policy != "never" and self.config.device_timeout > 0:
             from pilosa_tpu.executor.devicehealth import DeviceHealth
 
             health = DeviceHealth(
@@ -175,6 +203,27 @@ class Server:
             plan_cache=self.plan_cache,
         )
         self.api = API(self.holder, self.executor, cluster=cluster, server=self)
+        if self.config.distributed_enabled:
+            from pilosa_tpu.parallel.multihost import (
+                MultiHostRuntime,
+                make_apply_fn,
+            )
+
+            self.multihost = MultiHostRuntime(
+                rank=self._mh_rank,
+                world=self._mh_world,
+                apply_fn=make_apply_fn(self),
+                frame_bytes=self.config.distributed_frame_bytes,
+                idle_interval=self.config.distributed_idle_interval,
+                dispatch_timeout=self.config.distributed_dispatch_timeout,
+                leader_timeout=self.config.distributed_leader_timeout,
+                on_degrade=self._degrade_to_local_mesh,
+                logger=self.logger,
+            )
+            # the executor routes every non-remote query through the
+            # gang on the leader; followers re-enter execute() from the
+            # worker loop with the in-gang flag set
+            self.executor.gang = self.multihost
         # serving pipeline (server/pipeline.py): every query/import
         # request flows through bounded per-class admission queues with
         # deadline scheduling, singleflight coalescing, and
@@ -229,6 +278,22 @@ class Server:
         axis (None = single-device execution). Accepts an int count or
         "all"; more devices requested than visible is an error — a
         silent clamp would hide a misconfigured slice."""
+        if self.config.distributed_enabled:
+            # distributed serving: one GLOBAL mesh over every process's
+            # devices — the whole point; mesh_devices is ignored (a
+            # partial global mesh would strand follower devices)
+            import jax
+
+            from pilosa_tpu.parallel.spmd import make_mesh
+
+            devices = jax.devices()
+            mesh = make_mesh(devices)
+            self.logger.printf(
+                "multihost SPMD mesh: %d global devices over %d processes",
+                len(devices),
+                self._mh_world,
+            )
+            return mesh
         want = self.config.mesh_devices
         if isinstance(want, str):
             want = want.strip().lower()
@@ -254,6 +319,61 @@ class Server:
         mesh = make_mesh(devices[:want])
         self.logger.printf("SPMD mesh: %d devices over shard axis", want)
         return mesh
+
+    def _degrade_to_local_mesh(self) -> None:
+        """Multihost failure path: the gang is dead (follower loss),
+        so the global mesh can never complete another collective. Hand
+        the executor a mesh over THIS process's own devices (or none,
+        single-device) and fresh staging — serving continues locally,
+        reads stay correct (every rank holds the full replayed state),
+        capacity shrinks to one host.
+
+        On the CPU backend the local mesh is skipped entirely: CPU
+        cross-device collectives ride the same gloo context the dead
+        gang poisoned (observed: post-degrade local psum fails with
+        'Gloo all-reduce failed: Connection reset by peer'), so the
+        degraded executor runs the collective-free single-device
+        batched path. Real TPU deployments keep a local ICI mesh."""
+        import jax
+
+        from pilosa_tpu.parallel.spmd import make_mesh
+
+        local = jax.local_devices()
+        mesh = (
+            make_mesh(local)
+            if len(local) > 1 and jax.default_backend() != "cpu"
+            else None
+        )
+        stager = DeviceStager(
+            budget_bytes=self.config.stager_budget_bytes,
+            mesh=mesh,
+            delta_enabled=self.config.stager_delta_enabled,
+            delta_max_ratio=self.config.stager_delta_max_ratio,
+        )
+        ex = self.executor
+        ex.gang = None
+        with ex._spmd_mu:
+            ex._spmd_kernels = {}
+        ex.mesh = mesh
+        ex.stager = stager
+        # scorer queues may hold work aimed at dead global arrays, and
+        # results computed on the dead gang epoch must not be served
+        # (resets the new stager too — a no-op on a fresh instance)
+        ex._on_device_restore()
+        self.stager = stager
+        self.mesh = mesh
+        self.logger.printf(
+            "multihost degraded: serving on local mesh (%d devices)",
+            len(local),
+        )
+
+    def serve_follower(self) -> str:
+        """Run the multihost follower worker loop on the calling thread
+        until the leader's poison pill (clean shutdown) or leader loss
+        (deadline-fenced abort). Returns the stop reason."""
+        if self.multihost is None:
+            raise RuntimeError("serve_follower requires distributed-enabled")
+        return self.multihost.serve_follower()
 
     # -- lifecycle (reference Server.Open:312) --
 
@@ -300,7 +420,16 @@ class Server:
             "pilosa_tpu server listening on %s://%s:%d", self.scheme, *self.address()
         )
         if self.cluster is None and not self.config.cluster.disabled:
-            self.cluster = self._build_cluster()
+            if self.config.distributed_enabled:
+                # one distribution plane at a time: the gang replays all
+                # state to every rank, so layering the HTTP cluster's
+                # shard placement on top would double-route work
+                self.logger.printf(
+                    "cluster config ignored: distributed-enabled runs the "
+                    "multihost gang plane instead"
+                )
+            else:
+                self.cluster = self._build_cluster()
         if self.cluster is not None:
             self.executor.cluster = self.cluster
             self.api.cluster = self.cluster
@@ -315,6 +444,12 @@ class Server:
             self.config.device_policy == "auto"
             and self.config.auto_device_min_containers <= 0
             and not os.environ.get("PILOSA_AUTO_DEVICE_MIN_CONTAINERS")
+            # gang determinism: a per-rank MEASURED crossover would make
+            # ranks disagree on device-vs-CPU routing — one rank enters
+            # a collective the other skips, and the mesh deadlocks. In
+            # distributed mode the crossover must be config-pinned
+            # (auto-device-min-containers) or the shared default.
+            and self.multihost is None
         ):
             from pilosa_tpu.executor.autotune import autotune_executor
 
@@ -680,6 +815,10 @@ class Server:
                     "pipeline drain timed out after %.1fs; remaining work failed 503",
                     self.config.pipeline_drain_timeout,
                 )
+        # after the pipeline drained (no new gang work), poison the
+        # follower loops so every rank exits cleanly
+        if self.multihost is not None:
+            self.multihost.close()
         if self.gc_notifier is not None:
             self.gc_notifier.close()
         self.stats.close()
@@ -699,11 +838,24 @@ class Server:
         view.go:216-247 CreateShardMessage)."""
         self.send_async({"type": "create-shard", "index": index, "shard": shard})
 
+    def _gang_message(self, msg: dict) -> None:
+        """Replicate a broadcast message to the multihost gang: schema
+        ops and shard announcements must reach follower holders the
+        same way cluster peers get them. No-op inside a gang replay
+        (followers apply the op themselves) and after degrade."""
+        mh = self.multihost
+        if mh is not None and mh.should_dispatch():
+            from pilosa_tpu.parallel.multihost import Descriptor, KIND_MESSAGE
+
+            mh.dispatch(Descriptor(KIND_MESSAGE, msg))
+
     def send_sync(self, msg: dict) -> None:
+        self._gang_message(msg)
         if self.cluster is not None:
             self.cluster.send_sync(msg)
 
     def send_async(self, msg: dict) -> None:
+        self._gang_message(msg)
         if self.cluster is not None:
             self.cluster.send_async(msg)
 

@@ -114,6 +114,14 @@ PLANCACHE_BYTES = "plancache.bytes"
 # distributed map-reduce
 CLUSTER_MAP_REMOTE_SECONDS = "cluster.map_remote_seconds"
 CLUSTER_REMOTE_ERRORS = "cluster.remote_errors"
+# multihost gang dispatch (parallel/multihost.py)
+MULTIHOST_DISPATCHES = "multihost.dispatches"
+MULTIHOST_BROADCAST_SECONDS = "multihost.broadcast_seconds"
+MULTIHOST_TICKS = "multihost.ticks"
+MULTIHOST_ABORTS = "multihost.aborts"
+MULTIHOST_DEGRADED = "multihost.degraded"
+MULTIHOST_FOLLOWER_LAG_SECONDS = "multihost.follower_lag_seconds"
+MULTIHOST_FOLLOWER_ERRORS = "multihost.follower_errors"
 # serving pipeline (server/pipeline.py)
 PIPELINE_ADMITTED = "pipeline.admitted"
 PIPELINE_SHEDS = "pipeline.sheds"
@@ -200,7 +208,7 @@ METRICS: dict[str, tuple[str, str]] = {
     STAGER_DELTA_FALLBACK: (
         "counter",
         "generation-mismatched blocks that fell back to a full re-stage "
-        "(label: reason = log | ratio | shape | sparse_form)",
+        "(label: reason = log | ratio | shape | sparse_form | multihost)",
     ),
     STAGER_DELTA_APPLY_SECONDS: (
         "summary",
@@ -233,6 +241,37 @@ METRICS: dict[str, tuple[str, str]] = {
     CLUSTER_REMOTE_ERRORS: (
         "counter",
         "remote map-reduce legs that failed and re-mapped onto replicas (label: node)",
+    ),
+    MULTIHOST_DISPATCHES: (
+        "counter",
+        "gang work descriptors dispatched (leader) / applied (follower) "
+        "(label: role)",
+    ),
+    MULTIHOST_BROADCAST_SECONDS: (
+        "summary",
+        "leader-side latency of one descriptor broadcast over the "
+        "collective plane",
+    ),
+    MULTIHOST_TICKS: (
+        "counter",
+        "idle heartbeat broadcasts that completed (leader)",
+    ),
+    MULTIHOST_ABORTS: (
+        "counter",
+        "gang aborts: leader degrade-to-local-mesh events and follower "
+        "loop exits on leader loss (label: role)",
+    ),
+    MULTIHOST_DEGRADED: (
+        "gauge",
+        "1 after the gang degraded to the local mesh, else 0",
+    ),
+    MULTIHOST_FOLLOWER_LAG_SECONDS: (
+        "summary",
+        "follower clock lag behind the leader's idle-tick timestamps",
+    ),
+    MULTIHOST_FOLLOWER_ERRORS: (
+        "counter",
+        "descriptors whose follower-side replay raised (divergence signal)",
     ),
     PIPELINE_ADMITTED: (
         "counter",
@@ -304,6 +343,7 @@ STAGE_STAGE = "stager.stage"
 STAGE_DELTA = "stager.delta_apply"
 STAGE_MAP_REMOTE = "cluster.map_remote"
 STAGE_MAP_LOCAL = "cluster.map_local"
+STAGE_GANG = "multihost.gang"
 
 STAGES: dict[str, str] = {
     STAGE_QUERY: "root span, one per query (API layer)",
@@ -320,6 +360,7 @@ STAGES: dict[str, str] = {
     STAGE_DELTA: "delta scatter-apply onto a resident block (meta: nupdates)",
     STAGE_MAP_REMOTE: "distributed map-reduce remote leg (meta: node)",
     STAGE_MAP_LOCAL: "distributed map-reduce local leg",
+    STAGE_GANG: "gang-dispatched multihost execution (meta: plan, kind)",
 }
 
 

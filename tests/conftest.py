@@ -11,3 +11,10 @@ CPU devices; real-TPU benchmarking happens in bench.py, not here.
 from pilosa_tpu.utils.jaxplatform import force_cpu_mesh
 
 force_cpu_mesh(8)
+
+
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; the soak rides outside it
+    config.addinivalue_line(
+        "markers", "slow: long multi-process soaks excluded from tier-1"
+    )

@@ -1,0 +1,310 @@
+"""Gang-dispatch protocol tests (parallel/multihost.py): descriptor and
+frame round-trips, follower deadline abort, idle-tick liveness, leader
+dispatch fencing — all in-process against the LoopbackChannel — plus a
+2-process jax.distributed serving smoke (the dryrun driver in quick
+mode), skipped when jax.distributed is unavailable."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from pilosa_tpu.parallel import multihost
+from pilosa_tpu.parallel.multihost import (
+    ChannelClosed,
+    Descriptor,
+    GangFollower,
+    GangUnavailable,
+    KIND_IMPORT,
+    KIND_POISON,
+    KIND_QUERY,
+    KIND_TICK,
+    LoopbackChannel,
+    MultiHostRuntime,
+    decode_frame,
+    decode_message,
+    encode_message,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- framing ------------------------------------------------------------------
+
+
+def test_frame_round_trip_single():
+    frames = encode_message(KIND_QUERY, b"Count(Row(f=1))", 4096)
+    assert len(frames) == 1
+    assert len(frames[0]) == 4096  # fixed-size: one compiled hop program
+    kind, payload = decode_message(frames)
+    assert kind == KIND_QUERY
+    assert payload == b"Count(Row(f=1))"
+
+
+def test_frame_round_trip_multi_frame():
+    blob = bytes(range(256)) * 200  # 51200 bytes across several frames
+    frames = encode_message(KIND_IMPORT, blob, 4096)
+    assert len(frames) > 1
+    assert all(len(f) == 4096 for f in frames)
+    kind, payload = decode_message(frames)
+    assert kind == KIND_IMPORT and payload == blob
+
+
+def test_frame_round_trip_empty_payload():
+    frames = encode_message(KIND_POISON, b"", 1024)
+    assert decode_message(frames) == (KIND_POISON, b"")
+
+
+def test_frame_bad_magic_rejected():
+    frame = b"\x00" * 4096
+    with pytest.raises(multihost.FrameError):
+        decode_frame(frame)
+
+
+def test_frame_inconsistent_sequence_rejected():
+    a = encode_message(KIND_QUERY, b"x" * 9000, 4096)
+    b = encode_message(KIND_TICK, b"y", 4096)
+    with pytest.raises(multihost.FrameError):
+        decode_message([a[0], b[0]])
+
+
+def test_descriptor_round_trip():
+    desc = multihost.query_descriptor(
+        "idx",
+        'Count(Intersect(Row(f=1), Row(g="a b")))',
+        [0, 3, 5],
+        type("O", (), {"exclude_row_attrs": True, "exclude_columns": False})(),
+    )
+    kind, raw = desc.kind, desc.encode()
+    back = Descriptor.decode(kind, raw)
+    assert back.payload == desc.payload
+    assert back.payload["index"] == "idx"
+    assert back.payload["shards"] == [0, 3, 5]
+    assert back.payload["opt"]["exclude_row_attrs"] is True
+    # canonical plan identity rides along (plan/canon.py)
+    assert back.payload["plan"] and back.payload["plan"].startswith("pqh:")
+
+
+# -- follower loop ------------------------------------------------------------
+
+
+def _send(ch, kind, payload: bytes = b""):
+    ch.send(encode_message(kind, payload, ch.frame_bytes))
+
+
+def test_follower_applies_work_and_exits_on_poison():
+    ch = LoopbackChannel(2048)
+    applied = []
+    f = GangFollower(ch, lambda k, p: applied.append((k, p)), leader_timeout=5.0)
+    _send(ch, KIND_QUERY, json.dumps({"q": 1}).encode())
+    _send(ch, KIND_QUERY, json.dumps({"q": 2}).encode())
+    _send(ch, KIND_POISON)
+    assert f.run() == "poison"
+    assert applied == [(KIND_QUERY, {"q": 1}), (KIND_QUERY, {"q": 2})]
+    assert f.works == 2
+
+
+def test_follower_deadline_abort_on_silent_leader():
+    """A follower whose leader goes quiet past leader_timeout aborts
+    the loop cleanly (deadline-fenced) instead of hanging forever."""
+    ch = LoopbackChannel(2048)
+    f = GangFollower(ch, lambda k, p: None, leader_timeout=0.2)
+    t0 = time.monotonic()
+    assert f.run() == "leader_timeout"
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_follower_abort_on_channel_closed():
+    """Collective-plane death (the real channel's peer-loss surface)
+    exits the loop with channel_closed, not a hang or a raise."""
+    ch = LoopbackChannel(2048)
+    ch.close()
+    f = GangFollower(ch, lambda k, p: None, leader_timeout=5.0)
+    assert f.run() == "channel_closed"
+
+
+def test_follower_idle_tick_liveness():
+    """Ticks keep the loop alive across idle gaps longer than any
+    single recv, carry the leader clock for lag measurement, and work
+    dispatched after a tick run still applies."""
+    ch = LoopbackChannel(2048)
+    applied = []
+    f = GangFollower(ch, lambda k, p: applied.append(p), leader_timeout=0.6)
+
+    def leader():
+        for _ in range(4):
+            time.sleep(0.25)  # > half the timeout: only ticks keep it alive
+            _send(ch, KIND_TICK, json.dumps({"t": time.time()}).encode())
+        _send(ch, KIND_QUERY, json.dumps({"late": True}).encode())
+        _send(ch, KIND_POISON)
+
+    t = threading.Thread(target=leader)
+    t.start()
+    assert f.run() == "poison"
+    t.join()
+    assert f.ticks == 4
+    assert f.last_lag < 5.0
+    assert applied == [{"late": True}]
+
+
+def test_follower_expected_apply_error_continues():
+    """Validation-class errors (bad args, missing schema) raise before
+    any collective on every rank identically — the loop continues."""
+    ch = LoopbackChannel(2048)
+
+    def apply(kind, payload):
+        if payload.get("boom"):
+            raise ValueError("Count() requires an input bitmap")
+        return "ok"
+
+    f = GangFollower(ch, apply, leader_timeout=5.0)
+    _send(ch, KIND_QUERY, json.dumps({"boom": True}).encode())
+    _send(ch, KIND_QUERY, json.dumps({}).encode())
+    _send(ch, KIND_POISON)
+    assert f.run() == "poison"
+    assert f.errors == 1 and f.works == 2
+
+
+def test_follower_unexpected_apply_error_aborts_loop():
+    """An unexpected mid-execution failure may have skipped collectives
+    the leader still runs — continuing would pair mismatched
+    collectives on the next hop (observed as a gloo size-mismatch abort
+    killing BOTH processes). The loop must exit cleanly instead; the
+    leader's dispatch fence then degrades the gang."""
+    ch = LoopbackChannel(2048)
+
+    def apply(kind, payload):
+        raise RuntimeError("device wedged mid-kernel")
+
+    f = GangFollower(ch, apply, leader_timeout=5.0)
+    _send(ch, KIND_QUERY, json.dumps({}).encode())
+    _send(ch, KIND_QUERY, json.dumps({}).encode())  # never reached
+    assert f.run() == "apply_error"
+    assert f.errors == 1 and f.works == 1
+
+
+# -- leader dispatch ----------------------------------------------------------
+
+
+def test_leader_dispatch_runs_in_lockstep_order():
+    """Leader dispatch broadcasts the descriptor and runs it locally;
+    an attached follower on the same channel applies the identical
+    descriptors in the identical order."""
+    ch = LoopbackChannel(4096)
+    leader_applied, follower_applied = [], []
+    rt = MultiHostRuntime(
+        rank=0,
+        world=2,
+        channel=ch,
+        apply_fn=lambda k, p: (leader_applied.append(p), p["n"] * 10)[1],
+        idle_interval=0,  # no ticker: the follower loop below is finite
+        dispatch_timeout=5.0,
+    )
+    results = [rt.dispatch(Descriptor(KIND_QUERY, {"n": i})) for i in range(3)]
+    assert results == [0, 10, 20]
+    rt.close()  # poison pill lands after the three work messages
+    f = GangFollower(ch, lambda k, p: follower_applied.append(p), leader_timeout=2.0)
+    assert f.run() == "poison"
+    assert follower_applied == leader_applied == [{"n": 0}, {"n": 1}, {"n": 2}]
+
+
+def test_leader_dispatch_timeout_degrades_and_503s():
+    """A wedged channel (dead follower) turns a dispatch into a clean
+    GangUnavailable within the fence, flips the runtime to degraded,
+    and fires the degrade hook — never a hang."""
+
+    class WedgedChannel:
+        frame_bytes = 4096
+
+        def send(self, frames):
+            time.sleep(30)
+
+    degraded = []
+    rt = MultiHostRuntime(
+        rank=0,
+        world=2,
+        channel=WedgedChannel(),
+        apply_fn=lambda k, p: None,
+        idle_interval=0,
+        dispatch_timeout=0.3,
+        on_degrade=lambda: degraded.append(1),
+    )
+    t0 = time.monotonic()
+    with pytest.raises(GangUnavailable) as ei:
+        rt.dispatch(Descriptor(KIND_QUERY, {}))
+    assert time.monotonic() - t0 < 3.0
+    assert ei.value.status == 503
+    assert rt.degraded and degraded == [1]
+    # post-degrade dispatches fail fast without waiting the fence
+    t0 = time.monotonic()
+    with pytest.raises(GangUnavailable):
+        rt.dispatch(Descriptor(KIND_QUERY, {}))
+    assert time.monotonic() - t0 < 0.1
+
+
+def test_leader_request_deadline_does_not_degrade():
+    """A caller deadline shorter than the fence raises
+    DeadlineExceeded and leaves the gang HEALTHY — a slow query must
+    never tear down a live gang."""
+    from pilosa_tpu.server.deadline import Deadline, DeadlineExceeded
+
+    class SlowChannel:
+        frame_bytes = 4096
+
+        def send(self, frames):
+            time.sleep(1.0)
+
+    rt = MultiHostRuntime(
+        rank=0,
+        world=2,
+        channel=SlowChannel(),
+        apply_fn=lambda k, p: "late",
+        idle_interval=0,
+        dispatch_timeout=30.0,
+    )
+    with pytest.raises(DeadlineExceeded):
+        rt.dispatch(Descriptor(KIND_QUERY, {}), deadline=Deadline.after(0.15))
+    assert not rt.degraded
+
+
+def test_single_process_runtime_is_inactive():
+    rt = MultiHostRuntime(rank=0, world=1, channel=LoopbackChannel(1024),
+                          apply_fn=lambda k, p: None)
+    assert not rt.active
+    assert not rt.should_dispatch()
+
+
+# -- 2-process serving smoke --------------------------------------------------
+
+
+def test_two_process_multihost_serving_smoke():
+    """The full serving path on a real 2-process jax.distributed CPU
+    mesh: HTTP on rank 0, gang replay on rank 1, bit-identity against
+    the CPU oracle, and a bounded follower-kill failure — the dryrun
+    driver in quick mode."""
+    import jax
+
+    if not hasattr(jax, "distributed"):
+        pytest.skip("jax.distributed unavailable")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "dryrun_multihost.py"), "--quick"],
+        capture_output=True,
+        text=True,
+        timeout=560,
+        env={
+            k: v
+            for k, v in os.environ.items()
+            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+        },
+    )
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+    summary = json.loads(proc.stdout[proc.stdout.index('{\n  "what"') :])
+    assert summary["ok"] is True
+    assert summary["serving"]["rank0_http_bit_identical"] is True
+    assert summary["serving"]["rank1_replay_bit_identical"] is True
+    assert summary["follower_kill"]["first_query_bounded"] is True
+    assert summary["follower_kill"]["degraded"] is True
