@@ -401,10 +401,9 @@ class Executor:
         opt: Optional[ExecOptions] = None,
     ) -> list[Any]:
         gang = self.gang
-        if (
-            gang is not None
-            and not (opt is not None and opt.remote)
-            and gang.should_dispatch()
+        if gang is not None and gang.should_dispatch_query(
+            bool(opt is not None and opt.remote),
+            query if isinstance(query, str) else str(query),
         ):
             # multihost leader: broadcast the descriptor so every rank
             # enters this execution in lockstep (the mesh spans
@@ -1914,11 +1913,25 @@ class Executor:
             return self.cluster.clear_bit(index, c, f, row_id, col_id, opt)
         return f.clear_bit(row_id, col_id)
 
+    def _gang_forward_write(self, index, c: Call, opt) -> bool:
+        """Federated leader receiving a forward-style write (SetValue /
+        attrs) at top level: the LOCAL apply must replay through the
+        gang (so follower holders stay identical), then fan out to
+        peers as usual. True when handled."""
+        lex = self.cluster.local_executor if self.cluster is not None else None
+        if lex is None or opt.remote:
+            return False
+        lex(index, c, None, opt)
+        self.cluster.forward_to_all(index, c, opt)
+        return True
+
     def _execute_set_value(self, index, c: Call, opt) -> None:
         col_id, ok = c.uint_arg("col")
         if not ok:
             raise ValueError("SetValue() col argument required")
         args = {k: v for k, v in c.args.items() if k != "col"}
+        if self._gang_forward_write(index, c, opt):
+            return
         for name, value in args.items():
             f = self.holder.field(index, name)
             if f is None:
@@ -1944,6 +1957,8 @@ class Executor:
         }
         if f.row_attr_store is None:
             raise ValueError("row attr store not configured")
+        if self._gang_forward_write(index, c, opt):
+            return
         f.row_attr_store.set_attrs(row_id, attrs)
         if self.cluster is not None and not opt.remote:
             self.cluster.forward_to_all(index, c, opt)
@@ -1956,6 +1971,8 @@ class Executor:
         attrs = {k: v for k, v in c.args.items() if k != "_col"}
         if idx.column_attrs is None:
             raise ValueError("column attr store not configured")
+        if self._gang_forward_write(index, c, opt):
+            return
         idx.column_attrs.set_attrs(col_id, attrs)
         if self.cluster is not None and not opt.remote:
             self.cluster.forward_to_all(index, c, opt)

@@ -796,3 +796,12 @@ class DeviceStager:
             if not fl.event.is_set():
                 fl.error = RuntimeError("staging abandoned: device wedged")
                 fl.event.set()
+
+    def reset_for_reform(self) -> None:
+        """Gang re-formation (parallel/federation.py): arrays staged
+        under the previous gang epoch may reference the torn global
+        mesh, and pending delta snapshots predate the re-synced host
+        fragments — drop everything so post-reform queries re-stage
+        from the current holder state. Same mechanics as a device
+        wedge: epoch bump fences zombie builders."""
+        self.reset_after_wedge()

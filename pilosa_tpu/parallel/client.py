@@ -4,12 +4,18 @@ http/client.go). JSON instead of protobuf; same endpoint map."""
 from __future__ import annotations
 
 import json
+import random
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
-from pilosa_tpu.utils import privateproto
+from pilosa_tpu.utils import metrics, privateproto
+
+# retry backoff cap: one fence window, not a liveness probe interval —
+# a leg that can't land in ~2s should fail over, not keep waiting
+_BACKOFF_CAP = 2.0
 
 
 class ClientError(Exception):
@@ -26,13 +32,62 @@ class ClientError(Exception):
         self.status = status
 
 
+def _retryable(e: ClientError) -> bool:
+    """Transient failures worth a retry: the node never answered
+    (transport) or answered 503 — a fencing gang leader says exactly
+    that during re-formation. Any other HTTP error is deterministic
+    (bad query, missing field) and retrying just repeats it."""
+    return e.transport or e.status == 503
+
+
 class InternalClient:
-    def __init__(self, timeout: float = 30.0, ssl_context=None) -> None:
+    def __init__(
+        self,
+        timeout: float = 30.0,
+        ssl_context=None,
+        retries: int = 0,
+        retry_backoff: float = 0.05,
+    ) -> None:
         self.timeout = timeout
         # for https:// peers (reference http/client.go builds its
         # transport from the TLS config, server/server.go:166-240);
         # None = system defaults
         self.ssl_context = ssl_context
+        # cross-gang RPC retry policy (capped exponential + full
+        # jitter); retries=0 preserves one-shot semantics — the probe
+        # client and control-plane broadcasts stay one-shot so liveness
+        # verdicts and status gossip remain prompt
+        self.retries = retries
+        self.retry_backoff = retry_backoff
+
+    def _with_retry(self, op: str, fn: Callable[[], Any]) -> Any:
+        """Run ``fn`` with up to ``self.retries`` retries on transient
+        failures, honoring the ambient request deadline: a retry whose
+        backoff cannot fit in the remaining budget is not attempted —
+        the caller's failover path (replica re-map) is faster than a
+        doomed wait."""
+        if self.retries <= 0:
+            return fn()
+        from pilosa_tpu.server import deadline as _deadline
+
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except ClientError as e:
+                if not _retryable(e) or attempt >= self.retries:
+                    if attempt:
+                        metrics.count(metrics.CLIENT_RETRY_EXHAUSTED, op=op)
+                    raise
+                delay = min(_BACKOFF_CAP, self.retry_backoff * (2 ** attempt))
+                delay *= 0.5 + random.random() * 0.5  # jitter
+                dl = _deadline.current()
+                if dl is not None and dl.remaining() <= delay:
+                    metrics.count(metrics.CLIENT_RETRY_EXHAUSTED, op=op)
+                    raise
+                attempt += 1
+                metrics.count(metrics.CLIENT_RETRIES, op=op)
+                time.sleep(delay)
 
     def _request(
         self,
@@ -78,12 +133,18 @@ class InternalClient:
         q = {"remote": "true" if remote else "false"}
         if shards is not None:
             q["shards"] = ",".join(str(s) for s in shards)
-        resp = self._request(
-            "POST",
-            uri,
-            f"/index/{index}/query",
-            body=query.encode(),
-            query=q,
+        # safe to retry even for writes: Set/Clear are idempotent and a
+        # transport failure means the request may or may not have
+        # landed either way — at-least-once is the existing contract
+        resp = self._with_retry(
+            "query",
+            lambda: self._request(
+                "POST",
+                uri,
+                f"/index/{index}/query",
+                body=query.encode(),
+                query=q,
+            ),
         )
         return resp.get("results", [])
 
@@ -93,40 +154,52 @@ class InternalClient:
         body = {"rowIDs": list(row_ids), "columnIDs": list(column_ids)}
         if timestamps is not None:
             body["timestamps"] = list(timestamps)
-        self._request(
-            "POST",
-            uri,
-            f"/index/{index}/field/{field}/import",
-            body=json.dumps(body).encode(),
+        self._with_retry(
+            "import",
+            lambda: self._request(
+                "POST",
+                uri,
+                f"/index/{index}/field/{field}/import",
+                body=json.dumps(body).encode(),
+            ),
         )
 
     def import_values(self, uri: str, index: str, field: str, column_ids, values) -> None:
         body = {"columnIDs": list(column_ids), "values": list(values)}
-        self._request(
-            "POST",
-            uri,
-            f"/index/{index}/field/{field}/import-value",
-            body=json.dumps(body).encode(),
+        self._with_retry(
+            "import",
+            lambda: self._request(
+                "POST",
+                uri,
+                f"/index/{index}/field/{field}/import-value",
+                body=json.dumps(body).encode(),
+            ),
         )
 
     def import_bits_local(self, uri, index, field, row_ids, column_ids, timestamps=None):
         body = {"rowIDs": list(row_ids), "columnIDs": list(column_ids), "local": True}
         if timestamps is not None:
             body["timestamps"] = list(timestamps)
-        self._request(
-            "POST",
-            uri,
-            f"/index/{index}/field/{field}/import",
-            body=json.dumps(body).encode(),
+        self._with_retry(
+            "import",
+            lambda: self._request(
+                "POST",
+                uri,
+                f"/index/{index}/field/{field}/import",
+                body=json.dumps(body).encode(),
+            ),
         )
 
     def import_values_local(self, uri, index, field, column_ids, values):
         body = {"columnIDs": list(column_ids), "values": list(values), "local": True}
-        self._request(
-            "POST",
-            uri,
-            f"/index/{index}/field/{field}/import-value",
-            body=json.dumps(body).encode(),
+        self._with_retry(
+            "import",
+            lambda: self._request(
+                "POST",
+                uri,
+                f"/index/{index}/field/{field}/import-value",
+                body=json.dumps(body).encode(),
+            ),
         )
 
     # -- fragment sync (reference FragmentBlocks/BlockData:637,682) --
@@ -261,6 +334,34 @@ class InternalClient:
             body, headers = json.dumps(msg).encode(), None
         self._request(
             "POST", uri, "/internal/cluster/message", body=body, headers=headers
+        )
+
+    # -- federation (parallel/federation.py) --
+
+    def gang_apply(self, uri: str, kind: int, payload: dict, epoch: int) -> None:
+        """Replicate one epoch-stamped gang descriptor to a follower in
+        replicated mode. The follower 409s on an epoch mismatch (stale
+        replica — it must rejoin before applying anything)."""
+        self._with_retry(
+            "gang_apply",
+            lambda: self._request(
+                "POST",
+                uri,
+                "/internal/gang/apply",
+                body=json.dumps(
+                    {"kind": kind, "payload": payload, "epoch": epoch}
+                ).encode(),
+            ),
+        )
+
+    def gang_rejoin(self, uri: str, follower_uri: str) -> dict:
+        """Announce a re-staged follower to its gang leader; the leader
+        re-forms the gang around it and returns the new epoch."""
+        return self._request(
+            "POST",
+            uri,
+            "/internal/gang/rejoin",
+            body=json.dumps({"uri": follower_uri}).encode(),
         )
 
     # -- misc --

@@ -28,6 +28,7 @@ from typing import Callable, Optional
 
 from pilosa_tpu.parallel.client import ClientError, InternalClient
 from pilosa_tpu.parallel.hashing import DEFAULT_PARTITION_N, Jmphasher, partition
+from pilosa_tpu.parallel.multihost import GangUnavailable
 from pilosa_tpu.parallel.node import Node
 from pilosa_tpu.utils import metrics, trace
 from pilosa_tpu.utils.errors import NotFoundError
@@ -144,6 +145,11 @@ class Cluster:
         self._probe_client = InternalClient(
             timeout=probe_timeout, ssl_context=ssl_context
         )
+        # federation hook (parallel/federation.py): when this node is a
+        # gang leader, local map-reduce / write legs must replay through
+        # the gang instead of touching the holder directly — set to a
+        # callable (index, call, shards, opt) -> executor result
+        self.local_executor: Optional[Callable] = None
 
     # -- wiring --------------------------------------------------------------
 
@@ -435,6 +441,12 @@ class Cluster:
                 name: idx.max_shard() for name, idx in holder.indexes.items()
             },
         }
+        # federation: gang lifecycle rides the periodic exchange too, so
+        # a peer that was down during a transition broadcast still heals
+        # within one status interval instead of routing to a stale view
+        mh = getattr(self.server, "multihost", None)
+        if mh is not None and mh.federated:
+            msg["gang"] = {"state": mh.state, "epoch": mh.epoch}
         if not sync:
             self.send_async(msg)
             return
@@ -473,6 +485,13 @@ class Cluster:
                     idx = holder.index(name)
                     if idx is not None:
                         idx.set_remote_max_shard(int(m))
+                # federation: adopt the peer gang's CURRENT lifecycle —
+                # this node may have been down when it was broadcast
+                gang = (self._probe_client.status(n.uri) or {}).get("gang")
+                if gang:
+                    with self.mu:
+                        n.gang_state = gang.get("state", "")
+                        n.gang_epoch = int(gang.get("epoch", 0))
             except (ClientError, OSError):
                 pass  # peer down: its push will heal us when it boots
 
@@ -493,6 +512,11 @@ class Cluster:
         sender = next((n for n in self.nodes if n.id == msg.get("node_id")), None)
         if sender is not None:
             self._note_probe(sender, True, traffic=True)
+            gang = msg.get("gang")
+            if gang:
+                with self.mu:
+                    sender.gang_state = gang.get("state", "")
+                    sender.gang_epoch = int(gang.get("epoch", 0))
 
     def _apply_remote_holder_state(self, msg: dict) -> None:
         """Merge a peer's schema + maxShards into the local holder (the
@@ -524,10 +548,42 @@ class Cluster:
             self._holder_clean()
         elif typ == "set-coordinator":
             self._apply_set_coordinator(msg["node"]["id"])
+        elif typ == "gang-state":
+            self._apply_gang_state(msg)
         elif typ == "node-leave":
             pass  # deliberate: no automatic removal (reference cluster.go:1629)
         else:
             raise ValueError(f"unknown cluster message: {typ}")
+
+    def _apply_gang_state(self, msg: dict) -> None:
+        """Federation: a gang leader announced a lifecycle transition —
+        update its node so placement stops routing writes to a fencing
+        gang and reads prefer ACTIVE owners (parallel/federation.py)."""
+        with self.mu:
+            node = next(
+                (n for n in self.nodes if n.id == msg.get("node_id")), None
+            )
+            if node is None:
+                return
+            node.gang_state = msg.get("state", "")
+            node.gang_epoch = int(msg.get("epoch", 0))
+        if self.logger:
+            self.logger.printf(
+                "gang %s -> %s (epoch %s)",
+                msg.get("node_id"), msg.get("state"), msg.get("epoch"),
+            )
+
+    def announce_gang_state(self, state: str, epoch: int) -> None:
+        """Broadcast THIS node's gang lifecycle to every peer (and apply
+        it locally) — called from the runtime's state-change hook."""
+        msg = {
+            "type": "gang-state",
+            "node_id": self.node_id,
+            "state": state,
+            "epoch": epoch,
+        }
+        self._apply_gang_state(msg)
+        self.send_async(msg)
 
     def _handle_node_join(self, node: Node) -> None:
         """Coordinator-side join handling (reference nodeJoin,
@@ -695,12 +751,22 @@ class Cluster:
             futures = []
             for node, node_shards in by_node:
                 if node.id == self.node_id:
-                    futures.append(
-                        (node, node_shards, self._pool.submit(
-                            self._map_local_leg, parent, node_shards, map_fn,
-                            reduce_fn, zero_factory,
-                        ))
-                    )
+                    if self.local_executor is not None:
+                        # federated leader: the local leg replays
+                        # through the gang so every rank sees it
+                        futures.append(
+                            (node, node_shards, self._pool.submit(
+                                self._map_gang_leg, parent, index, c,
+                                node_shards, opt,
+                            ))
+                        )
+                    else:
+                        futures.append(
+                            (node, node_shards, self._pool.submit(
+                                self._map_local_leg, parent, node_shards, map_fn,
+                                reduce_fn, zero_factory,
+                            ))
+                        )
                 else:
                     futures.append(
                         (node, node_shards, self._pool.submit(
@@ -711,7 +777,7 @@ class Cluster:
             for node, node_shards, fut in futures:
                 try:
                     v = fut.result()
-                except (ClientError, ConnectionError) as e:
+                except (ClientError, ConnectionError, GangUnavailable) as e:
                     # failover: ban the node, re-map its shards onto
                     # replicas (reference mapReduce:1496-1509). Only
                     # transport-level failures feed the liveness tracker
@@ -749,7 +815,14 @@ class Cluster:
                 raise ShardUnavailableError(
                     f"shard {index}/{shard} has no live owner"
                 )
-            node = candidates[0]
+            # federation: a fencing gang missed recent writes — prefer
+            # an un-fenced owner for reads when one exists (it is also
+            # the one that can answer without a failover round-trip)
+            ok = [
+                n for n in candidates
+                if n.gang_state not in ("DEGRADED", "REFORMING")
+            ]
+            node = (ok or candidates)[0]
             by_id.setdefault(node.id, (node, []))[1].append(shard)
         return list(by_id.values())
 
@@ -770,6 +843,17 @@ class Cluster:
                 v = map_fn(shard)
             result = v if result is None else reduce_fn(result, v)
         return result
+
+    def _map_gang_leg(self, parent, index, c, shards, opt):
+        """Federated local leg: re-enter the executor with remote=True
+        so the gang hook replays the leg on every rank of THIS gang
+        (parallel/federation.py wires local_executor). Raises
+        GangUnavailable while the gang is fencing — map_reduce then
+        bans this node and re-maps the shards onto a replica gang."""
+        if parent is None:
+            return self.local_executor(index, c, shards, opt)
+        with parent.child(metrics.STAGE_MAP_LOCAL, shards=len(shards)):
+            return self.local_executor(index, c, shards, opt)
 
     def _map_remote_leg(self, parent, node, index, c, shards):
         """Remote leg wrapper: per-node fan-out RPC latency lands in
@@ -832,9 +916,15 @@ class Cluster:
 
         shard = col_id // SHARD_WIDTH
         ret = False
-        for node in self.shard_nodes(index, shard):
+        for node in self._write_targets(index, shard):
             if node.id == self.node_id:
-                if local_fn():
+                if self.local_executor is not None:
+                    # federated leader: replay the write through the
+                    # gang so follower holders stay identical
+                    res = self.local_executor(index, c, None, opt)
+                    if res is True:
+                        ret = True
+                elif local_fn():
                     ret = True
             elif not opt.remote:
                 res = self.client.query_node(
@@ -843,6 +933,17 @@ class Cluster:
                 if res and res[0] is True:
                     ret = True
         return ret
+
+    def _write_targets(self, index, shard) -> list[Node]:
+        """Write-owner set for one shard: owners whose gang is fencing
+        (DEGRADED/REFORMING) are skipped while an un-fenced owner
+        exists — the skipped gang re-converges through the rejoin-time
+        anti-entropy pass (sync_holder) before it turns ACTIVE again.
+        All owners fencing → write to them anyway (a replicated-mode
+        DEGRADED gang still applies writes)."""
+        owners = self.shard_nodes(index, shard)
+        ok = [n for n in owners if n.gang_state not in ("DEGRADED", "REFORMING")]
+        return ok or owners
 
     def forward_to_all(self, index, c, opt) -> None:
         """SetValue/attrs replicate to every node (reference

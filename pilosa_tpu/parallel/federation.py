@@ -1,0 +1,228 @@
+"""Sharded gang federation (ISSUE 7): compose the gang plane with the
+cluster plane so N independent ``jax.distributed`` gangs together serve
+one index, and a rank death ends in re-formation instead of PR 5's
+degrade-forever.
+
+Topology
+--------
+``cluster.hosts`` lists the gang LEADER URIs — each leader is one
+cluster node, and jump-hash places shards on leaders exactly as it
+places them on plain nodes. A top-level query splits across gangs in
+``cluster.map_reduce``: the LOCAL leg re-enters the executor through
+``cluster.local_executor`` (wired here) with ``remote=True`` so this
+gang's runtime replays it on every rank of THIS gang only; REMOTE legs
+fan out over :class:`InternalClient` to the owner leader's query
+endpoint and merge through the existing Row/TopN/BSI reducers.
+
+Lifecycle
+---------
+Follower death fences in-flight dispatches (bounded 503), the leader
+marks itself DEGRADED in the cluster plane (peers stop routing writes
+to it, reads prefer other owners), then keeps serving replicated-solo.
+A restarted follower boots with ``federation-rejoin = <leader>`` and
+announces itself; the leader re-forms around it — anti-entropy catch-up,
+schema + fragment push, epoch bump (the fence that keeps plan caches
+and stale repliers from replaying pre-failure state) — and rejoins
+ACTIVE in replicated mode. No path stays degraded forever.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def _client(server, timeout: float = 30.0):
+    from pilosa_tpu.parallel.client import InternalClient
+
+    cfg = server.config
+    return InternalClient(
+        timeout=timeout,
+        ssl_context=server.client_ssl_context(),
+        retries=cfg.client_retries,
+        retry_backoff=cfg.client_retry_backoff,
+    )
+
+
+def wire(server) -> None:
+    """Connect the two planes on a gang leader: the cluster plane gets
+    a gang-replaying local executor, the gang runtime gets its
+    federation hooks (replication, epoch fencing, state gossip)."""
+    mh, cluster, ex = server.multihost, server.cluster, server.executor
+    if mh is None or cluster is None:
+        return
+    mh.federated = True
+    cfg = server.config
+    # cross-gang legs retry transient failures / fencing 503s
+    cluster.client.retries = cfg.client_retries
+    cluster.client.retry_backoff = cfg.client_retry_backoff
+
+    from pilosa_tpu.executor.executor import ExecOptions
+
+    def local_executor(index, c, shards, opt):
+        # remote=True: the cluster plane already routed this leg here,
+        # so the gang replays it without re-splitting across gangs.
+        # Plain (non-gang) options — the dispatch hook swaps in the
+        # serial/cache-bypassing _gang_opt at replay time.
+        o = ExecOptions(
+            remote=True,
+            exclude_row_attrs=getattr(opt, "exclude_row_attrs", False),
+            exclude_columns=getattr(opt, "exclude_columns", False),
+        )
+        res = ex.execute(index, str(c), shards, o)
+        if not res:
+            return None
+        # remote-mode results come back in wire shape (TopN returns
+        # id/count dicts, executor._execute_topn) — decode exactly like
+        # a remote leg so map_reduce merges one representation
+        return cluster._decode_remote(c, res[0])
+
+    cluster.local_executor = local_executor
+
+    def replicate(uri: str, kind: int, payload: dict, epoch: int) -> None:
+        cluster.client.gang_apply(uri, kind, payload, epoch)
+
+    mh.replicate_fn = replicate
+    # epoch fence on re-form: results, plans, and scorer state computed
+    # against the pre-failure mesh must not survive into the new epoch
+    mh.on_reform = ex._on_device_restore
+    mh.on_state_change = cluster.announce_gang_state
+    # seed peers immediately — a replicated-solo restart must advertise
+    # DEGRADED before the first query routes to it
+    cluster.announce_gang_state(mh.state, mh.epoch)
+
+
+def _pull_missing_fragments(server) -> int:
+    """Rejoin-time catch-up, part 1: materialize locally-owned
+    fragments that were CREATED on peer replicas while this gang was
+    fenced — ``sync_holder`` only block-diffs fragments that already
+    exist locally, so a brand-new fragment would otherwise never
+    arrive and post-re-form reads of it would be silently empty."""
+    cluster, holder = server.cluster, server.holder
+    if cluster is None or cluster.replica_n < 2:
+        return 0
+    pulled = 0
+    for node in cluster._other_nodes():
+        try:
+            inventory = cluster.client.fragment_inventory(node.uri)
+        except Exception:
+            continue
+        for ent in inventory:
+            iname, fname = ent["index"], ent["field"]
+            vname, shard = ent["view"], ent["shard"]
+            owners = cluster.shard_nodes(iname, shard)
+            if not any(n.id == cluster.node_id for n in owners):
+                continue
+            if holder.fragment(iname, fname, vname, shard) is not None:
+                continue
+            try:
+                data = cluster.client.retrieve_fragment(
+                    node.uri, iname, fname, vname, shard
+                )
+                server.api.unmarshal_fragment(iname, fname, vname, shard, data)
+                pulled += 1
+            except Exception as e:
+                server.logger.printf(
+                    "rejoin: fragment pull %s/%s/%s/%d from %s failed: %s",
+                    iname, fname, vname, shard, node.uri, e,
+                )
+    return pulled
+
+
+def handle_rejoin(server, follower_uri: str) -> dict:
+    """Leader-side re-formation (POST /internal/gang/rejoin). Order
+    matters: (1) anti-entropy catch-up for writes that routed around
+    this gang while it fenced, (2) schema push so the follower can host
+    fragments, (3) fragment push, (4) ``reform()`` — fence, epoch bump,
+    ACTIVE. Writes landing during the push window re-converge through
+    the next anti-entropy sweep."""
+    from pilosa_tpu.server.api import APIError
+
+    mh, cluster, api = server.multihost, server.cluster, server.api
+    if mh is None or not mh.federated:
+        raise APIError("not a federated gang leader")
+    t0 = time.monotonic()
+    if cluster is not None:
+        try:
+            _pull_missing_fragments(server)
+            cluster.sync_holder()
+        except Exception as e:
+            server.logger.printf("rejoin: pre-re-form anti-entropy failed: %s", e)
+    client = cluster.client if cluster is not None else _client(server)
+    client.send_message(
+        follower_uri, {"type": "schema", "schema": server.holder.schema()}
+    )
+    pushed = 0
+    for frag in api.fragment_inventory():
+        data = api.marshal_fragment(
+            frag["index"], frag["field"], frag["view"], frag["shard"]
+        )
+        client.send_fragment(
+            follower_uri,
+            frag["index"],
+            frag["field"],
+            frag["view"],
+            frag["shard"],
+            data,
+        )
+        pushed += 1
+    # merge with any followers already serving: a second rejoin must
+    # not evict the first
+    replicas = [u for u in mh.health()["replicas"] if u != follower_uri]
+    replicas.append(follower_uri)
+    out = mh.reform(replicas, reason=f"follower {follower_uri} rejoined")
+    out["fragments"] = pushed
+    out["reformSeconds"] = round(time.monotonic() - t0, 3)
+    server.logger.printf(
+        "gang re-formed around %s: epoch %d, %d fragments, %.2fs",
+        follower_uri,
+        out["epoch"],
+        pushed,
+        out["reformSeconds"],
+    )
+    return out
+
+
+def rejoin_follower(server, leader_uri: str) -> bool:
+    """Follower boot path (``federation-rejoin``): announce this
+    re-staged process to its gang leader and adopt the new epoch.
+    Retries across the re-form budget — the leader may itself still be
+    coming up or fencing. Returns True once rejoined."""
+    budget = server.config.federation_reform_budget
+    client = _client(server, timeout=max(budget, 10.0))
+    t_dead = time.monotonic() + budget
+    while True:
+        try:
+            resp = client.gang_rejoin(leader_uri, server.uri)
+            break
+        except Exception as e:
+            if time.monotonic() >= t_dead:
+                server.logger.printf(
+                    "federation rejoin to %s failed after %.1fs: %s",
+                    leader_uri,
+                    budget,
+                    e,
+                )
+                return False
+            time.sleep(0.25)
+    server.gang_epoch = int(resp.get("epoch", 0))
+    server.logger.printf(
+        "rejoined gang at %s: epoch %d", leader_uri, server.gang_epoch
+    )
+    return True
+
+
+def start_rejoin(server):
+    """Run the rejoin announcement off-thread so ``open()`` returns and
+    the HTTP listener can answer the leader's schema/fragment push —
+    the rejoin RPC and the push it triggers would deadlock a single
+    thread."""
+    import threading
+
+    t = threading.Thread(
+        target=rejoin_follower,
+        args=(server, server.config.federation_rejoin),
+        name="federation-rejoin",
+        daemon=True,
+    )
+    t.start()
+    return t

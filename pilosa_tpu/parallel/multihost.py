@@ -58,12 +58,50 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import struct
 import threading
 import time
 from typing import Any, Callable, Optional
 
 from pilosa_tpu.utils import metrics
+
+# -- gang lifecycle ----------------------------------------------------------
+
+# Lifecycle states. FORMING only ever appears in the transition log
+# (construction blocks inside jax.distributed.initialize, so a
+# constructed runtime is already formed). DEGRADED is no longer a
+# terminal state: a federated runtime keeps serving in replicated mode
+# and returns to ACTIVE through reform().
+STATE_FORMING = "FORMING"
+STATE_ACTIVE = "ACTIVE"
+STATE_DEGRADED = "DEGRADED"
+STATE_REFORMING = "REFORMING"
+
+_STATE_CODES = {
+    STATE_FORMING: 0,
+    STATE_ACTIVE: 1,
+    STATE_DEGRADED: 2,
+    STATE_REFORMING: 3,
+}
+
+# Gang execution modes. "collective": lockstep replay over the
+# jax.distributed collective plane — every rank enters every compiled
+# program. "replicated": post-re-form — each rank runs an independent
+# local mesh; reads execute on the leader directly and only
+# state-bearing work replicates to follower HTTP endpoints, ordered by
+# the same single leader thread. The distinction exists because a dead
+# peer poisons the shared gloo context (and tears the global mesh), so
+# the collective plane cannot be rebuilt in-process — but the gang CAN
+# re-form around HTTP replication and keep its redundancy story.
+MODE_COLLECTIVE = "collective"
+MODE_REPLICATED = "replicated"
+
+# Write-call detector for the replicated-mode dispatch decision (the
+# same shape http_handler uses to exempt writes from coalescing):
+# replicated reads run directly on the leader's local mesh, only
+# state-bearing queries need the leader thread's ordering + fan-out.
+_WRITE_RE = re.compile(r"\b(?:Set\w*|Clear)\s*\(")
 
 # -- wire framing ------------------------------------------------------------
 
@@ -176,6 +214,10 @@ def query_descriptor(index: str, query_text: str, shards, opt) -> Descriptor:
             "opt": {
                 "exclude_row_attrs": bool(getattr(opt, "exclude_row_attrs", False)),
                 "exclude_columns": bool(getattr(opt, "exclude_columns", False)),
+                # federated legs arrive with remote=True and must replay
+                # that way: the gang ranks execute their local shards
+                # only, never re-route through the cluster plane
+                "remote": bool(getattr(opt, "remote", False)),
             },
         },
     )
@@ -281,10 +323,12 @@ class CollectiveChannel:
     def recv_message(self, timeout: Optional[float] = None) -> tuple[int, bytes]:
         first = self.recv_frame(timeout)
         kind, seq, total, chunk = decode_frame(first)
+        if seq != 0:
+            raise FrameError(f"message starts mid-sequence: {seq}/{total}")
         chunks = [chunk]
         for _ in range(1, total):
             kind2, seq2, total2, chunk2 = decode_frame(self.recv_frame(timeout))
-            if kind2 != kind or total2 != total:
+            if kind2 != kind or total2 != total or seq2 != len(chunks):
                 raise FrameError("interleaved message frames")
             chunks.append(chunk2)
         return kind, b"".join(chunks)
@@ -330,11 +374,119 @@ class LoopbackChannel:
     def recv_message(self, timeout: Optional[float] = None) -> tuple[int, bytes]:
         first = self.recv_frame(timeout)
         kind, seq, total, chunk = decode_frame(first)
+        if seq != 0:
+            raise FrameError(f"message starts mid-sequence: {seq}/{total}")
         chunks = [chunk]
         for _ in range(1, total):
-            _, _, _, chunk2 = decode_frame(self.recv_frame(timeout))
+            kind2, seq2, total2, chunk2 = decode_frame(self.recv_frame(timeout))
+            if kind2 != kind or total2 != total or seq2 != len(chunks):
+                raise FrameError("interleaved message frames")
             chunks.append(chunk2)
         return kind, b"".join(chunks)
+
+
+# -- fault injection ---------------------------------------------------------
+
+FAULTS_ENV = "PILOSA_TPU_MH_FAULTS"
+
+
+class FaultSpec:
+    """Deterministic fault schedule for the gang control channel,
+    parsed from ``PILOSA_TPU_MH_FAULTS`` (or the ``distributed-faults``
+    config knob): ``drop_every=N`` zeroes every Nth sent frame (the
+    receiver sees bad magic — frame loss on the wire), ``dup_every=N``
+    delivers every Nth frame twice (duplicate delivery),
+    ``delay=S`` sleeps S seconds before each send (a slow or wedged
+    peer), ``after=K`` starts counting only after the first K frames so
+    bring-up traffic passes clean. No RNG anywhere — the follower
+    desync-abort and leader fencing paths reproduce exactly, without
+    SIGKILL."""
+
+    __slots__ = ("drop_every", "dup_every", "delay", "after")
+
+    def __init__(
+        self,
+        drop_every: int = 0,
+        dup_every: int = 0,
+        delay: float = 0.0,
+        after: int = 0,
+    ) -> None:
+        self.drop_every = drop_every
+        self.dup_every = dup_every
+        self.delay = delay
+        self.after = after
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        spec = cls()
+        for part in (text or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, value = part.partition("=")
+            key = key.strip()
+            if key in ("drop_every", "dup_every", "after"):
+                setattr(spec, key, int(value))
+            elif key == "delay":
+                spec.delay = float(value)
+            else:
+                raise ValueError(f"unknown fault knob: {key!r}")
+        return spec
+
+    def __bool__(self) -> bool:
+        return bool(self.drop_every or self.dup_every or self.delay)
+
+
+class FaultyChannel:
+    """Wraps any channel with a :class:`FaultSpec` applied on the SEND
+    side — the leader is the only sender, so one wrapper perturbs the
+    whole gang. Receive paths pass through untouched: a dropped frame
+    surfaces on the receiver as a FrameError (bad magic on the zeroed
+    frame), exactly what a desynced collective hop looks like."""
+
+    def __init__(self, inner, spec: FaultSpec) -> None:
+        self.inner = inner
+        self.spec = spec
+        self.frame_bytes = inner.frame_bytes
+        self._sent = 0
+
+    def send(self, frames) -> None:
+        out = []
+        for frame in frames:
+            self._sent += 1
+            n = self._sent - self.spec.after
+            if n <= 0:
+                out.append(frame)
+                continue
+            if self.spec.drop_every and n % self.spec.drop_every == 0:
+                out.append(b"\x00" * len(frame))  # lost on the wire
+                continue
+            out.append(frame)
+            if self.spec.dup_every and n % self.spec.dup_every == 0:
+                out.append(frame)
+        if self.spec.delay:
+            time.sleep(self.spec.delay)
+        self.inner.send(out)
+
+    def recv_frame(self, timeout: Optional[float] = None) -> bytes:
+        return self.inner.recv_frame(timeout)
+
+    def recv_message(self, timeout: Optional[float] = None) -> tuple[int, bytes]:
+        return self.inner.recv_message(timeout)
+
+    def close(self) -> None:
+        close = getattr(self.inner, "close", None)
+        if close is not None:
+            close()
+
+
+def maybe_faulty(channel, spec_text: str = ""):
+    """Wrap ``channel`` when a fault spec is configured (explicit
+    argument wins, else the env); identity otherwise."""
+    text = spec_text or os.environ.get(FAULTS_ENV, "")
+    if not text:
+        return channel
+    return FaultyChannel(channel, FaultSpec.parse(text))
 
 
 # -- bootstrap ---------------------------------------------------------------
@@ -437,7 +589,8 @@ class GangFollower:
 
     def run(self) -> str:
         """Loop until poison / leader loss; returns the stop reason
-        ("poison" | "leader_timeout" | "channel_closed")."""
+        ("poison" | "leader_timeout" | "channel_closed" | "desync" |
+        "apply_error")."""
         while True:
             try:
                 kind, raw = self.channel.recv_message(timeout=self.leader_timeout)
@@ -447,6 +600,15 @@ class GangFollower:
                 return self.stopped_reason
             except ChannelClosed:
                 self.stopped_reason = "channel_closed"
+                metrics.count(metrics.MULTIHOST_ABORTS, role="follower")
+                return self.stopped_reason
+            except FrameError:
+                # a dropped/garbled/misordered frame means this rank can
+                # no longer prove it has seen the same work stream as
+                # the leader — continuing could skip or replay work
+                # silently. Abort cleanly; the leader's dispatch fence
+                # turns the silence into the designed 503 + degrade.
+                self.stopped_reason = "desync"
                 metrics.count(metrics.MULTIHOST_ABORTS, role="follower")
                 return self.stopped_reason
             if kind == KIND_POISON:
@@ -463,7 +625,15 @@ class GangFollower:
                 except (ValueError, TypeError):
                     pass
                 continue
-            desc = Descriptor.decode(kind, raw)
+            try:
+                desc = Descriptor.decode(kind, raw)
+            except ValueError:
+                # frame reassembly produced bytes that don't decode: a
+                # duplicated or clipped mid-message frame — same desync
+                # verdict as a framing error
+                self.stopped_reason = "desync"
+                metrics.count(metrics.MULTIHOST_ABORTS, role="follower")
+                return self.stopped_reason
             self.works += 1
             metrics.count(metrics.MULTIHOST_DISPATCHES, role="follower")
             try:
@@ -539,10 +709,12 @@ class MultiHostRuntime:
         leader_timeout: float = 60.0,
         on_degrade: Optional[Callable[[], None]] = None,
         logger=None,
+        faults: str = "",
     ) -> None:
         self.rank = rank
         self.world = world
-        self.channel = channel if channel is not None else CollectiveChannel(frame_bytes)
+        ch = channel if channel is not None else CollectiveChannel(frame_bytes)
+        self.channel = maybe_faulty(ch, faults)
         self.apply_fn = apply_fn
         self.frame_bytes = frame_bytes
         self.idle_interval = idle_interval
@@ -551,27 +723,107 @@ class MultiHostRuntime:
         self.on_degrade = on_degrade
         self.logger = logger
         self.active = world > 1
-        self.degraded = False
+        # lifecycle (ISSUE 7): state machine + epoch + transition log.
+        # `degraded` survives as a property over `state` for callers
+        # (and tests) from the PR 5 single-plane world.
+        self.state = STATE_ACTIVE
+        self.mode = MODE_COLLECTIVE
+        self.epoch = 0
+        self.federated = False  # set by the federation wiring (server)
+        self.transitions: list[dict] = []
+        self._replicas: list[str] = []  # replicated-mode follower URIs
+        # federation hooks, wired by parallel/federation.py:
+        # replicate_fn(uri, kind, payload, epoch) applies a descriptor
+        # on one replicated follower (raises on terminal failure);
+        # on_reform epoch-fences server state (plan cache, stager)
+        # before a rejoin; on_state_change announces lifecycle moves to
+        # the cluster plane.
+        self.replicate_fn: Optional[Callable[[str, int, dict, int], None]] = None
+        self.on_reform: Optional[Callable[[], None]] = None
+        self.on_state_change: Optional[Callable[[str, int], None]] = None
         self._in_gang = threading.local()
         self._mu = threading.Lock()
         self._cond = threading.Condition(self._mu)
         self._queue: list[tuple[Descriptor, "_Future"]] = []
         self._closing = False
+        self._loop_gen = 0  # bumped at degrade/reform: zombie loops exit
         self._leader_thread: Optional[threading.Thread] = None
         self._ticker_thread: Optional[threading.Thread] = None
         self._last_send = time.monotonic()
         self.follower: Optional[GangFollower] = None
         metrics.gauge(metrics.MULTIHOST_DEGRADED, 0)
-        if self.active and rank == 0:
-            self._leader_thread = threading.Thread(
-                target=self._leader_loop, name="multihost-leader", daemon=True
+        metrics.gauge(metrics.MULTIHOST_STATE, _STATE_CODES[self.state])
+        metrics.gauge(metrics.MULTIHOST_EPOCH, self.epoch)
+        if self.active:
+            self.transitions.append(
+                {
+                    "from": STATE_FORMING,
+                    "to": STATE_ACTIVE,
+                    "reason": "gang formed",
+                    "t": time.time(),
+                }
             )
-            self._leader_thread.start()
+        if self.active and rank == 0:
+            self._start_leader_loop()
             if idle_interval > 0:
                 self._ticker_thread = threading.Thread(
                     target=self._tick_loop, name="multihost-ticker", daemon=True
                 )
                 self._ticker_thread.start()
+
+    @classmethod
+    def replicated(
+        cls,
+        apply_fn: Optional[Callable[[int, dict], Any]] = None,
+        dispatch_timeout: float = 30.0,
+        logger=None,
+    ) -> "MultiHostRuntime":
+        """A replicated-mode gang of ONE: the boot path for a restarted
+        gang LEADER (``federation-leader = true``). The old collective
+        plane died with its peers — gloo contexts cannot be rebuilt
+        in-process — so the node comes back solo: no jax.distributed,
+        a loopback channel nothing ever rides, ``active`` forced so the
+        leader thread orders writes, and DEGRADED until a follower
+        rejoins through reform()."""
+        rt = cls(
+            rank=0,
+            world=1,
+            channel=LoopbackChannel(),
+            apply_fn=apply_fn,
+            idle_interval=0,  # ticks only feed collective followers
+            dispatch_timeout=dispatch_timeout,
+            logger=logger,
+        )
+        rt.active = True
+        rt.mode = MODE_REPLICATED
+        rt.federated = True
+        rt.state = STATE_DEGRADED
+        rt.transitions.append(
+            {
+                "from": STATE_FORMING,
+                "to": STATE_DEGRADED,
+                "reason": "replicated-solo boot (no replicas yet)",
+                "t": time.time(),
+            }
+        )
+        metrics.gauge(metrics.MULTIHOST_DEGRADED, 1)
+        metrics.gauge(metrics.MULTIHOST_STATE, _STATE_CODES[STATE_DEGRADED])
+        rt._start_leader_loop()
+        return rt
+
+    @property
+    def degraded(self) -> bool:
+        """PR 5 compatibility view of the lifecycle state machine."""
+        return self.state == STATE_DEGRADED
+
+    def _start_leader_loop(self) -> None:
+        with self._mu:
+            gen = self._loop_gen
+        t = threading.Thread(
+            target=self._leader_loop, args=(gen,), name="multihost-leader", daemon=True
+        )
+        self._leader_thread = t
+        t.start()
 
     # -- shared ---------------------------------------------------------------
 
@@ -588,13 +840,78 @@ class MultiHostRuntime:
         """Should work on THIS thread be routed through the gang?
         Leader only, gang alive, and not already inside a gang replay
         (the leader thread and follower loop re-enter the same entry
-        points with this flag set)."""
-        return (
-            self.active
-            and not self.degraded
-            and self.rank == 0
-            and not self.in_gang_thread()
-        )
+        points with this flag set). A DEGRADED collective gang refuses
+        (PR 5 fail-fast); a DEGRADED replicated gang still dispatches —
+        the leader thread applies locally and redundancy returns at the
+        next reform()."""
+        if not (self.active and self.rank == 0 and not self.in_gang_thread()):
+            return False
+        if self.state == STATE_REFORMING:
+            # control messages apply locally-only during the (brief)
+            # re-form fence — the rejoin push carries full state anyway,
+            # and a 503 on a schema broadcast would fail the peer's op
+            return False
+        return not (self.degraded and self.mode == MODE_COLLECTIVE)
+
+    def should_dispatch_query(self, remote: bool, query_text: str = "") -> bool:
+        """Route decision for executor.execute — the decision table in
+        docs/multihost.md:
+
+        * single-plane gang (PR 5): dispatch everything that did NOT
+          arrive from another node — the gang replays all state.
+        * federated, collective mode: dispatch only the REMOTE legs —
+          a top-level query is first split across gangs by the cluster
+          plane, and each gang's local leg re-enters with remote=True.
+        * federated, replicated mode: reads run directly on the
+          leader's local mesh (no lockstep needed); only state-bearing
+          legs dispatch, so the leader thread can order and replicate
+          them.
+        """
+        if not (self.active and self.rank == 0 and not self.in_gang_thread()):
+            return False
+        if not self.federated:
+            return not remote and not self.degraded
+        if self.mode == MODE_COLLECTIVE:
+            # degraded-collective: refuse so the cluster plane fails
+            # the leg over to a replica gang instead of waiting
+            return remote and not self.degraded
+        return remote and bool(_WRITE_RE.search(query_text or ""))
+
+    def should_dispatch_import(self, local: bool = False) -> bool:
+        """Import routing: a single-plane gang broadcasts the TOP-LEVEL
+        import (the gang owns everything); a federated gang lets the
+        cluster plane route shard groups first and replays only the
+        LOCAL leg (the ``import_*_local`` entry points)."""
+        if not (self.active and self.rank == 0 and not self.in_gang_thread()):
+            return False
+        if self.federated:
+            if self.mode == MODE_COLLECTIVE and self.degraded:
+                return False
+            return local
+        return (not local) and not self.degraded
+
+    def _set_state(self, to: str, reason: str) -> None:
+        with self._mu:
+            frm = self.state
+            if frm == to:
+                return
+            self.state = to
+            self.transitions.append(
+                {"from": frm, "to": to, "reason": reason, "t": time.time()}
+            )
+            del self.transitions[:-16]
+            epoch = self.epoch
+        metrics.gauge(metrics.MULTIHOST_DEGRADED, 1 if to == STATE_DEGRADED else 0)
+        metrics.gauge(metrics.MULTIHOST_STATE, _STATE_CODES.get(to, -1))
+        if self.logger is not None:
+            self.logger.printf("multihost gang %s -> %s: %s", frm, to, reason)
+        hook = self.on_state_change
+        if hook is not None:
+            try:
+                hook(to, epoch)
+            except Exception as e:
+                if self.logger is not None:
+                    self.logger.printf("multihost state-change hook error: %s", e)
 
     # -- leader ---------------------------------------------------------------
 
@@ -605,7 +922,13 @@ class MultiHostRuntime:
         runtime and raises GangUnavailable."""
         fut = _Future()
         with self._mu:
-            if self._closing or self.degraded or not self.active:
+            refused = (
+                self._closing
+                or not self.active
+                or self.state == STATE_REFORMING
+                or (self.state == STATE_DEGRADED and self.mode == MODE_COLLECTIVE)
+            )
+            if refused:
                 raise GangUnavailable("multihost gang is not accepting work")
             self._queue.append((desc, fut))
             self._cond.notify_all()
@@ -633,32 +956,71 @@ class MultiHostRuntime:
             raise fut.error
         return fut.result
 
-    def _leader_loop(self) -> None:
+    def _leader_loop(self, gen: int = 0) -> None:
         self._enter_gang()
         while True:
             with self._mu:
-                while not self._queue and not self._closing:
+                while (
+                    not self._queue and not self._closing and gen == self._loop_gen
+                ):
                     self._cond.wait(timeout=0.5)
+                if gen != self._loop_gen:
+                    # superseded by a degrade/reform: the queue (and the
+                    # channel, if any) belong to the new loop now. A
+                    # zombie stuck in a dead collective send never gets
+                    # here — it just never touches new work.
+                    return
                 if self._closing and not self._queue:
                     return
                 desc, fut = self._queue.pop(0)
+                mode = self.mode
             t0 = time.monotonic()
-            try:
-                self._send(desc.kind, desc.encode())
-            except BaseException as e:
-                fut.error = GangUnavailable(f"gang broadcast failed: {e}")
-                fut.event.set()
-                self.degrade(f"broadcast failed: {e}")
-                return
-            metrics.observe(
-                metrics.MULTIHOST_BROADCAST_SECONDS, time.monotonic() - t0
-            )
+            if mode == MODE_COLLECTIVE:
+                try:
+                    self._send(desc.kind, desc.encode())
+                except BaseException as e:
+                    fut.error = GangUnavailable(f"gang broadcast failed: {e}")
+                    fut.event.set()
+                    self.degrade(f"broadcast failed: {e}")
+                    return
+                metrics.observe(
+                    metrics.MULTIHOST_BROADCAST_SECONDS, time.monotonic() - t0
+                )
             metrics.count(metrics.MULTIHOST_DISPATCHES, role="leader")
             try:
                 fut.result = self.apply_fn(desc.kind, desc.payload)
             except BaseException as e:
                 fut.error = e
+            if (
+                mode == MODE_REPLICATED
+                and desc.kind != KIND_TICK
+                and fut.error is None
+            ):
+                self._replicate(desc)
             fut.event.set()
+
+    def _replicate(self, desc: Descriptor) -> None:
+        """Replicated-mode fan-out: apply the descriptor on every gang
+        follower over HTTP, epoch-stamped so a stale (pre-re-form)
+        follower can never apply post-re-form work. A follower that
+        still fails after the client's own retries is dropped from the
+        gang and the lifecycle returns to DEGRADED — the leader keeps
+        serving solo, and the follower must rejoin (with a fresh state
+        sync) to count again."""
+        if self.replicate_fn is None:
+            return
+        with self._mu:
+            targets = list(self._replicas)
+            epoch = self.epoch
+        for uri in targets:
+            try:
+                self.replicate_fn(uri, desc.kind, desc.payload, epoch)
+            except Exception as e:
+                with self._mu:
+                    if uri in self._replicas:
+                        self._replicas.remove(uri)
+                metrics.count(metrics.MULTIHOST_ABORTS, role="replica")
+                self._set_state(STATE_DEGRADED, f"replica {uri} lost: {e}")
 
     def _send(self, kind: int, payload: bytes) -> None:
         self.channel.send(encode_message(kind, payload, self.frame_bytes))
@@ -671,7 +1033,9 @@ class MultiHostRuntime:
         while True:
             time.sleep(self.idle_interval / 2.0)
             with self._mu:
-                if self._closing or self.degraded:
+                # ticks only feed collective follower loops; a
+                # replicated gang has no collective to keep alive
+                if self._closing or self.degraded or self.mode != MODE_COLLECTIVE:
                     return
                 busy = bool(self._queue)
             if busy or time.monotonic() - self._last_send < self.idle_interval:
@@ -679,7 +1043,7 @@ class MultiHostRuntime:
             fut = _Future()
             desc = Descriptor(KIND_TICK, {"t": time.time()})
             with self._mu:
-                if self._closing or self.degraded:
+                if self._closing or self.degraded or self.mode != MODE_COLLECTIVE:
                     return
                 self._queue.append((desc, fut))
                 self._cond.notify_all()
@@ -715,27 +1079,93 @@ class MultiHostRuntime:
     # -- failure / lifecycle --------------------------------------------------
 
     def degrade(self, reason: str) -> None:
-        """Declare the gang dead: stop accepting dispatches, fail the
-        queue, and hand the executor back to a local mesh via
-        ``on_degrade``. Idempotent."""
+        """Fence the gang: fail queued work, stop collective dispatch,
+        and hand the executor a local mesh via ``on_degrade``.
+        Idempotent. A non-federated runtime stays DEGRADED until
+        process restart (PR 5 semantics); a federated runtime
+        immediately re-enters service in replicated-solo mode — the
+        cluster plane advertises DEGRADED so peers prefer other
+        replicas, and reform() restores ACTIVE when a follower
+        rejoins."""
         with self._mu:
-            if self.degraded:
+            if self.state in (STATE_DEGRADED, STATE_REFORMING):
                 return
-            self.degraded = True
             stale, self._queue = self._queue, []
+            self._loop_gen += 1  # a wedged leader loop must not touch new work
         for _, fut in stale:
             fut.error = GangUnavailable(f"multihost gang degraded: {reason}")
             fut.event.set()
         metrics.count(metrics.MULTIHOST_ABORTS, role="leader")
-        metrics.gauge(metrics.MULTIHOST_DEGRADED, 1)
-        if self.logger is not None:
-            self.logger.printf("multihost gang degraded: %s", reason)
+        self._set_state(STATE_DEGRADED, reason)
         if self.on_degrade is not None:
             try:
                 self.on_degrade()
             except Exception as e:
                 if self.logger is not None:
                     self.logger.printf("multihost degrade hook error: %s", e)
+        if self.federated and self.active and self.rank == 0:
+            # keep serving: replicated-solo on the local mesh the
+            # degrade hook just installed. Writes apply locally-only;
+            # redundancy returns via reform() on follower rejoin.
+            with self._mu:
+                if self._closing:
+                    return
+                self.mode = MODE_REPLICATED
+                self._replicas = []
+            self._start_leader_loop()
+
+    def reform(self, replicas: list[str], reason: str = "follower rejoin") -> dict:
+        """Re-form the gang around HTTP replication (leader only):
+        fence in-flight dispatches, bump the epoch (the fence that
+        keeps plan caches, delta logs, and stale repliers from
+        replaying pre-failure state), run the ``on_reform`` state
+        hooks, register the follower set, and return to ACTIVE in
+        replicated mode. Valid from DEGRADED (the normal path after a
+        follower death), from ACTIVE-replicated (another follower
+        joining), or from ACTIVE-collective (operator-forced: the
+        collective plane is abandoned for HTTP replication)."""
+        if not (self.active and self.rank == 0):
+            raise GangUnavailable("gang re-formation is a leader-side operation")
+        with self._mu:
+            if self._closing:
+                raise GangUnavailable("multihost runtime is closing")
+            stale, self._queue = self._queue, []
+            self._loop_gen += 1
+        for _, fut in stale:
+            fut.error = GangUnavailable("multihost gang re-forming — retry")
+            fut.event.set()
+        self._set_state(STATE_REFORMING, reason)
+        with self._mu:
+            self.epoch += 1
+            epoch = self.epoch
+        metrics.gauge(metrics.MULTIHOST_EPOCH, epoch)
+        if self.on_reform is not None:
+            try:
+                self.on_reform()
+            except Exception as e:
+                if self.logger is not None:
+                    self.logger.printf("multihost reform hook error: %s", e)
+        with self._mu:
+            self.mode = MODE_REPLICATED
+            self._replicas = list(replicas)
+        self._set_state(
+            STATE_ACTIVE, f"re-formed at epoch {epoch} ({len(replicas)} replicas)"
+        )
+        metrics.count(metrics.MULTIHOST_REFORMS)
+        self._start_leader_loop()
+        return {"epoch": epoch, "state": self.state, "mode": self.mode}
+
+    def health(self) -> dict:
+        """The gang block for /status: lifecycle at a glance."""
+        with self._mu:
+            last = self.transitions[-1] if self.transitions else None
+            return {
+                "state": self.state,
+                "mode": self.mode,
+                "epoch": self.epoch,
+                "replicas": list(self._replicas),
+                "lastTransition": dict(last) if last else None,
+            }
 
     def close(self) -> None:
         """Leader: drain the queue, broadcast the poison pill so
@@ -746,7 +1176,8 @@ class MultiHostRuntime:
                 return
             self._closing = True
             self._cond.notify_all()
-        if self.rank == 0 and self.active and not self.degraded:
+        degraded_collective = self.degraded and self.mode == MODE_COLLECTIVE
+        if self.rank == 0 and self.active and not degraded_collective:
             if self._leader_thread is not None:
                 self._leader_thread.join(timeout=self.dispatch_timeout)
                 if self._leader_thread.is_alive():
@@ -755,10 +1186,11 @@ class MultiHostRuntime:
                     # would desync framing; followers fall back to
                     # their own leader timeout instead
                     return
-            try:
-                self._send(KIND_POISON, b"")
-            except Exception:
-                pass  # followers fall back to their own leader timeout
+            if self.mode == MODE_COLLECTIVE:
+                try:
+                    self._send(KIND_POISON, b"")
+                except Exception:
+                    pass  # followers fall back to their own leader timeout
 
     def stats(self) -> dict:
         f = self.follower
@@ -767,6 +1199,12 @@ class MultiHostRuntime:
             "world": self.world,
             "active": self.active,
             "degraded": self.degraded,
+            "state": self.state,
+            "mode": self.mode,
+            "epoch": self.epoch,
+            "federated": self.federated,
+            "replicas": list(self._replicas),
+            "transitions": [dict(t) for t in self.transitions[-5:]],
             "queue_depth": len(self._queue),
             "follower": None
             if f is None
@@ -809,27 +1247,48 @@ def make_apply_fn(server) -> Callable[[int, dict], Any]:
                 _gang_opt(
                     exclude_row_attrs=opt_kw.get("exclude_row_attrs", False),
                     exclude_columns=opt_kw.get("exclude_columns", False),
+                    remote=opt_kw.get("remote", False),
                 ),
             )
         if kind == KIND_IMPORT:
-            server.api.import_bits(
-                payload["index"],
-                payload["field"],
-                payload["row_ids"],
-                payload["column_ids"],
-                payload.get("timestamps"),
-                payload.get("row_keys"),
-                payload.get("column_keys"),
-            )
+            # federated legs carry local=True: the cluster plane already
+            # routed the shard group here (and translated any keys), so
+            # the replay must apply as-is, never re-route
+            if payload.get("local"):
+                server.api.import_bits_local(
+                    payload["index"],
+                    payload["field"],
+                    payload["row_ids"],
+                    payload["column_ids"],
+                    payload.get("timestamps"),
+                )
+            else:
+                server.api.import_bits(
+                    payload["index"],
+                    payload["field"],
+                    payload["row_ids"],
+                    payload["column_ids"],
+                    payload.get("timestamps"),
+                    payload.get("row_keys"),
+                    payload.get("column_keys"),
+                )
             return None
         if kind == KIND_IMPORT_VALUES:
-            server.api.import_values(
-                payload["index"],
-                payload["field"],
-                payload["column_ids"],
-                payload["values"],
-                payload.get("column_keys"),
-            )
+            if payload.get("local"):
+                server.api.import_values_local(
+                    payload["index"],
+                    payload["field"],
+                    payload["column_ids"],
+                    payload["values"],
+                )
+            else:
+                server.api.import_values(
+                    payload["index"],
+                    payload["field"],
+                    payload["column_ids"],
+                    payload["values"],
+                    payload.get("column_keys"),
+                )
             return None
         if kind == KIND_MESSAGE:
             server.receive_message(payload)

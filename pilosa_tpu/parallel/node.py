@@ -16,14 +16,24 @@ class Node:
     uri: str  # http://host:port
     is_coordinator: bool = False
     state: str = "READY"
+    # federation: lifecycle of the gang this node leads ("" for plain
+    # nodes) — peers stop routing writes to a DEGRADED/REFORMING gang
+    # and prefer gang-ACTIVE owners for reads (parallel/federation.py)
+    gang_state: str = ""
+    gang_epoch: int = 0
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "id": self.id,
             "uri": self.uri,
             "isCoordinator": self.is_coordinator,
             "state": self.state,
         }
+        # optional-keyed: plain-cluster payloads stay byte-stable
+        if self.gang_state:
+            d["gangState"] = self.gang_state
+            d["gangEpoch"] = self.gang_epoch
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "Node":
@@ -32,4 +42,6 @@ class Node:
             uri=d["uri"],
             is_coordinator=d.get("isCoordinator", False),
             state=d.get("state", "READY"),
+            gang_state=d.get("gangState", ""),
+            gang_epoch=d.get("gangEpoch", 0),
         )

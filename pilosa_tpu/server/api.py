@@ -246,16 +246,18 @@ class API:
 
     # -- imports (reference api.Import:652-696) --
 
-    def _gang_import(self, op: str, payload: dict) -> bool:
+    def _gang_import(self, op: str, payload: dict, local: bool = False) -> bool:
         """Multihost leader: broadcast an import descriptor so every
         rank's holder replays the identical mutation; True when the
         gang handled it (the leader thread and every follower re-enter
         this method with the gang flag set and fall through to the
-        local body). timestamps may be datetimes on internal callers —
-        gang payloads are JSON, so those callers (cluster legs) never
-        run in multihost mode."""
+        local body). In a FEDERATED deployment the cluster plane routes
+        shard groups first, so only the ``import_*_local`` legs
+        (local=True) replay through the gang. timestamps may be
+        datetimes on internal callers — gang payloads are JSON, so
+        those callers (cluster legs) never run in multihost mode."""
         mh = getattr(self.server, "multihost", None) if self.server else None
-        if mh is None or not mh.should_dispatch():
+        if mh is None or not mh.should_dispatch_import(local):
             return False
         from pilosa_tpu.parallel.multihost import (
             Descriptor,
@@ -318,7 +320,22 @@ class API:
         f.import_bits(row_ids, column_ids, parsed_ts)
 
     def import_bits_local(self, index, field, row_ids, column_ids, timestamps=None):
-        """Internal: import bits into this node only (owner-side leg)."""
+        """Internal: import bits into this node only (owner-side leg).
+        On a federated gang leader this leg replays through the gang so
+        follower holders receive the identical shard group."""
+        if self._gang_import(
+            "import",
+            {
+                "index": index,
+                "field": field,
+                "row_ids": list(row_ids),
+                "column_ids": list(column_ids),
+                "timestamps": list(timestamps) if timestamps else None,
+                "local": True,
+            },
+            local=True,
+        ):
+            return
         f = self.holder.field(index, field)
         if f is None:
             raise NotFoundError(f"field not found: {field}")
@@ -382,7 +399,11 @@ class API:
                 vals = [values[i] for i in idxs]
                 for node in self.cluster.shard_nodes(index, shard):
                     if node.id == self.cluster.node_id:
-                        f.import_values(cols, vals)
+                        # through the local entry point, not f.import_values:
+                        # on a federated gang leader the owner-side leg must
+                        # replay through the gang so follower holders stay
+                        # bit-identical (same as _route_import for bits)
+                        self.import_values_local(index, field, cols, vals)
                     else:
                         self.cluster.client.import_values_local(
                             node.uri, index, field, cols, vals
@@ -391,6 +412,18 @@ class API:
         f.import_values(column_ids, values)
 
     def import_values_local(self, index, field, column_ids, values):
+        if self._gang_import(
+            "import_values",
+            {
+                "index": index,
+                "field": field,
+                "column_ids": list(column_ids),
+                "values": list(values),
+                "local": True,
+            },
+            local=True,
+        ):
+            return
         f = self.holder.field(index, field)
         if f is None:
             raise NotFoundError(f"field not found: {field}")
@@ -605,6 +638,11 @@ class API:
             "nodes": nodes,
             "localID": getattr(self.cluster, "node_id", "") if self.cluster else "",
         }
+        # gang health (ISSUE 7 bugfix): a degraded gang was previously
+        # indistinguishable from a healthy one on the public route
+        mh = getattr(self.server, "multihost", None) if self.server else None
+        if mh is not None:
+            out["gang"] = mh.health()
         job = (
             self.cluster.resize_job_status()
             if self.cluster is not None and hasattr(self.cluster, "resize_job_status")
@@ -636,6 +674,20 @@ class API:
         if self.server is None:
             raise APIError("cluster not configured")
         self.server.receive_message(msg)
+
+    def gang_apply(self, kind: int, payload: dict, epoch: int) -> None:
+        """Replicated-mode gang follower: apply one epoch-stamped
+        descriptor pushed by the gang leader (parallel/federation.py)."""
+        if self.server is None:
+            raise APIError("gang not configured")
+        self.server.gang_apply(kind, payload, epoch)
+
+    def gang_rejoin(self, follower_uri: str) -> dict:
+        """Gang leader: re-form the gang around a re-staged follower;
+        returns the post-re-form health block (new epoch included)."""
+        if self.server is None:
+            raise APIError("gang not configured")
+        return self.server.gang_rejoin(follower_uri)
 
     def set_coordinator(self, node_id: str) -> None:
         self._validate("set_coordinator")

@@ -115,6 +115,33 @@ class Config:
     # follower-side bound on leader silence before the worker loop
     # aborts cleanly instead of waiting forever
     distributed_leader_timeout: float = 120.0
+    # fault injection on the gang control channel (tests/dryruns only):
+    # "drop_every=N,dup_every=N,delay=S,after=N" — see
+    # multihost.FaultSpec; "" disables
+    distributed_faults: str = ""
+    # federation (parallel/federation.py): composing the gang plane
+    # with the cluster plane. A federated deployment sets cluster.hosts
+    # to the gang LEADER URIs; each leader is one cluster node owning
+    # its gang's shard range.
+    # rejoin target: a restarted follower boots non-distributed with
+    # this set to its gang leader's URI, re-stages holder state from
+    # the leader, and announces itself for re-formation; "" disables
+    federation_rejoin: str = ""
+    # restarted gang LEADER: boot non-distributed but keep the gang
+    # plane alive in replicated-solo mode (DEGRADED until a follower
+    # rejoins) so the node re-enters the federation without a working
+    # collective plane — the dead peers poisoned the old one
+    federation_leader: bool = False
+    # upper bound (seconds) for one re-formation pass (fragment
+    # re-sync + epoch bump + ACTIVE); used by operators/harnesses as
+    # the recovery budget and by the rejoin boot path as its sync
+    # deadline
+    federation_reform_budget: float = 30.0
+    # cross-gang RPC retry policy (parallel/client.py): transient
+    # transport failures / 503s retry with capped exponential backoff
+    # + jitter, bounded by the request deadline
+    client_retries: int = 2
+    client_retry_backoff: float = 0.05
     # cluster
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     # TLS on the listener + internal client (reference server.go:166-240)
@@ -243,6 +270,11 @@ class Config:
             f'distributed-coordinator = "{self.distributed_coordinator}"',
             f"distributed-num-processes = {self.distributed_num_processes}",
             f"distributed-dispatch-timeout = {self.distributed_dispatch_timeout}",
+            f'federation-rejoin = "{self.federation_rejoin}"',
+            f"federation-leader = {'true' if self.federation_leader else 'false'}",
+            f"federation-reform-budget = {self.federation_reform_budget}",
+            f"client-retries = {self.client_retries}",
+            f"client-retry-backoff = {self.client_retry_backoff}",
             f'metric = "{self.metric}"',
             f"trace-sample-rate = {self.trace_sample_rate}",
             f"slow-query-time = {self.slow_query_time}",

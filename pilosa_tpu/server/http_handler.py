@@ -172,6 +172,8 @@ class Handler:
             Route("GET", r"/internal/shards/max", lambda req: {"standard": a.max_shards()}),
             Route("GET", r"/internal/fragments", lambda req: a.fragment_inventory()),
             Route("POST", r"/internal/probe", self.post_probe),
+            Route("POST", r"/internal/gang/apply", self.post_gang_apply),
+            Route("POST", r"/internal/gang/rejoin", self.post_gang_rejoin),
             Route("GET", r"/internal/translate/data", self.get_translate_data),
             Route("POST", r"/internal/translate/keys", self.post_translate_keys),
             Route(
@@ -538,6 +540,23 @@ class Handler:
         body = json.loads(req.body or b"{}")
         _require(body, "uri")
         return {"alive": self.api.probe_node(body["uri"])}
+
+    def post_gang_apply(self, req) -> dict:
+        """Replicated-mode gang replication: apply one epoch-stamped
+        descriptor from the gang leader (409 on a stale epoch)."""
+        body = json.loads(req.body or b"{}")
+        _require(body, "kind")
+        self.api.gang_apply(
+            int(body["kind"]), body.get("payload") or {}, int(body.get("epoch", 0))
+        )
+        return {}
+
+    def post_gang_rejoin(self, req) -> dict:
+        """A re-staged follower announcing itself; the leader re-forms
+        the gang around it and returns the new epoch."""
+        body = json.loads(req.body or b"{}")
+        _require(body, "uri")
+        return self.api.gang_rejoin(body["uri"])
 
     def get_translate_data(self, req):
         q = req.query

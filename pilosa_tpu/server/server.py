@@ -203,6 +203,11 @@ class Server:
             plan_cache=self.plan_cache,
         )
         self.api = API(self.holder, self.executor, cluster=cluster, server=self)
+        # federation (parallel/federation.py): epoch adopted from the
+        # gang leader at rejoin; -1 = never joined, every epoch-stamped
+        # apply is refused until the leader's state push lands
+        self.gang_epoch = -1
+        self._gang_apply_fn = None
         if self.config.distributed_enabled:
             from pilosa_tpu.parallel.multihost import (
                 MultiHostRuntime,
@@ -219,10 +224,27 @@ class Server:
                 leader_timeout=self.config.distributed_leader_timeout,
                 on_degrade=self._degrade_to_local_mesh,
                 logger=self.logger,
+                faults=self.config.distributed_faults,
             )
             # the executor routes every non-remote query through the
             # gang on the leader; followers re-enter execute() from the
             # worker loop with the in-gang flag set
+            self.executor.gang = self.multihost
+        elif self.config.federation_leader:
+            # restarted gang leader: the old collective plane died with
+            # its peers (a poisoned gloo context cannot be rebuilt
+            # in-process), so come back replicated-solo — DEGRADED until
+            # a follower rejoins through /internal/gang/rejoin
+            from pilosa_tpu.parallel.multihost import (
+                MultiHostRuntime,
+                make_apply_fn,
+            )
+
+            self.multihost = MultiHostRuntime.replicated(
+                apply_fn=make_apply_fn(self),
+                dispatch_timeout=self.config.distributed_dispatch_timeout,
+                logger=self.logger,
+            )
             self.executor.gang = self.multihost
         # serving pipeline (server/pipeline.py): every query/import
         # request flows through bounded per-class admission queues with
@@ -351,7 +373,11 @@ class Server:
             delta_max_ratio=self.config.stager_delta_max_ratio,
         )
         ex = self.executor
-        ex.gang = None
+        if self.multihost is None or not self.multihost.federated:
+            # PR 5 single-plane semantics: the gang is gone for good.
+            # A FEDERATED runtime keeps the gang attached — it re-enters
+            # service replicated-solo and reform() needs the hook chain.
+            ex.gang = None
         with ex._spmd_mu:
             ex._spmd_kernels = {}
         ex.mesh = mesh
@@ -420,20 +446,38 @@ class Server:
             "pilosa_tpu server listening on %s://%s:%d", self.scheme, *self.address()
         )
         if self.cluster is None and not self.config.cluster.disabled:
-            if self.config.distributed_enabled:
-                # one distribution plane at a time: the gang replays all
-                # state to every rank, so layering the HTTP cluster's
-                # shard placement on top would double-route work
+            if self.config.distributed_enabled and self._mh_rank != 0:
+                # federation: the cluster plane runs on gang LEADERS
+                # only — a follower's holder is a replica of its
+                # leader's, reachable through the leader
                 self.logger.printf(
-                    "cluster config ignored: distributed-enabled runs the "
-                    "multihost gang plane instead"
+                    "federation: rank %d leaves the cluster plane to its "
+                    "gang leader",
+                    self._mh_rank,
                 )
             else:
+                if self.config.distributed_enabled:
+                    self.logger.printf(
+                        "federation: gang leader joins the cluster plane "
+                        "(sharded gang federation)"
+                    )
                 self.cluster = self._build_cluster()
         if self.cluster is not None:
             self.executor.cluster = self.cluster
             self.api.cluster = self.cluster
             self.cluster.attach_server(self)
+            if self.multihost is not None:
+                # compose the planes: gang-replaying local executor,
+                # replication + epoch-fence + state-gossip hooks
+                from pilosa_tpu.parallel import federation
+
+                federation.wire(self)
+        if self.config.federation_rejoin:
+            # restarted follower: announce to the gang leader off-thread
+            # (the leader's schema/fragment push needs OUR listener)
+            from pilosa_tpu.parallel import federation
+
+            federation.start_rejoin(self)
         # measure the device-policy crossover for THIS deployment
         # (dispatch RTT / per-container CPU cost) unless the operator
         # pinned one via config or env — measured beats guessed
@@ -838,16 +882,20 @@ class Server:
         view.go:216-247 CreateShardMessage)."""
         self.send_async({"type": "create-shard", "index": index, "shard": shard})
 
-    def _gang_message(self, msg: dict) -> None:
+    def _gang_message(self, msg: dict) -> bool:
         """Replicate a broadcast message to the multihost gang: schema
         ops and shard announcements must reach follower holders the
         same way cluster peers get them. No-op inside a gang replay
-        (followers apply the op themselves) and after degrade."""
+        (followers apply the op themselves) and after degrade. Returns
+        True when the message WAS gang-dispatched — the replay applies
+        it locally, so the caller must not apply it again."""
         mh = self.multihost
         if mh is not None and mh.should_dispatch():
             from pilosa_tpu.parallel.multihost import Descriptor, KIND_MESSAGE
 
             mh.dispatch(Descriptor(KIND_MESSAGE, msg))
+            return True
+        return False
 
     def send_sync(self, msg: dict) -> None:
         self._gang_message(msg)
@@ -863,9 +911,51 @@ class Server:
         if self.cluster is not None:
             self.cluster.send_to(node, msg)
 
+    # -- federation (parallel/federation.py) --
+
+    def gang_apply(self, kind: int, payload: dict, epoch: int) -> None:
+        """Replicated-mode follower: apply one descriptor pushed by the
+        gang leader. The epoch is the staleness fence — a LOWER epoch
+        is a pre-re-form descriptor (a stale leader thread, a delayed
+        frame) and must never land on post-re-form state (409, the
+        sender rejoins). A HIGHER epoch is adopted: the leader only
+        replicates to followers it just re-staged, and the bump may
+        race the rejoin response that carries it."""
+        from pilosa_tpu.server.api import APIError
+
+        if epoch < self.gang_epoch:
+            raise APIError(
+                f"gang epoch mismatch: have {self.gang_epoch}, got {epoch} "
+                "— stale descriptor refused, sender must re-form",
+                status=409,
+            )
+        if epoch > self.gang_epoch:
+            self.logger.printf(
+                "gang epoch %d -> %d (leader re-formed)", self.gang_epoch, epoch
+            )
+            self.gang_epoch = epoch
+        if self._gang_apply_fn is None:
+            from pilosa_tpu.parallel.multihost import make_apply_fn
+
+            self._gang_apply_fn = make_apply_fn(self)
+        self._gang_apply_fn(kind, payload)
+
+    def gang_rejoin(self, follower_uri: str) -> dict:
+        """Gang leader: re-form around a re-staged follower (anti-
+        entropy catch-up, schema + fragment push, epoch bump, ACTIVE)."""
+        from pilosa_tpu.parallel import federation
+
+        return federation.handle_rejoin(self, follower_uri)
+
     # -- message application (reference Server.ReceiveMessage:435-517) --
 
     def receive_message(self, msg: dict) -> None:
+        # a message arriving from a cluster PEER replays through the
+        # gang first, so this gang's followers see the same schema ops
+        # its leader does; the replay re-enters here with the in-gang
+        # flag set and falls through to the local apply below
+        if self._gang_message(msg):
+            return
         typ = msg.get("type")
         if typ == "create-index":
             self.holder.create_index_if_not_exists(

@@ -114,12 +114,18 @@ PLANCACHE_BYTES = "plancache.bytes"
 # distributed map-reduce
 CLUSTER_MAP_REMOTE_SECONDS = "cluster.map_remote_seconds"
 CLUSTER_REMOTE_ERRORS = "cluster.remote_errors"
+# internal HTTP client retry layer (parallel/client.py)
+CLIENT_RETRIES = "client.retries"
+CLIENT_RETRY_EXHAUSTED = "client.retry_exhausted"
 # multihost gang dispatch (parallel/multihost.py)
 MULTIHOST_DISPATCHES = "multihost.dispatches"
 MULTIHOST_BROADCAST_SECONDS = "multihost.broadcast_seconds"
 MULTIHOST_TICKS = "multihost.ticks"
 MULTIHOST_ABORTS = "multihost.aborts"
 MULTIHOST_DEGRADED = "multihost.degraded"
+MULTIHOST_STATE = "multihost.state"
+MULTIHOST_EPOCH = "multihost.epoch"
+MULTIHOST_REFORMS = "multihost.reforms"
 MULTIHOST_FOLLOWER_LAG_SECONDS = "multihost.follower_lag_seconds"
 MULTIHOST_FOLLOWER_ERRORS = "multihost.follower_errors"
 # serving pipeline (server/pipeline.py)
@@ -242,6 +248,15 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter",
         "remote map-reduce legs that failed and re-mapped onto replicas (label: node)",
     ),
+    CLIENT_RETRIES: (
+        "counter",
+        "internal HTTP requests retried after a transient failure (label: op)",
+    ),
+    CLIENT_RETRY_EXHAUSTED: (
+        "counter",
+        "internal HTTP requests that failed after exhausting all retries "
+        "(label: op)",
+    ),
     MULTIHOST_DISPATCHES: (
         "counter",
         "gang work descriptors dispatched (leader) / applied (follower) "
@@ -264,6 +279,18 @@ METRICS: dict[str, tuple[str, str]] = {
     MULTIHOST_DEGRADED: (
         "gauge",
         "1 after the gang degraded to the local mesh, else 0",
+    ),
+    MULTIHOST_STATE: (
+        "gauge",
+        "gang lifecycle state: 0=FORMING 1=ACTIVE 2=DEGRADED 3=REFORMING",
+    ),
+    MULTIHOST_EPOCH: (
+        "gauge",
+        "gang epoch, bumped on every re-formation to fence stale replay",
+    ),
+    MULTIHOST_REFORMS: (
+        "counter",
+        "gang re-formations completed (DEGRADED/REFORMING back to ACTIVE)",
     ),
     MULTIHOST_FOLLOWER_LAG_SECONDS: (
         "summary",

@@ -1,0 +1,619 @@
+"""Sharded gang federation tests (ISSUE 7): fault-injection hooks on
+the gang channel (abort-under-loss, fence-under-delay), the gang
+lifecycle state machine (degrade → replicated-solo → reform → ACTIVE,
+never degrade-forever), cross-gang RPC retries, gang-state gossip on
+the cluster plane, and an in-process federated leader + follower
+rejoin cycle over real HTTP — plus a slow 2-gang × 2-process kill /
+recover run (the dryrun driver in quick mode)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.parallel import federation, multihost
+from pilosa_tpu.parallel.client import ClientError, InternalClient, _retryable
+from pilosa_tpu.parallel.multihost import (
+    Descriptor,
+    FaultSpec,
+    FaultyChannel,
+    GangFollower,
+    GangUnavailable,
+    KIND_QUERY,
+    LoopbackChannel,
+    MODE_COLLECTIVE,
+    MODE_REPLICATED,
+    MultiHostRuntime,
+    STATE_ACTIVE,
+    STATE_DEGRADED,
+    STATE_REFORMING,
+    encode_message,
+    maybe_faulty,
+)
+from pilosa_tpu.parallel.node import Node
+from pilosa_tpu.utils import metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- fault injection (satellite: env/config-gated channel faults) -------------
+
+
+class TestFaultSpec:
+    def test_parse_all_knobs(self):
+        s = FaultSpec.parse("drop_every=3, delay=0.25, dup_every=5, after=10")
+        assert s.drop_every == 3
+        assert s.dup_every == 5
+        assert s.delay == 0.25
+        assert s.after == 10
+        assert bool(s)
+
+    def test_parse_empty_is_falsy(self):
+        assert not FaultSpec.parse("")
+        assert not FaultSpec.parse("after=5")  # an offset alone faults nothing
+
+    def test_parse_unknown_knob_rejected(self):
+        with pytest.raises(ValueError):
+            FaultSpec.parse("explode_every=2")
+
+    def test_maybe_faulty(self):
+        ch = LoopbackChannel(1024)
+        assert maybe_faulty(ch, "") is ch
+        wrapped = maybe_faulty(ch, "drop_every=2")
+        assert isinstance(wrapped, FaultyChannel)
+        assert wrapped.frame_bytes == 1024
+
+    def test_runtime_faults_param_wraps_channel(self):
+        rt = MultiHostRuntime(
+            rank=0, world=1, channel=LoopbackChannel(1024),
+            apply_fn=lambda k, p: None, faults="drop_every=3",
+        )
+        assert isinstance(rt.channel, FaultyChannel)
+
+
+class TestChannelFaults:
+    def test_drop_aborts_follower_as_desync(self):
+        """A dropped (zeroed) frame reads as bad magic — the follower
+        must abort the loop cleanly ('desync'), never apply garbage."""
+        ch = FaultyChannel(LoopbackChannel(2048), FaultSpec(drop_every=1))
+        ch.send(encode_message(KIND_QUERY, json.dumps({"n": 1}).encode(), 2048))
+        f = GangFollower(ch, lambda k, p: None, leader_timeout=5.0)
+        assert f.run() == "desync"
+        assert f.works == 0
+
+    def test_duplicate_frame_detected_as_desync(self):
+        """Duplicate delivery inside a multi-frame message breaks seq
+        continuity — detected, not silently double-applied."""
+        ch = FaultyChannel(LoopbackChannel(512), FaultSpec(dup_every=1))
+        blob = json.dumps({"pad": "x" * 2000}).encode()  # several frames
+        ch.send(encode_message(KIND_QUERY, blob, 512))
+        f = GangFollower(ch, lambda k, p: None, leader_timeout=5.0)
+        assert f.run() == "desync"
+
+    def test_after_offset_lets_bringup_pass(self):
+        """after=K: the first K frames fly clean (bring-up traffic),
+        then the schedule starts."""
+        inner = LoopbackChannel(2048)
+        ch = FaultyChannel(inner, FaultSpec(drop_every=1, after=2))
+        applied = []
+        for i in range(3):
+            ch.send(encode_message(KIND_QUERY, json.dumps({"n": i}).encode(), 2048))
+        f = GangFollower(ch, lambda k, p: applied.append(p["n"]), leader_timeout=0.3)
+        assert f.run() == "desync"  # third frame was zeroed
+        assert applied == [0, 1]
+
+    def test_delay_trips_dispatch_fence(self):
+        """fence-under-delay: a send slower than dispatch_timeout turns
+        into the designed degrade + GangUnavailable, never a hang."""
+        ch = FaultyChannel(LoopbackChannel(2048), FaultSpec(delay=5.0))
+        rt = MultiHostRuntime(
+            rank=0, world=2, channel=ch,
+            apply_fn=lambda k, p: "never", idle_interval=0,
+            dispatch_timeout=0.3,
+        )
+        t0 = time.monotonic()
+        with pytest.raises(GangUnavailable):
+            rt.dispatch(Descriptor(KIND_QUERY, {}))
+        assert time.monotonic() - t0 < 3.0
+        assert rt.degraded
+
+
+# -- lifecycle state machine --------------------------------------------------
+
+
+def _runtime(federated=True, **kw):
+    kw.setdefault("channel", LoopbackChannel(4096))
+    kw.setdefault("apply_fn", lambda k, p: p.get("n"))
+    kw.setdefault("idle_interval", 0)
+    kw.setdefault("dispatch_timeout", 5.0)
+    rt = MultiHostRuntime(rank=0, world=2, **kw)
+    rt.federated = federated
+    return rt
+
+
+class TestLifecycle:
+    def test_federated_degrade_enters_replicated_solo(self):
+        """Follower death on a FEDERATED gang is not the end: the
+        leader re-enters service replicated-solo — DEGRADED (peers
+        route around it) but still dispatching."""
+        hooks = []
+        rt = _runtime()
+        rt.on_degrade = lambda: hooks.append("degrade")
+        rt.degrade("follower died")
+        assert rt.state == STATE_DEGRADED and rt.degraded
+        assert rt.mode == MODE_REPLICATED
+        assert hooks == ["degrade"]
+        assert rt.should_dispatch()
+        assert rt.dispatch(Descriptor(KIND_QUERY, {"n": 7})) == 7
+        rt.close()
+
+    def test_nonfederated_degrade_stays_dead(self):
+        """PR 5 single-plane semantics preserved: without a federation,
+        DEGRADED-collective refuses dispatch until process restart."""
+        rt = _runtime(federated=False)
+        rt.degrade("follower died")
+        assert rt.mode == MODE_COLLECTIVE
+        assert not rt.should_dispatch()
+        with pytest.raises(GangUnavailable):
+            rt.dispatch(Descriptor(KIND_QUERY, {}))
+
+    def test_reform_bumps_epoch_and_returns_active(self):
+        events = []
+        rt = _runtime()
+        rt.on_reform = lambda: events.append("reform")
+        rt.on_state_change = lambda st, ep: events.append((st, ep))
+        rt.degrade("follower died")
+        out = rt.reform(["http://f:1"], reason="follower rejoined")
+        assert out == {"epoch": 1, "state": STATE_ACTIVE, "mode": MODE_REPLICATED}
+        assert rt.epoch == 1 and rt.state == STATE_ACTIVE
+        assert "reform" in events
+        # DEGRADED -> REFORMING -> ACTIVE announced in order
+        states = [e[0] for e in events if isinstance(e, tuple)]
+        assert states == [STATE_DEGRADED, STATE_REFORMING, STATE_ACTIVE]
+        h = rt.health()
+        assert h["state"] == STATE_ACTIVE and h["epoch"] == 1
+        assert h["replicas"] == ["http://f:1"]
+        assert h["lastTransition"]["to"] == STATE_ACTIVE
+        # dispatch works again, and the transition log kept the history
+        assert rt.dispatch(Descriptor(KIND_QUERY, {"n": 3})) == 3
+        arcs = [(t["from"], t["to"]) for t in rt.transitions]
+        assert (STATE_ACTIVE, STATE_DEGRADED) in arcs
+        assert (STATE_REFORMING, STATE_ACTIVE) in arcs
+        rt.close()
+
+    def test_reform_fences_inflight_dispatch(self):
+        """Work queued behind an in-flight dispatch gets the bounded
+        GangUnavailable when reform fences the queue; the new epoch's
+        loop serves fresh work."""
+        gate = threading.Event()
+        started = threading.Event()
+
+        def apply(kind, payload):
+            if payload.get("block"):
+                started.set()
+                gate.wait(timeout=10)
+            return payload.get("n")
+
+        rt = _runtime(apply_fn=apply)
+        rt.federated = True
+        errs, out = [], []
+
+        def d(payload):
+            try:
+                out.append(rt.dispatch(Descriptor(KIND_QUERY, payload)))
+            except GangUnavailable as e:
+                errs.append(e)
+
+        t1 = threading.Thread(target=d, args=({"block": True, "n": 1},))
+        t1.start()
+        assert started.wait(timeout=5)
+        t2 = threading.Thread(target=d, args=({"n": 2},))  # queued behind
+        t2.start()
+        time.sleep(0.1)
+        rt.reform(["http://f:1"], reason="operator")
+        t2.join(timeout=5)
+        assert len(errs) == 1 and "re-forming" in str(errs[0])
+        gate.set()
+        t1.join(timeout=5)
+        assert out == [1]  # in-flight work completed under the old loop
+        assert rt.dispatch(Descriptor(KIND_QUERY, {"n": 9})) == 9
+        rt.close()
+
+    def test_replica_loss_degrades_and_recovers_again(self):
+        """Double failure: the re-formed replica dies too — the gang
+        returns to DEGRADED (solo), keeps serving, and a second reform
+        recovers it. No degrade-forever path."""
+        rt = _runtime()
+        rt.degrade("follower died")
+        rt.reform(["http://f:1"])
+        calls = []
+
+        def replicate(uri, kind, payload, epoch):
+            calls.append((uri, epoch))
+            raise ClientError("connection refused", transport=True)
+
+        rt.replicate_fn = replicate
+        assert rt.dispatch(Descriptor(KIND_QUERY, {"n": 1})) == 1
+        assert calls == [("http://f:1", 1)]
+        assert rt.state == STATE_DEGRADED
+        assert rt.health()["replicas"] == []
+        # still serving solo, and a second reform returns ACTIVE
+        assert rt.dispatch(Descriptor(KIND_QUERY, {"n": 2})) == 2
+        out = rt.reform(["http://f:2"])
+        assert out["epoch"] == 2 and rt.state == STATE_ACTIVE
+        rt.close()
+
+    def test_replicated_classmethod_boot(self):
+        """A restarted leader boots replicated-solo: active without
+        jax.distributed, DEGRADED until a follower rejoins."""
+        rt = MultiHostRuntime.replicated(apply_fn=lambda k, p: p["n"] * 2)
+        assert rt.active and rt.rank == 0 and rt.world == 1
+        assert rt.state == STATE_DEGRADED
+        assert rt.mode == MODE_REPLICATED and rt.federated
+        assert rt.dispatch(Descriptor(KIND_QUERY, {"n": 4})) == 8
+        out = rt.reform(["http://f:1"])
+        assert out["state"] == STATE_ACTIVE and out["epoch"] == 1
+        rt.close()
+
+
+# -- dispatch decision tables -------------------------------------------------
+
+
+class TestDispatchTables:
+    def test_query_table_single_plane(self):
+        rt = _runtime(federated=False)
+        assert rt.should_dispatch_query(remote=False)
+        assert not rt.should_dispatch_query(remote=True)
+        rt.degrade("dead")
+        assert not rt.should_dispatch_query(remote=False)
+
+    def test_query_table_federated_collective(self):
+        rt = _runtime()
+        # cluster plane splits first; only the routed legs replay
+        assert rt.should_dispatch_query(remote=True, query_text="Count(Row(f=1))")
+        assert not rt.should_dispatch_query(remote=False)
+
+    def test_query_table_federated_replicated(self):
+        rt = _runtime()
+        rt.degrade("dead")  # -> replicated-solo
+        # reads run straight on the local mesh; writes order + replicate
+        assert not rt.should_dispatch_query(remote=True, query_text="Count(Row(f=1))")
+        assert rt.should_dispatch_query(remote=True, query_text="Set(10, f=1)")
+        assert rt.should_dispatch_query(remote=True, query_text="SetValue(f=10, 7)")
+        assert rt.should_dispatch_query(remote=True, query_text="Clear(10, f=1)")
+        assert rt.should_dispatch_query(
+            remote=True, query_text='SetRowAttrs(f, 1, x="y")'
+        )
+        assert not rt.should_dispatch_query(remote=True, query_text="TopN(f, n=5)")
+        rt.close()
+
+    def test_import_table(self):
+        single = _runtime(federated=False)
+        assert single.should_dispatch_import(local=False)
+        assert not single.should_dispatch_import(local=True)
+        fed = _runtime()
+        assert fed.should_dispatch_import(local=True)
+        assert not fed.should_dispatch_import(local=False)
+        fed.degrade("dead")  # replicated-solo still applies local legs
+        assert fed.should_dispatch_import(local=True)
+        fed.close()
+
+    def test_reforming_refuses_and_degraded_collective_refuses(self):
+        rt = _runtime()
+        rt.state = STATE_REFORMING
+        # control messages apply locally-only during the re-form fence;
+        # data paths still route to dispatch(), which raises the
+        # bounded GangUnavailable (the 503 the fence is made of)
+        assert not rt.should_dispatch()
+        assert rt.should_dispatch_import(local=True)
+        with pytest.raises(GangUnavailable):
+            rt.dispatch(Descriptor(KIND_QUERY, {}))
+        rt.state = STATE_ACTIVE
+        rt2 = _runtime()
+        rt2.federated = True
+        rt2.mode = MODE_COLLECTIVE
+        rt2.state = STATE_DEGRADED
+        assert not rt2.should_dispatch_query(remote=True, query_text="Count(Row(f=1))")
+        assert not rt2.should_dispatch_import(local=True)
+
+
+# -- cross-gang RPC retries (satellite: backoff + jitter + deadline) ----------
+
+
+class TestClientRetry:
+    def _fail_then_ok(self, failures, exc):
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] <= failures:
+                raise exc
+            return "ok"
+
+        return fn, calls
+
+    def test_retryable_classification(self):
+        assert _retryable(ClientError("x", transport=True))
+        assert _retryable(ClientError("x", status=503))
+        assert not _retryable(ClientError("x", status=400))
+        assert not _retryable(ClientError("x", status=409))
+
+    def test_transient_503_retried_then_succeeds(self):
+        c = InternalClient(retries=2, retry_backoff=0.001)
+        fn, calls = self._fail_then_ok(2, ClientError("fencing", status=503))
+        before = metrics.snapshot().get("client.retries;op:t1", 0)
+        assert c._with_retry("t1", fn) == "ok"
+        assert calls["n"] == 3
+        assert metrics.snapshot().get("client.retries;op:t1", 0) == before + 2
+
+    def test_exhausted_raises_and_counts(self):
+        c = InternalClient(retries=2, retry_backoff=0.001)
+        fn, calls = self._fail_then_ok(99, ClientError("down", transport=True))
+        before = metrics.snapshot().get("client.retry_exhausted;op:t2", 0)
+        with pytest.raises(ClientError):
+            c._with_retry("t2", fn)
+        assert calls["n"] == 3  # initial + 2 retries
+        assert metrics.snapshot().get("client.retry_exhausted;op:t2", 0) == before + 1
+
+    def test_deterministic_errors_not_retried(self):
+        c = InternalClient(retries=3, retry_backoff=0.001)
+        fn, calls = self._fail_then_ok(99, ClientError("bad query", status=400))
+        with pytest.raises(ClientError):
+            c._with_retry("t3", fn)
+        assert calls["n"] == 1
+
+    def test_zero_retries_is_one_shot(self):
+        c = InternalClient(retries=0)
+        fn, calls = self._fail_then_ok(99, ClientError("down", transport=True))
+        with pytest.raises(ClientError):
+            c._with_retry("t4", fn)
+        assert calls["n"] == 1
+
+    def test_deadline_fences_backoff(self):
+        """A retry whose backoff cannot fit the remaining request
+        budget is not attempted — fail over instead of a doomed wait."""
+        from pilosa_tpu.server import deadline
+
+        c = InternalClient(retries=5, retry_backoff=5.0)
+        fn, calls = self._fail_then_ok(99, ClientError("down", transport=True))
+        t0 = time.monotonic()
+        with deadline.activate(deadline.Deadline.after(0.2)):
+            with pytest.raises(ClientError):
+                c._with_retry("t5", fn)
+        assert calls["n"] == 1
+        assert time.monotonic() - t0 < 1.0
+
+
+# -- gang-state on the cluster plane ------------------------------------------
+
+
+class TestGangStateGossip:
+    def test_node_serialization_round_trip(self):
+        n = Node(id="a", uri="http://a:1", gang_state="DEGRADED", gang_epoch=3)
+        d = n.to_dict()
+        assert d["gangState"] == "DEGRADED" and d["gangEpoch"] == 3
+        back = Node.from_dict(d)
+        assert back.gang_state == "DEGRADED" and back.gang_epoch == 3
+
+    def test_plain_node_payload_unchanged(self):
+        d = Node(id="a", uri="http://a:1").to_dict()
+        assert "gangState" not in d and "gangEpoch" not in d
+        back = Node.from_dict(d)
+        assert back.gang_state == "" and back.gang_epoch == 0
+
+
+# -- in-process federated rejoin cycle over real HTTP -------------------------
+
+
+def _free_ports(n):
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _req(uri, method, path, body=None):
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    r = urllib.request.Request(uri + path, data=data, method=method)
+    try:
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _wait(pred, timeout=20.0, every=0.1, what="condition"):
+    t_end = time.monotonic() + timeout
+    while time.monotonic() < t_end:
+        if pred():
+            return
+        time.sleep(every)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class TestFederatedRejoinCycle:
+    def test_leader_boot_rejoin_replicate_and_double_failure(self, tmp_path):
+        """The full lifecycle in one process, over real HTTP: a
+        replicated-solo federated leader (DEGRADED) serving next to a
+        plain peer, a follower rejoin that re-stages state and flips
+        the gang ACTIVE at a bumped epoch, write replication to the
+        re-formed follower, epoch fencing of stale descriptors, and a
+        second failure returning to DEGRADED — never degrade-forever."""
+        from pilosa_tpu.server import ClusterConfig, Config, Server
+
+        pa, pb, pf = _free_ports(3)
+        hosts = [f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"]
+
+        def cfg(port, i, **kw):
+            return Config(
+                data_dir=str(tmp_path / f"n{port}"),
+                bind=f"127.0.0.1:{port}",
+                device_policy="never",
+                metric="none",
+                anti_entropy_interval=0,
+                client_retries=0,  # fail fast in-process
+                cluster=ClusterConfig(
+                    disabled=False,
+                    coordinator=(i == 0),
+                    replicas=2,
+                    hosts=hosts,
+                    probe_interval=0,
+                    # >0 so the boot-time NodeStatus pull runs: B boots
+                    # after A's DEGRADED broadcast and must adopt A's
+                    # current gang state at join
+                    status_interval=30.0,
+                ),
+                **kw,
+            )
+
+        a = Server(cfg(pa, 0, federation_leader=True))
+        a.open()
+        b = Server(cfg(pb, 1))
+        b.open()
+        ua, ub = f"http://127.0.0.1:{pa}", f"http://127.0.0.1:{pb}"
+        servers = [a, b]
+        try:
+            # federation wired: replicated-solo leader, DEGRADED
+            assert a.multihost is not None and a.multihost.federated
+            st, body = _req(ua, "GET", "/status")
+            assert st == 200
+            assert body["gang"]["state"] == "DEGRADED"
+            assert body["gang"]["mode"] == "replicated"
+            assert b.multihost is None  # plain peer: no gang block
+
+            # load through the DEGRADED leader: writes order through the
+            # gang leader thread, reads route around the fencing gang
+            _req(ua, "POST", "/index/i", {})
+            _req(ua, "POST", "/index/i/field/f", {})
+            for col in range(20):
+                st, r = _req(
+                    ua, "POST", "/index/i/query", f"Set({col}, f=1)".encode()
+                )
+                assert st == 200, r
+            st, r = _req(ua, "POST", "/index/i/query", b"Count(Row(f=1))")
+            assert st == 200 and r["results"] == [20]
+            # B's knowledge of A's gang state rides the coordinator's
+            # status gossip (async); once it lands, B's reads route
+            # around the fencing gang's (write-skipped, stale) replica
+            _wait(
+                lambda: next(
+                    (n.gang_state for n in b.cluster.nodes if n.uri == ua), ""
+                )
+                == "DEGRADED",
+                what="gang-state gossip to peer B",
+            )
+            st, r = _req(ub, "POST", "/index/i/query", b"Count(Row(f=1))")
+            assert st == 200 and r["results"] == [20]
+
+            # follower rejoin: fresh data dir, re-staged over HTTP
+            f = Server(
+                Config(
+                    data_dir=str(tmp_path / "fol"),
+                    bind=f"127.0.0.1:{pf}",
+                    device_policy="never",
+                    metric="none",
+                    federation_rejoin=ua,
+                )
+            )
+            f.open()
+            servers.append(f)
+            uf = f"http://127.0.0.1:{pf}"
+            _wait(
+                lambda: a.multihost.state == "ACTIVE",
+                what="gang re-formation",
+            )
+            st, body = _req(ua, "GET", "/status")
+            assert body["gang"]["state"] == "ACTIVE"
+            assert body["gang"]["epoch"] >= 1
+            assert uf in body["gang"]["replicas"]
+            assert f.gang_epoch == body["gang"]["epoch"]
+            # the cluster plane heard the transitions
+            node_a = next(n for n in b.cluster.nodes if n.uri == ua)
+            assert node_a.gang_state == "ACTIVE"
+
+            # re-staged state: the follower answers like the leader
+            st, r = _req(uf, "POST", "/index/i/query", b"Count(Row(f=1))")
+            assert st == 200 and r["results"] == [20]
+
+            # a write on the leader replicates to the re-formed follower
+            st, r = _req(ua, "POST", "/index/i/query", b"Set(500, f=2)")
+            assert st == 200 and r["results"] == [True]
+            _wait(
+                lambda: _req(uf, "POST", "/index/i/query", b"Count(Row(f=2))")[1].get(
+                    "results"
+                )
+                == [1],
+                what="write replication to follower",
+            )
+
+            # epoch fence: a stale (pre-re-form) descriptor is refused
+            st, r = _req(
+                uf,
+                "POST",
+                "/internal/gang/apply",
+                {"kind": multihost.KIND_MESSAGE, "payload": {}, "epoch": 0},
+            )
+            assert st == 409, r
+
+            # double failure: kill the follower; the next replicated
+            # write drops it and the gang returns to DEGRADED — serving
+            f.close()
+            st, r = _req(ua, "POST", "/index/i/query", b"Set(501, f=2)")
+            assert st == 200 and r["results"] == [True]
+            _wait(
+                lambda: a.multihost.state == "DEGRADED",
+                what="degrade on replica loss",
+            )
+            st, r = _req(ua, "POST", "/index/i/query", b"Count(Row(f=2))")
+            assert st == 200 and r["results"] == [2]
+            st, body = _req(ua, "GET", "/debug/multihost")
+            assert body["state"] == "DEGRADED" and body["replicas"] == []
+        finally:
+            for s in servers:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+
+
+# -- 2-gang x 2-process kill/recover smoke ------------------------------------
+
+
+@pytest.mark.slow
+def test_two_gang_federation_smoke():
+    """The federation dryrun in quick mode: 2 gangs × 2 processes on
+    CPU, serving bit-identical to the oracle across gangs, surviving a
+    follower SIGKILL (bounded unavailability, re-form to ACTIVE) and a
+    leader SIGKILL (replica failover, replicated-solo restart)."""
+    import jax
+
+    if not hasattr(jax, "distributed"):
+        pytest.skip("jax.distributed unavailable")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "dryrun_federation.py"), "--quick"],
+        capture_output=True,
+        text=True,
+        timeout=560,
+        env={
+            k: v
+            for k, v in os.environ.items()
+            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+        },
+    )
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+    summary = json.loads(proc.stdout[proc.stdout.index('{\n  "what"') :])
+    assert summary["ok"] is True
