@@ -199,12 +199,28 @@ def window_quality(tall: dict):
     mode, qps = headline_mode(tall)
     if not qps:
         return None
-    return {
+    out = {
         "sustained_rtt_ms": rtt_ms,
         "pipelining_depth": round(qps * rtt_ms / 1000.0, 2),
         "headline_qps": qps,
         "headline_mode": mode,
     }
+    # chain windows ride the same qualification as TopN (VERDICT chain-
+    # margin instability): a degraded window must not overwrite the
+    # last-good chain numbers either
+    seq_chain = tall.get("chain_qps") or 0.0
+    ck, cv = best_closed_loop(tall, "chain_qps_c")
+    if ck is not None and cv > seq_chain:
+        chain_mode, chain_qps = f"{ck.rsplit('c', 1)[1]} closed-loop clients", cv
+    else:
+        chain_mode, chain_qps = "sequential", seq_chain
+    if chain_qps:
+        out.update(
+            chain_headline_qps=chain_qps,
+            chain_headline_mode=chain_mode,
+            chain_pipelining_depth=round(chain_qps * rtt_ms / 1000.0, 2),
+        )
+    return out
 
 
 def window_degraded(new_wq, old_wq):
@@ -228,6 +244,20 @@ def window_degraded(new_wq, old_wq):
             f"pipelining depth {depth:.2f} < {DEGRADED_DEPTH_FACTOR}x "
             f"last-good {old_depth:.2f}"
         )
+    # symmetric chain-window check: a run whose chain window is shallow
+    # (or absent) must not displace qualified chain numbers
+    old_cd = old_wq.get("chain_pipelining_depth")
+    if old_cd:
+        new_cd = new_wq.get("chain_pipelining_depth")
+        if not new_cd:
+            return True, (
+                "no chain window measured this run (last-good has one)"
+            )
+        if new_cd < old_cd * DEGRADED_DEPTH_FACTOR:
+            return True, (
+                f"chain pipelining depth {new_cd:.2f} < "
+                f"{DEGRADED_DEPTH_FACTOR}x last-good {old_cd:.2f}"
+            )
     return False, None
 
 
@@ -587,6 +617,143 @@ def _rw_mix_probe(budget_s: float) -> dict:
     return out
 
 
+def _continuous_batching_probe(budget_s: float) -> dict:
+    """Continuous-batching dispatch engine A/B (ISSUE 8): closed-loop
+    c8/c32 heterogeneous reads (TopN/Count/Intersect/chain) against two
+    bare device executors over the same holder — one routing through
+    the async dispatch engine, one blocking per call — recording qps
+    per concurrency plus the measured device-idle fraction per arm.
+    Chip-independent for the CONTRAST (the engine's wave grouping,
+    dedup, and in-flight overlap all exercise on the CPU backend); the
+    absolute gap widens on a tunneled chip where each blocking call
+    holds a thread for a full RTT."""
+    import shutil as _shutil
+    import tempfile
+
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.utils import metrics as _metrics
+
+    R, BITS = 256, 4000
+    tmp = tempfile.mkdtemp(prefix="pilosa_dispatch_probe_")
+    out = {
+        "note": (
+            "closed-loop heterogeneous reads on bare device executors: "
+            "dispatch engine (async waves) vs blocking per-call "
+            "execution; device_idle_fraction = wall time with no device "
+            "work in flight"
+        )
+    }
+    h = Holder(tmp)
+    h.open()
+    try:
+        idx = h.create_index("cb")
+        fld = idx.create_field("f")
+        rng = np.random.default_rng(77)
+        rows, cols = [], []
+        for r_ in range(R):
+            rows += [r_] * BITS
+            cols += rng.integers(0, 1 << 20, size=BITS).tolist()
+        fld.import_bits(rows, cols)
+        # heterogeneous mix — distinct canonical signatures coexist in
+        # one wave; closed-loop round-robin also produces exact
+        # duplicates in the backlog, which the engine collapses
+        queries = [
+            "TopN(f, n=10)",
+            "TopN(f, Row(f=3), n=8)",
+            "Count(Row(f=1))",
+            "Count(Intersect(Row(f=1), Row(f=2)))",
+            "Count(Union(Row(f=4), Row(f=5), Row(f=6)))",
+            "Count(Difference(Row(f=7), Row(f=8)))",
+        ]
+
+        def exec_sum(snap):
+            tot = 0.0
+            for k, v in snap.items():
+                if k.split(";")[0] == "spmd.execute_seconds.hist":
+                    tot += (v or {}).get("sum", 0.0)
+            return tot
+
+        def arm(engine: bool, n_clients: int, seconds: float):
+            ex = Executor(h, device_policy="always", dispatch_enabled=engine)
+            try:
+                for q in queries:  # warm: compile + stage
+                    ex.execute("cb", q)
+                counts = [0] * n_clients
+                errors: list = []
+                stop = time.perf_counter() + seconds
+
+                def client(ci):
+                    i = ci
+                    try:
+                        while time.perf_counter() < stop and not errors:
+                            ex.execute("cb", queries[i % len(queries)])
+                            counts[ci] += 1
+                            i += 1
+                    except BaseException as e:
+                        errors.append(e)
+
+                snap0 = _metrics.snapshot()
+                ts = [
+                    threading.Thread(target=client, args=(ci,))
+                    for ci in range(n_clients)
+                ]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                if errors:
+                    raise errors[0]
+                dt = time.perf_counter() - t0
+                if engine:
+                    idle = ex.dispatch_engine.stats()["device_idle_fraction"]
+                else:
+                    # blocking arm has no engine accounting: idle =
+                    # 1 - (device execute seconds / wall). On a
+                    # tunneled chip the RTT rides INSIDE the blocking
+                    # call, so this flatters the blocking arm if
+                    # anything.
+                    busy = exec_sum(_metrics.snapshot()) - exec_sum(snap0)
+                    idle = max(0.0, min(1.0, 1.0 - busy / dt))
+                return sum(counts) / dt, idle
+            finally:
+                ex.close()
+
+        seg = max(2.0, min(6.0, budget_s / 7))
+        for n in (8, 32):
+            qps_b, idle_b = arm(False, n, seg)
+            qps_e, idle_e = arm(True, n, seg)
+            out[f"c{n}_qps"] = round(qps_e, 1)
+            out[f"c{n}_qps_blocking"] = round(qps_b, 1)
+            out[f"c{n}_speedup"] = round(qps_e / qps_b, 2) if qps_b else None
+            out[f"c{n}_device_idle_fraction"] = round(idle_e, 4)
+            out[f"c{n}_device_idle_fraction_blocking"] = round(idle_b, 4)
+        # hot-set arm: 4 distinct TopN-heavy queries (the dashboard /
+        # head-of-Zipf shape the plan cache targets) — wave dedup can
+        # collapse c clients toward 4 executions. On a 1-core CPU rig
+        # the speedup ceiling at c8 is clients/distinct = 2x; on chip
+        # the ceiling is the RTT overlap instead.
+        queries[:] = [
+            "TopN(f, n=10)",
+            "TopN(f, Row(f=3), n=8)",
+            "TopN(f, Row(f=5), n=8)",
+            "Count(Row(f=1))",
+        ]
+        for n in (8, 32):
+            qps_b, _ = arm(False, n, seg)
+            qps_e, _ = arm(True, n, seg)
+            out[f"hotset_c{n}_qps"] = round(qps_e, 1)
+            out[f"hotset_c{n}_qps_blocking"] = round(qps_b, 1)
+            out[f"hotset_c{n}_speedup"] = (
+                round(qps_e / qps_b, 2) if qps_b else None
+            )
+    finally:
+        h.close()
+        _shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def _plan_cache_probe(budget_s: float) -> dict:
     """Plan result cache under Zipf-repeated traffic (ISSUE 4): a
     TopN/Intersect query mix drawn from a Zipf distribution (the
@@ -915,6 +1082,22 @@ def main():
                         "native_core8_qps": round(nv * 8, 2),
                         "margin": round(best / (nv * 8), 2),
                     }
+            # per-window chain margins (VERDICT chain-margin
+            # instability): the margin at EVERY measured chain
+            # concurrency window, not just the best — so a single good
+            # window can't mask degraded siblings in the artifact
+            _cnv = _native.get("tall_chains_1Bx64shards", {}).get(
+                "native_cpu_qps"
+            )
+            if _cnv:
+                _cm = {
+                    k: round(v / (_cnv * 8), 2)
+                    for k, v in result.get("tall", {}).items()
+                    if k.startswith("chain_qps_c")
+                    and isinstance(v, (int, float))
+                }
+                if _cm:
+                    result["chain_margins_per_window"] = _cm
     except Exception as e:  # any malformed baseline file — keep the JSON flowing
         print(f"native baseline unavailable: {type(e).__name__}: {e}", file=sys.stderr)
 
@@ -959,6 +1142,22 @@ def main():
             except Exception as e:
                 print(
                     f"plan-cache probe failed: {type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
+
+    # ---- continuous-batching probe (ISSUE 8): closed-loop c8/c32 qps
+    # + device-idle fraction, dispatch engine vs blocking execution.
+    if os.environ.get("PILOSA_BENCH_DISPATCH", "1") != "0":
+        rem = child_budget - (time.monotonic() - _T_PROC_START)
+        if rem > 60:
+            try:
+                result["continuous_batching"] = _continuous_batching_probe(
+                    min(30.0, rem - 30)
+                )
+            except Exception as e:
+                print(
+                    f"continuous-batching probe failed: "
+                    f"{type(e).__name__}: {e}",
                     file=sys.stderr,
                 )
 

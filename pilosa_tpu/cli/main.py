@@ -142,6 +142,12 @@ def main(argv=None) -> int:
         help="fetch /debug/plancache (plan result-cache hit/invalidation/"
         "bytes snapshot) instead",
     )
+    p.add_argument(
+        "--dispatch",
+        action="store_true",
+        help="fetch /debug/dispatch (continuous-batching dispatch engine "
+        "wave/queue/idle snapshot) instead",
+    )
     p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("config", help="print the effective configuration")
@@ -477,10 +483,13 @@ def cmd_inspect(args) -> int:
 def cmd_metrics(args) -> int:
     """Dump a node's observability surface: Prometheus text from
     /metrics, the recent-trace ring buffer with --traces, the
-    serving-pipeline snapshot with --pipeline, or the plan result-cache
-    snapshot with --cache."""
+    serving-pipeline snapshot with --pipeline, the plan result-cache
+    snapshot with --cache, or the dispatch-engine snapshot with
+    --dispatch."""
     host = args.host if args.host.startswith("http") else f"http://{args.host}"
-    if getattr(args, "cache", False):
+    if getattr(args, "dispatch", False):
+        path = "/debug/dispatch"
+    elif getattr(args, "cache", False):
         path = "/debug/plancache"
     elif args.pipeline:
         path = "/debug/pipeline"

@@ -17,6 +17,13 @@ path pays only one uncontended lock acquisition. While a round's fetch
 is in flight, new arrivals accumulate for the next round, so batch
 width self-tunes to the fetch latency (the scarce resource on a
 tunneled chip, whose device→host transfers serialize).
+
+This scorer is the *intra-wave* coalescing mechanism that the
+continuous-batching dispatch engine (executor/dispatch.py) composes:
+the engine widens the concurrency funnel at the executor boundary
+(heterogeneous plans per wave, submit-don't-block), and the TopN calls
+inside one wave still funnel through this scorer so homogeneous
+scoring dispatches merge into single batched kernel launches.
 """
 
 from __future__ import annotations
@@ -213,6 +220,11 @@ class BatchedScorer:
             # flag clears; a new leader draining fresh arrivals touches
             # different slots, so the concurrent _finish is safe
             fetch(prev)
+            # every round this leader launched has now been fetched, so
+            # its pad lanes are no longer referenced by in-flight device
+            # work — re-zero them through a donated jit so the scratch
+            # buffer is recycled in place on TPU (no-op zeros on CPU)
+            self._recycle_pads()
         except BaseException:
             # never leave the scorer wedged: a leader death outside the
             # per-key guards (KeyboardInterrupt, MemoryError) must not
@@ -229,6 +241,21 @@ class BatchedScorer:
             if launched_all is not prev:
                 fetch(launched_all)
             raise
+
+    def _recycle_pads(self) -> None:
+        """Recycle the cached pow2 pad zeros through a donated re-zero
+        (ops.zeros_like_donated). Called only after the leader's final
+        fetch, when no round launched by this leader still holds the
+        pads; a concurrent fresh leader is possible but rare, so a
+        donation conflict just drops the entry for _launch to rebuild."""
+        for zkey in list(self._pad_zeros):
+            zero = self._pad_zeros.get(zkey)
+            if zero is None:
+                continue
+            try:
+                self._pad_zeros[zkey] = ops.zeros_like_donated(zero)
+            except BaseException:
+                self._pad_zeros.pop(zkey, None)
 
     def _fill(self, batch: list[_Slot], mat) -> None:
         # compatibility seam (tests/instrumentation wrap this): launch +

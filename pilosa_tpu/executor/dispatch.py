@@ -1,0 +1,463 @@
+"""Continuous-batching async device dispatch — the executor↔device
+boundary as a persistent feed loop (ISSUE 8).
+
+The round-7 profile was blunt: the chip answers thousands of TopN qps
+batched while serving delivered ~123 at c32, because every query still
+parked a thread on a blocking dispatch and only *identical* queries
+ever shared a launch (pipeline gangs) or *homogeneous* TopN scoring
+coalesced (BatchedScorer). TPU/GPU inference servers close exactly
+this gap with continuous batching (Orca/vLLM iteration-level
+scheduling): one persistent dispatch loop owns the device and admits
+whatever is queued into the next wave, so the device never idles
+between launches. This module is that loop for bitmap queries:
+
+* **Submit, don't block.** ``Executor.execute`` hands eligible local
+  reads to ``submit()`` and gets a future back; the calling thread
+  waits on the future instead of occupying the executor. Ineligible
+  work (writes, gang/multihost, cluster fan-out, remote legs, traced
+  queries, ``serial``) keeps the old inline path — the PR 5/6 gang
+  determinism contract holds because gang execution is ``serial`` and
+  never reaches the engine.
+* **Heterogeneous waves.** The loop drains up to ``max_wave`` queued
+  items per wave. Within a wave, items group by execution context
+  (index, shard set, exec-opt bits) and dedup by canonical plan
+  signature (plan/canon.py) — wave-level singleflight, so duplicate
+  plans (including argument-order permutations) execute once and share
+  results. Each group then becomes ONE combined multi-call query
+  through ``executor._execute``: *mixed* TopN/Count/Sum/chain plans
+  ride one wave, fan through the executor's read pool together, and
+  the BatchedScorer / stacked scorers coalesce their kernel work into
+  batched launches — generalizing both the pipeline's identical-query
+  gangs and the scorer's homogeneous micro-batches.
+* **Overlap.** ``max_inflight`` waves execute concurrently (double /
+  triple buffering at the serving layer): while wave N computes, the
+  loop is already building wave N+1 and firing advisory stage-ahead
+  warms (``stager.stage_ahead``) so operand uploads overlap kernel
+  execution, and wave N−1's waiters consume results as each runner
+  finishes.
+* **Deadlines.** Items whose deadline expired while queued are
+  cancelled at wave build — before any parse/translate/kernel work —
+  and their wave-mates are unaffected; a combined execution that fails
+  (one bad member, a deadline, anything) falls back to per-item
+  execution so each member gets ITS OWN outcome, mirroring the
+  pipeline's gang fallback.
+* **Shutdown by construction.** ``close()`` flips ``_closing`` under
+  the queue lock; from then on ``submit()`` returns ``None`` and the
+  caller executes inline — there is no submit/close race to lose. The
+  loop drains what was already queued within the ``drain`` budget and
+  fails the rest.
+
+Observability: ``dispatch.wave_size``, ``dispatch.inflight_depth``,
+``dispatch.device_idle_fraction`` (1 − fraction of wall time with at
+least one wave executing, since first submit), and
+``dispatch.queue_wait_seconds``; snapshot at ``/debug/dispatch``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+from pilosa_tpu.pql import Query
+from pilosa_tpu.utils import metrics
+
+# Request-deadline seam (server/deadline.py), imported lazily for the
+# same L4→L6 layering reason as executor.py.
+_deadline_mod = None
+
+
+def _deadline():
+    global _deadline_mod
+    if _deadline_mod is None:
+        from pilosa_tpu.server import deadline as _m
+
+        _deadline_mod = _m
+    return _deadline_mod
+
+
+class _Item:
+    """One submitted query: the future its caller blocks on."""
+
+    __slots__ = (
+        "index",
+        "query",
+        "shards",
+        "opt",
+        "deadline",
+        "signature",
+        "n_calls",
+        "event",
+        "value",
+        "error",
+        "t_enq",
+        "wait_s",
+    )
+
+    def __init__(self, index, query, shards, opt, deadline, signature) -> None:
+        self.index = index
+        self.query = query
+        self.shards = shards
+        self.opt = opt
+        self.deadline = deadline
+        self.signature = signature
+        self.n_calls = len(query.calls)
+        self.event = threading.Event()
+        self.value: Any = None
+        self.error: Optional[BaseException] = None
+        self.t_enq = 0.0
+        self.wait_s = 0.0
+
+    def finish(self, result=None, error=None) -> None:
+        self.value = result
+        self.error = error
+        self.event.set()
+
+    def result(self) -> Any:
+        """Block until the wave resolves this item. A waiter whose own
+        deadline passes first raises (the runner's dequeue-time check
+        skips its queued work; a launched item completes harmlessly on
+        the abandoned future)."""
+        dl = self.deadline
+        if dl is None:
+            self.event.wait()
+        else:
+            while not self.event.is_set():
+                rem = dl.remaining()
+                if rem <= 0:
+                    dl.check("dispatch")  # raises (and counts)
+                self.event.wait(timeout=min(rem, 0.5))
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+
+class DispatchEngine:
+    """The persistent per-device dispatch loop. One per Executor; the
+    loop thread starts lazily on first submit, so idle executors (and
+    every bare test executor that never routes through it) pay
+    nothing."""
+
+    def __init__(
+        self,
+        executor,
+        max_wave: int = 16,
+        max_inflight: int = 2,
+        stage_ahead: int = 1,
+    ) -> None:
+        self.executor = executor
+        self.max_wave = max(1, int(max_wave))
+        self.max_inflight = max(1, int(max_inflight))
+        self.stage_ahead_depth = max(0, int(stage_ahead))
+        self._mu = threading.Lock()
+        self._cond = threading.Condition(self._mu)
+        self._q: deque[_Item] = deque()
+        self._closing = False
+        self._loop_thread: Optional[threading.Thread] = None
+        # wave runner slots: the loop blocks here BEFORE dequeuing, so
+        # while all slots compute the queue keeps accumulating and the
+        # next wave comes out wider — backlog IS the batching window,
+        # exactly like the pipeline's gang dequeue
+        self._slots = threading.Semaphore(self.max_inflight)
+        self._inflight = 0
+        self._in_wave = threading.local()
+        # busy/idle accounting: busy = wall time with >=1 wave
+        # executing, measured from first submit. The exported
+        # dispatch.device_idle_fraction is 1 - busy/wall — the number
+        # continuous batching exists to drive down.
+        self._t_start: Optional[float] = None
+        self._busy_total = 0.0
+        self._busy_since: Optional[float] = None
+        # counters (ints under _mu; snapshot is consistent)
+        self.waves = 0
+        self.items = 0
+        self.dedup_hits = 0
+        self.combined_items = 0
+        self.fallbacks = 0
+        self.expired = 0
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(
+        self,
+        index: str,
+        query: Query,
+        shards,
+        opt,
+        deadline=None,
+        text: Optional[str] = None,
+    ) -> Optional[_Item]:
+        """Enqueue a read-only query for the next wave and return its
+        future — or ``None`` when the engine is closing, in which case
+        the caller executes inline (shutdown can never strand a
+        submit)."""
+        sig = None
+        if text is not None:
+            from pilosa_tpu.plan import canon
+
+            sig = canon.query_signature(text)
+        item = _Item(index, query, shards, opt, deadline, sig)
+        with self._mu:
+            if self._closing:
+                return None
+            if self._loop_thread is None:
+                self._t_start = time.monotonic()
+                t = threading.Thread(
+                    target=self._loop, name="dispatch-loop", daemon=True
+                )
+                self._loop_thread = t
+                t.start()
+            item.t_enq = time.monotonic()
+            self._q.append(item)
+            self.items += 1
+            self._cond.notify_all()
+        return item
+
+    def in_wave(self) -> bool:
+        """True on a thread currently executing a wave (re-entry
+        guard: anything inside a wave that reaches execute() again must
+        run inline, not deadlock against its own runner slot)."""
+        return getattr(self._in_wave, "active", False)
+
+    # -- the dispatch loop ---------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._mu:
+                while not self._q and not self._closing:
+                    self._cond.wait()
+                if not self._q:
+                    return  # closing and drained
+            # acquire a runner slot BEFORE dequeuing: with every slot
+            # busy the backlog keeps growing and the next wave is wider
+            self._slots.acquire()
+            with self._mu:
+                n = min(self.max_wave, len(self._q))
+                wave = [self._q.popleft() for _ in range(n)]
+                if not wave:
+                    self._slots.release()
+                    continue
+                self.waves += 1
+                self._inflight += 1
+                if self._inflight == 1:
+                    self._busy_since = time.monotonic()
+                metrics.gauge(metrics.DISPATCH_INFLIGHT_DEPTH, self._inflight)
+            # overlap: operand staging for what is STILL queued runs on
+            # the stager's side thread while this wave computes
+            self._stage_ahead_peek()
+            threading.Thread(
+                target=self._run_wave_slot,
+                args=(wave,),
+                name="dispatch-wave",
+                daemon=True,
+            ).start()
+
+    def _run_wave_slot(self, wave: list[_Item]) -> None:
+        try:
+            self._run_wave(wave)
+        finally:
+            with self._mu:
+                self._inflight -= 1
+                if self._inflight == 0 and self._busy_since is not None:
+                    self._busy_total += time.monotonic() - self._busy_since
+                    self._busy_since = None
+                metrics.gauge(metrics.DISPATCH_INFLIGHT_DEPTH, self._inflight)
+                metrics.gauge(
+                    metrics.DISPATCH_DEVICE_IDLE_FRACTION,
+                    self._idle_fraction_locked(),
+                )
+                self._cond.notify_all()  # close() waits on inflight==0
+            self._slots.release()
+
+    def _idle_fraction_locked(self) -> float:
+        if self._t_start is None:
+            return 1.0
+        now = time.monotonic()
+        wall = now - self._t_start
+        if wall <= 0:
+            return 0.0
+        busy = self._busy_total
+        if self._busy_since is not None:
+            busy += now - self._busy_since
+        return max(0.0, min(1.0, 1.0 - busy / wall))
+
+    # -- wave execution ------------------------------------------------------
+
+    def _run_wave(self, wave: list[_Item]) -> None:
+        self._in_wave.active = True
+        try:
+            now = time.monotonic()
+            metrics.observe(metrics.DISPATCH_WAVE_SIZE, len(wave))
+            live: list[_Item] = []
+            for it in wave:
+                it.wait_s = now - it.t_enq
+                metrics.observe(metrics.DISPATCH_QUEUE_WAIT_SECONDS, it.wait_s)
+                if it.deadline is not None and it.deadline.expired():
+                    # expired while queued: cancelled before any
+                    # parse/translate/kernel work; wave-mates unaffected
+                    with self._mu:
+                        self.expired += 1
+                    metrics.count(
+                        metrics.PIPELINE_DEADLINE_EXPIRED, stage="dispatch"
+                    )
+                    it.finish(error=_deadline().DeadlineExceeded("dispatch"))
+                    continue
+                live.append(it)
+            groups: dict[tuple, list[_Item]] = {}
+            for it in live:
+                o = it.opt
+                key = (
+                    it.index,
+                    tuple(it.shards) if it.shards is not None else None,
+                    o.remote,
+                    o.exclude_row_attrs,
+                    o.exclude_columns,
+                    o.cache,
+                )
+                groups.setdefault(key, []).append(it)
+            for members in groups.values():
+                self._run_group(members)
+        finally:
+            self._in_wave.active = False
+
+    def _run_group(self, members: list[_Item]) -> None:
+        """Dedup by canonical signature, then execute the distinct
+        plans as one combined multi-call query."""
+        leaders: list[_Item] = []
+        by_sig: dict[str, _Item] = {}
+        dups: dict[int, list[_Item]] = {}
+        for it in members:
+            lead = by_sig.get(it.signature) if it.signature is not None else None
+            if lead is not None and lead.n_calls == it.n_calls:
+                dups.setdefault(id(lead), []).append(it)
+                with self._mu:
+                    self.dedup_hits += 1
+                continue
+            if it.signature is not None:
+                by_sig[it.signature] = it
+            leaders.append(it)
+        if len(leaders) > 1:
+            if not self._try_combined(leaders):
+                for it in leaders:
+                    self._run_single(it)
+        elif leaders:
+            self._run_single(leaders[0])
+        for lead in leaders:
+            for d in dups.get(id(lead), ()):
+                d.finish(result=lead.value, error=lead.error)
+
+    def _try_combined(self, leaders: list[_Item]) -> bool:
+        """One combined execution for the whole group: the calls fan
+        through the executor's read pool together, so the batched
+        scorers coalesce heterogeneous members' kernel work. Runs under
+        the group-minimum deadline; any failure reports False and the
+        caller re-runs members individually (a bad member can never
+        fail its wave-mates)."""
+        head = leaders[0]
+        combined = Query(calls=[c for it in leaders for c in it.query.calls])
+        dls = [it.deadline for it in leaders if it.deadline is not None]
+        gang_dl = min(dls, key=lambda d: d.at) if dls else None
+        dm = _deadline()
+        try:
+            with dm.activate(gang_dl):
+                results = self.executor._execute(
+                    head.index, combined, head.shards, head.opt
+                )
+        except BaseException:
+            with self._mu:
+                self.fallbacks += 1
+            return False
+        with self._mu:
+            self.combined_items += len(leaders)
+        off = 0
+        for it in leaders:
+            it.finish(result=results[off : off + it.n_calls])
+            off += it.n_calls
+        return True
+
+    def _run_single(self, it: _Item) -> None:
+        if it.event.is_set():
+            return
+        dm = _deadline()
+        try:
+            with dm.activate(it.deadline):
+                it.finish(
+                    result=self.executor._execute(
+                        it.index, it.query, it.shards, it.opt
+                    )
+                )
+        except BaseException as err:
+            it.finish(error=err)
+
+    # -- stage-ahead overlap -------------------------------------------------
+
+    def _stage_ahead_peek(self) -> None:
+        """Advisory operand prefetch for queued-but-unlaunched items:
+        while the launched wave computes, the stager's side thread
+        uploads the NEXT waves' Row operands (staging overlapped with
+        compute). Bounded, best-effort, and idempotent — the real
+        execution re-stages whatever this missed."""
+        if self.stage_ahead_depth <= 0:
+            return
+        ex = self.executor
+        stage = getattr(ex.stager, "stage_ahead", None)
+        if stage is None:
+            return
+        with self._mu:
+            peek = list(self._q)[: self.stage_ahead_depth * self.max_wave]
+        seen: set = set()
+        for it in peek:
+            key = (it.index, it.signature)
+            if it.signature is not None and key in seen:
+                continue
+            seen.add(key)
+            stage(lambda it=it: ex._warm_query(it.index, it.query, it.shards))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, drain: float = 5.0) -> bool:
+        """Stop admission (``submit`` returns None → callers run
+        inline), drain queued + in-flight waves within ``drain``
+        seconds, fail whatever remains. Returns True when everything
+        drained in time."""
+        t0 = time.monotonic()
+        with self._mu:
+            self._closing = True
+            self._cond.notify_all()
+            loop = self._loop_thread
+        if loop is not None:
+            loop.join(timeout=max(0.0, drain - (time.monotonic() - t0)))
+        leftovers: list[_Item] = []
+        with self._mu:
+            deadline = t0 + drain
+            while self._inflight > 0 and time.monotonic() < deadline:
+                self._cond.wait(timeout=0.05)
+            clean = self._inflight == 0 and not self._q
+            while self._q:
+                leftovers.append(self._q.popleft())
+        for it in leftovers:
+            it.finish(error=RuntimeError("dispatch engine shut down"))
+        return clean
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The /debug/dispatch snapshot."""
+        with self._mu:
+            return {
+                "enabled": True,
+                "closing": self._closing,
+                "max_wave": self.max_wave,
+                "max_inflight": self.max_inflight,
+                "stage_ahead": self.stage_ahead_depth,
+                "queued": len(self._q),
+                "inflight_waves": self._inflight,
+                "waves": self.waves,
+                "items": self.items,
+                "dedup_hits": self.dedup_hits,
+                "combined_items": self.combined_items,
+                "fallbacks": self.fallbacks,
+                "deadline_expired": self.expired,
+                "device_idle_fraction": self._idle_fraction_locked(),
+            }

@@ -281,6 +281,10 @@ class Executor:
         health=None,
         auto_min_containers: Optional[int] = None,
         plan_cache=None,
+        dispatch_enabled: Optional[bool] = None,
+        dispatch_max_wave: int = 16,
+        dispatch_max_inflight: int = 2,
+        dispatch_stage_ahead: int = 1,
     ) -> None:
         self.holder = holder
         self.cluster = cluster  # None = single-node
@@ -356,6 +360,33 @@ class Executor:
             )
         self._read_pool = None  # lazy; see execute()
         self._read_pool_mu = threading.Lock()
+        # checkout refcount + closing flag: close() drains active
+        # pool.map users instead of nulling the attr under them, and a
+        # checkout during shutdown gets None (the caller runs the calls
+        # serially inline) — see _read_pool_acquire
+        self._read_pool_cv = threading.Condition(self._read_pool_mu)
+        self._read_pool_users = 0
+        self._read_pool_closing = False
+        # continuous-batching async dispatch engine (dispatch.py):
+        # eligible local reads entering execute() submit a future and
+        # wait instead of blocking through the call tree, so concurrent
+        # heterogeneous plans coalesce into device waves. The loop
+        # thread starts lazily on first submit. PILOSA_DISPATCH=0 turns
+        # it off for bare executors (benches A/B it); the server passes
+        # its dispatch-* knobs explicitly.
+        if dispatch_enabled is None:
+            dispatch_enabled = os.environ.get("PILOSA_DISPATCH", "1") != "0"
+        if dispatch_enabled:
+            from pilosa_tpu.executor.dispatch import DispatchEngine
+
+            self.dispatch_engine = DispatchEngine(
+                self,
+                max_wave=dispatch_max_wave,
+                max_inflight=dispatch_max_inflight,
+                stage_ahead=dispatch_stage_ahead,
+            )
+        else:
+            self.dispatch_engine = None
         # compiled shard_map kernels keyed by (kind, static args) — the
         # closures in spmd.py are rebuilt per call, so cache here to keep
         # XLA's jit cache effective across queries
@@ -424,11 +455,42 @@ class Executor:
                 return gang.dispatch(desc, deadline=dl)
             with sp.child(metrics.STAGE_GANG, plan=desc.payload.get("plan")):
                 return gang.dispatch(desc, deadline=dl)
+        engine = self.dispatch_engine
+        if engine is not None and self._engine_eligible(opt):
+            parsed = parse(query) if isinstance(query, str) else query
+            if parsed.write_call_n() == 0:
+                fut = engine.submit(
+                    index_name,
+                    parsed,
+                    shards,
+                    opt or ExecOptions(),
+                    deadline=_deadline().current(),
+                    text=query if isinstance(query, str) else None,
+                )
+                if fut is not None:  # None: engine closing -> inline
+                    return fut.result()
+            query = parsed  # already parsed; don't redo it below
         sp = trace.current()
         if sp is None:  # untraced: no span objects anywhere below
             return self._execute(index_name, query, shards, opt)
         with sp.child(metrics.STAGE_EXECUTOR, index=index_name):
             return self._execute(index_name, query, shards, opt)
+
+    def _engine_eligible(self, opt) -> bool:
+        """Route this execute() through the async dispatch engine?
+        Only plain local reads: the PR 5/6 gang determinism contract
+        keeps multihost/federation execution ``serial`` and
+        engine-free; cluster fan-out and remote legs have their own
+        scheduling; traced queries must show real execution in their
+        span tree; and a thread already inside a wave re-enters inline
+        rather than deadlocking against its own runner slot."""
+        if self.gang is not None or self.cluster is not None:
+            return False
+        if opt is not None and (opt.remote or opt.serial):
+            return False
+        if trace.current() is not None:
+            return False
+        return not self.dispatch_engine.in_wave()
 
     def _execute(
         self,
@@ -487,14 +549,7 @@ class Executor:
             # concurrently lets the BatchedScorer coalesce their TopN
             # scoring into batched kernel launches — the intra-request
             # form of continuous micro-batching.
-            with self._read_pool_mu:
-                if self._read_pool is None:
-                    from concurrent.futures import ThreadPoolExecutor
-
-                    self._read_pool = ThreadPoolExecutor(
-                        max_workers=16, thread_name_prefix="pql-read"
-                    )
-                pool = self._read_pool  # local ref: close() may null the attr
+            pool = self._read_pool_acquire()
             parent = trace.current()  # contextvars don't follow pool workers
             pdl = dl  # nor does the request deadline
 
@@ -502,7 +557,15 @@ class Executor:
                 with trace.activate(parent), _deadline().activate(pdl):
                     return self._execute_call(index_name, call, shards, opt)
 
-            results = list(pool.map(run_call, calls))
+            if pool is None:
+                # close() in progress: run serially inline instead of
+                # racing a shutting-down pool
+                results = [run_call(c) for c in calls]
+            else:
+                try:
+                    results = list(pool.map(run_call, calls))
+                finally:
+                    self._read_pool_release()
         else:
             results = []
             for call in calls:
@@ -1977,9 +2040,81 @@ class Executor:
         if self.cluster is not None and not opt.remote:
             self.cluster.forward_to_all(index, c, opt)
 
-    def close(self) -> None:
-        """Release the read pool (called from Server.close)."""
-        with self._read_pool_mu:
+    def _read_pool_acquire(self):
+        """Check out the shared read pool (lazily built), or None while
+        close() is in progress. The checkout refcount lets close()
+        drain active ``pool.map`` users before shutting the pool down —
+        previously close() nulled the attribute while a concurrent
+        execute() held a local ref and raced ``shutdown``."""
+        with self._read_pool_cv:
+            if self._read_pool_closing:
+                return None
+            if self._read_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._read_pool = ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="pql-read"
+                )
+            self._read_pool_users += 1
+            return self._read_pool
+
+    def _read_pool_release(self) -> None:
+        with self._read_pool_cv:
+            self._read_pool_users -= 1
+            if self._read_pool_users == 0:
+                self._read_pool_cv.notify_all()
+
+    def _warm_query(self, index: str, query, shards) -> None:
+        """Advisory stage-ahead warm (dispatch engine): upload the Row
+        operands a QUEUED query will touch while the current wave
+        computes, so staging overlaps kernel execution. Best-effort —
+        every error is swallowed, staging is idempotent, and the real
+        execution re-stages whatever this missed."""
+        if self.device_policy == "never" or self._cpu_forced():
+            return
+        try:
+            idx = self.holder.index(index)
+            if idx is None:
+                return
+            if shards is None:
+                shards = list(range(idx.max_shard() + 1))
+            for call in query.calls:
+                self._warm_call(index, call, shards)
+        except BaseException:
+            pass
+
+    def _warm_call(self, index: str, c: Call, shards) -> None:
+        if c.name == "Row":
+            field_name = c.field_arg()
+            row_id, ok = c.uint_arg(field_name)
+            if not ok:
+                return
+            for shard in shards:
+                frag = self.holder.fragment(
+                    index, field_name, VIEW_STANDARD, shard
+                )
+                if frag is not None:
+                    self.stager.row(frag, row_id)
+            return
+        for child in c.children:
+            self._warm_call(index, child, shards)
+
+    def close(self, drain: float = 5.0) -> None:
+        """Drain the dispatch engine, then the read pool (called from
+        Server.close). New read-pool checkouts are refused from here on
+        (those executions run their calls serially inline); in-flight
+        ``pool.map`` users get up to ``drain`` seconds to finish before
+        the pool shuts down under them."""
+        if self.dispatch_engine is not None:
+            self.dispatch_engine.close(drain=drain)
+        t0 = time.monotonic()
+        with self._read_pool_cv:
+            self._read_pool_closing = True
+            while (
+                self._read_pool_users > 0
+                and time.monotonic() - t0 < drain
+            ):
+                self._read_pool_cv.wait(timeout=0.05)
             pool, self._read_pool = self._read_pool, None
         if pool is not None:
             pool.shutdown(wait=False)

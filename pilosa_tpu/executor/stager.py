@@ -43,7 +43,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Callable, Optional
 
 import jax
@@ -132,6 +132,13 @@ class DeviceStager:
         self.hits = 0
         self.misses = 0
         self.delta_applies = 0
+        # async stage-ahead (dispatch engine): a single advisory
+        # prefetch side-thread drains a bounded thunk queue — same
+        # idiom as the chunked TopN walk's _prefetch thread
+        self._ahead_q: deque = deque(maxlen=32)
+        self._ahead_mu = threading.Lock()
+        self._ahead_cv = threading.Condition(self._ahead_mu)
+        self._ahead_thread: Optional[threading.Thread] = None
 
     # -- internal --
 
@@ -768,6 +775,39 @@ class DeviceStager:
             build,
             delta,
         )
+
+    def stage_ahead(self, thunk) -> None:
+        """Queue an advisory warm thunk on the background prefetch
+        thread: the dispatch engine calls this with the NEXT wave's
+        operand staging while the current wave computes, so uploads
+        overlap kernel execution. Purely advisory — the deque is
+        bounded (oldest dropped under pressure), errors are swallowed,
+        and the real execution path re-stages anything missed. The
+        thread retires after a few idle seconds and restarts on the
+        next call."""
+        with self._ahead_mu:
+            self._ahead_q.append(thunk)
+            if self._ahead_thread is None or not self._ahead_thread.is_alive():
+                self._ahead_thread = threading.Thread(
+                    target=self._stage_ahead_loop,
+                    name="stage-ahead",
+                    daemon=True,
+                )
+                self._ahead_thread.start()
+            self._ahead_cv.notify()
+
+    def _stage_ahead_loop(self) -> None:
+        while True:
+            with self._ahead_mu:
+                while not self._ahead_q:
+                    if not self._ahead_cv.wait(timeout=5.0):
+                        self._ahead_thread = None
+                        return  # idle: let the thread retire
+                thunk = self._ahead_q.popleft()
+            try:
+                thunk()
+            except BaseException:
+                pass  # advisory: the query path stages for real
 
     def clear(self) -> None:
         with self._mu:

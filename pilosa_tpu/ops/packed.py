@@ -259,3 +259,41 @@ def device_put_rows(words64_rows: np.ndarray, device=None) -> jax.Array:
     r = words64_rows.shape[0] if words64_rows.ndim == 2 else 1
     w32 = words64_rows.reshape(r, -1).view("<u4")
     return jax.device_put(w32, device)
+
+
+# -- dispatch-engine support ------------------------------------------------
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def _zeros_like_donated(buf) -> jax.Array:
+    return jnp.zeros_like(buf)
+
+
+def zeros_like_donated(buf) -> jax.Array:
+    """Re-zero a reusable device scratch buffer, donating the old one.
+
+    On TPU/GPU the donated input aliases the output, so a drained
+    scratch (e.g. the batcher's pow2 pad lanes) is recycled in place
+    instead of allocating fresh HBM every wave. CPU ignores donation
+    (and warns), so fall back to a plain zeros_like there.
+    """
+    db = getattr(buf, "devices", None)
+    platform = ""
+    try:
+        if db is not None:
+            platform = next(iter(buf.devices())).platform
+    except BaseException:
+        platform = ""
+    if platform in ("", "cpu"):
+        return jnp.zeros_like(buf)
+    return _zeros_like_donated(buf)
+
+
+def materialize_all(arrays: list) -> list:
+    """np.asarray over a heterogeneous list of device results.
+
+    One fetch loop for a dispatch wave's outputs: each asarray blocks
+    until that computation is done, so later items' device work
+    overlaps earlier items' transfers.
+    """
+    return [np.asarray(a) for a in arrays]

@@ -182,6 +182,18 @@ class Config:
     # graceful-drain budget at shutdown: queued + in-flight work gets
     # this long to complete before being failed 503
     pipeline_drain_timeout: float = 10.0
+    # continuous-batching dispatch engine (executor/dispatch.py): the
+    # async executor↔device boundary. Callers submit futures; a
+    # persistent loop admits queued queries into in-flight waves grouped
+    # by canonical plan signature, so heterogeneous plans coexist in one
+    # wave and wave N+1 stages while wave N executes.
+    dispatch_enabled: bool = True
+    # max queries admitted into one wave
+    dispatch_max_wave: int = 16
+    # concurrent waves in flight (double/triple buffering depth)
+    dispatch_max_inflight: int = 2
+    # how many waves ahead the stager prefetches operand rows (0 = off)
+    dispatch_stage_ahead: int = 1
     # plan result cache (plan/cache.py): generation-stamped cross-request
     # result cache between parsing and execution. Entries are keyed by
     # canonical plan hash + shard set and validated against fragment
@@ -285,6 +297,10 @@ class Config:
             f"pipeline-batch-max = {self.pipeline_batch_max}",
             f"pipeline-default-timeout = {self.pipeline_default_timeout}",
             f"pipeline-drain-timeout = {self.pipeline_drain_timeout}",
+            f"dispatch-enabled = {'true' if self.dispatch_enabled else 'false'}",
+            f"dispatch-max-wave = {self.dispatch_max_wave}",
+            f"dispatch-max-inflight = {self.dispatch_max_inflight}",
+            f"dispatch-stage-ahead = {self.dispatch_stage_ahead}",
             f"plan-cache-enabled = {'true' if self.plan_cache_enabled else 'false'}",
             f"plan-cache-max-bytes = {self.plan_cache_max_bytes}",
             f"plan-cache-min-cost = {self.plan_cache_min_cost}",

@@ -188,6 +188,7 @@ class Handler:
             ),
             Route("GET", r"/metrics", self.get_metrics),
             Route("GET", r"/debug/pipeline", self.get_debug_pipeline),
+            Route("GET", r"/debug/dispatch", self.get_debug_dispatch),
             Route("GET", r"/debug/multihost", self.get_debug_multihost),
             Route("GET", r"/debug/plancache", self.get_debug_plancache),
             Route("GET", r"/debug/vars", self.get_debug_vars),
@@ -661,6 +662,15 @@ class Handler:
         if self.pipeline is None:
             return {"enabled": False}
         return self.pipeline.stats()
+
+    def get_debug_dispatch(self, req) -> dict:
+        """Continuous-batching dispatch engine snapshot: queue depth,
+        in-flight waves, wave/dedup/fallback counters, device-idle
+        fraction."""
+        engine = getattr(self.api.executor, "dispatch_engine", None)
+        if engine is None:
+            return {"enabled": False}
+        return engine.stats()
 
     def get_debug_traces(self, req) -> dict:
         """Recent completed query traces (the tracer's ring buffer) as

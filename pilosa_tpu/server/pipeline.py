@@ -192,6 +192,7 @@ class QueryPipeline:
         batch_window: float = 0.0,
         shed_retry_after: float = 1.0,
         drain_timeout: float = 10.0,
+        dispatch_handoff: bool = False,
     ) -> None:
         workers = workers or {}
         queue_limits = queue_limits or {}
@@ -212,6 +213,12 @@ class QueryPipeline:
         self.batch_window = float(batch_window)
         self.shed_retry_after = float(shed_retry_after)
         self.drain_timeout = float(drain_timeout)
+        # when the executor's continuous-batching dispatch engine owns
+        # cross-request combining (it groups heterogeneous plans by
+        # canonical signature per wave), workers hand entries off one at
+        # a time instead of gang-batching identical queries here —
+        # otherwise both layers would contend for the same backlog
+        self.dispatch_handoff = bool(dispatch_handoff)
         self._closing = False
         # signature -> leader entry (singleflight)
         self._inflight: dict = {}
@@ -325,7 +332,12 @@ class QueryPipeline:
         Caller holds the lock."""
         head = cq.q.popleft()
         gang = [head]
-        if head.batch_key is None or self.batch_max < 2 or not self.combine_fn:
+        if (
+            self.dispatch_handoff
+            or head.batch_key is None
+            or self.batch_max < 2
+            or not self.combine_fn
+        ):
             return gang
         if cq.q:
             keep: deque[_Entry] = deque()
@@ -461,6 +473,7 @@ class QueryPipeline:
                 "closing": self._closing,
                 "batch_max": self.batch_max,
                 "batch_window_s": self.batch_window,
+                "dispatch_handoff": self.dispatch_handoff,
                 "coalesce_hits": self.coalesce_hits,
                 "coalesce_inflight": len(self._inflight),
                 "batches": self.batches,

@@ -201,6 +201,10 @@ class Server:
                 else None
             ),
             plan_cache=self.plan_cache,
+            dispatch_enabled=self.config.dispatch_enabled,
+            dispatch_max_wave=self.config.dispatch_max_wave,
+            dispatch_max_inflight=self.config.dispatch_max_inflight,
+            dispatch_stage_ahead=self.config.dispatch_stage_ahead,
         )
         self.api = API(self.holder, self.executor, cluster=cluster, server=self)
         # federation (parallel/federation.py): epoch adopted from the
@@ -273,6 +277,13 @@ class Server:
                 batch_window=self.config.pipeline_batch_window,
                 shed_retry_after=self.config.pipeline_shed_retry_after,
                 drain_timeout=self.config.pipeline_drain_timeout,
+                # with the dispatch engine on, cross-request combining
+                # belongs to the engine (which also handles
+                # heterogeneous plans); pipeline workers hand items off
+                # one at a time instead of gang-batching them
+                dispatch_handoff=(
+                    self.executor.dispatch_engine is not None
+                ),
             )
         self.handler = Handler(
             self.api,

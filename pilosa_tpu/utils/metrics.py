@@ -138,6 +138,11 @@ PIPELINE_BATCHES = "pipeline.batches"
 PIPELINE_BATCH_WIDTH = "pipeline.batch_width"
 PIPELINE_DEADLINE_EXPIRED = "pipeline.deadline_expired"
 PIPELINE_DRAIN_SECONDS = "pipeline.drain_seconds"
+# async continuous-batching dispatch engine (executor/dispatch.py)
+DISPATCH_WAVE_SIZE = "dispatch.wave_size"
+DISPATCH_INFLIGHT_DEPTH = "dispatch.inflight_depth"
+DISPATCH_DEVICE_IDLE_FRACTION = "dispatch.device_idle_fraction"
+DISPATCH_QUEUE_WAIT_SECONDS = "dispatch.queue_wait_seconds"
 # device health gate
 DEVICEHEALTH_HEALTHY = "devicehealth.healthy"
 DEVICEHEALTH_TRIPS = "devicehealth.trips"
@@ -335,6 +340,22 @@ METRICS: dict[str, tuple[str, str]] = {
     PIPELINE_DRAIN_SECONDS: (
         "summary",
         "graceful-drain duration at shutdown",
+    ),
+    DISPATCH_WAVE_SIZE: (
+        "summary",
+        "queries admitted per continuous-batching dispatch wave",
+    ),
+    DISPATCH_INFLIGHT_DEPTH: (
+        "gauge",
+        "dispatch waves currently executing (double/triple buffering depth)",
+    ),
+    DISPATCH_DEVICE_IDLE_FRACTION: (
+        "gauge",
+        "fraction of wall time since first submit with NO wave executing — the number continuous batching drives down",
+    ),
+    DISPATCH_QUEUE_WAIT_SECONDS: (
+        "summary",
+        "time a submitted query waited in the dispatch queue before its wave launched",
     ),
     DEVICEHEALTH_HEALTHY: ("gauge", "1 while the device path is open, 0 while gated"),
     DEVICEHEALTH_TRIPS: ("counter", "device health gate trips (device gated off)"),

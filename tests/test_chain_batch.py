@@ -36,7 +36,11 @@ def executors(tmp_path):
             cols += (base + rng.choice(pool, size=k)).tolist()
     fld.import_bits(rows, cols)
     cpu = Executor(h, device_policy="never")
-    dev = Executor(h, device_policy="always")
+    # dispatch engine off: these tests pin the legacy thread-coalescing
+    # path, where each caller thread enqueues behind the chain scorer's
+    # dispatcher flag. With the engine on, cross-request combining
+    # happens at the wave layer instead (covered by tests/test_dispatch.py).
+    dev = Executor(h, device_policy="always", dispatch_enabled=False)
     dev._chain_batch = True  # coalescing is opt-in (see _make_chain_scorer)
     yield cpu, dev
     h.close()

@@ -341,7 +341,13 @@ def test_permuted_argument_order_queries_coalesce(tmp_path):
 
 
 def test_homogeneous_queued_queries_batch_into_one_execution(tmp_path):
-    s = make_server(tmp_path, pipeline_interactive_workers=1)
+    # dispatch_enabled=False pins the legacy pipeline gang-batching
+    # path: with the dispatch engine on, cross-request combining moves
+    # into the engine (dispatch_handoff) and is covered by
+    # tests/test_dispatch.py instead
+    s = make_server(
+        tmp_path, pipeline_interactive_workers=1, dispatch_enabled=False
+    )
     try:
         seed(s, "ba", n_rows=4)
         gate = threading.Event()
