@@ -123,10 +123,10 @@ def executor_level(out: dict) -> None:
             res[name] = {
                 "cold_ms": t_cold,
                 "pruned_ms": _measure(
-                    lambda q: ex.execute("m", q), [q_pruned], budget_s=15
+                    lambda q, ex=ex: ex.execute("m", q), [q_pruned], budget_s=15
                 ),
                 "full_ms": _measure(
-                    lambda q: ex.execute("m", q), [q_full], reps=5, budget_s=25
+                    lambda q, ex=ex: ex.execute("m", q), [q_full], reps=5, budget_s=25
                 ),
             }
             if name != "cpu":

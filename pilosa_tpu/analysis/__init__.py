@@ -1,0 +1,23 @@
+"""Invariant checker: static AST lints + dynamic lock-order detection.
+
+``pilosa_tpu check [--strict] [paths…]`` runs the static half; the
+dynamic half rides along wherever ``OrderedLock`` replaced a raw
+``threading.Lock`` (dispatch engine, pipeline, stager, plan cache,
+multihost gang lifecycle). See docs/development.md for the rule
+catalog and suppression syntax.
+"""
+
+from pilosa_tpu.analysis.lint import (  # noqa: F401
+    Finding,
+    RULES,
+    check_paths,
+    check_source,
+)
+from pilosa_tpu.analysis.locks import (  # noqa: F401
+    GRAPH,
+    LockGraph,
+    LockOrderError,
+    OrderedLock,
+    held_locks,
+    strict_mode,
+)

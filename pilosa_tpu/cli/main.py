@@ -19,7 +19,7 @@ import time
 import urllib.request
 from datetime import datetime
 
-from pilosa_tpu import SHARD_WIDTH, __version__
+from pilosa_tpu import __version__
 
 
 def main(argv=None) -> int:
@@ -112,8 +112,23 @@ def main(argv=None) -> int:
     p.add_argument("-o", "--output", help="output file (default stdout)")
     p.set_defaults(fn=cmd_export)
 
-    p = sub.add_parser("check", help="check integrity of fragment files")
-    p.add_argument("files", nargs="+")
+    p = sub.add_parser(
+        "check",
+        help="run the invariant checker over source trees, or verify "
+        "integrity of fragment files",
+    )
+    p.add_argument(
+        "files",
+        nargs="*",
+        help="directories / .py files → invariant checker; fragment "
+        "files → integrity check; no args → check the whole repo",
+    )
+    p.add_argument(
+        "--strict",
+        action="store_true",
+        help="also fail on suppression hygiene (unknown rule ids, "
+        "reasonless disables)",
+    )
     p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser("inspect", help="dump container layout of a fragment file")
@@ -402,9 +417,46 @@ def _open_lazy(path):
 
 
 def cmd_check(args) -> int:
-    """Verify fragment file integrity (reference ctl/check.go)."""
+    """Dispatch by path kind: source trees / .py files go to the
+    invariant checker (analysis/lint.py); anything else keeps the
+    original fragment-file integrity check (reference ctl/check.go).
+    No paths at all means lint the whole repo — the CI gate."""
+    import os
+
+    code_paths = [
+        p for p in args.files if os.path.isdir(p) or p.endswith(".py")
+    ]
+    frag_paths = [p for p in args.files if p not in code_paths]
+    if not args.files:
+        code_paths = None  # checker default: the repo root
     rc = 0
-    for path in args.files:
+    if code_paths is None or code_paths:
+        rc = max(rc, _check_code(code_paths, strict=args.strict))
+    if frag_paths:
+        rc = max(rc, _check_fragments(frag_paths))
+    return rc
+
+
+def _check_code(paths, strict: bool) -> int:
+    from pilosa_tpu.analysis import lint
+
+    findings = lint.check_paths(paths, strict=strict)
+    for f in findings:
+        print(f.format(), file=sys.stderr)
+    n_files = len(lint.iter_py_files(paths or [lint.repo_root()]))
+    if findings:
+        print(
+            f"check: {len(findings)} finding(s) in {n_files} files",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"check: clean ({n_files} files)")
+    return 0
+
+
+def _check_fragments(files) -> int:
+    rc = 0
+    for path in files:
         if path.endswith(".cache") or path.endswith(".snapshotting"):
             continue
         try:

@@ -18,7 +18,7 @@ from pilosa_tpu import SHARD_WIDTH
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.core.fragment import _sized
 from pilosa_tpu.core.row import Row
-from pilosa_tpu.core.timequantum import views_by_time, views_by_time_range
+from pilosa_tpu.core.timequantum import views_by_time
 from pilosa_tpu.core.view import VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD, View
 
 FIELD_TYPE_SET = "set"

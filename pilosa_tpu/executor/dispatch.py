@@ -60,6 +60,7 @@ import time
 from collections import deque
 from typing import Any, Optional
 
+from pilosa_tpu.analysis.locks import OrderedLock
 from pilosa_tpu.pql import Query
 from pilosa_tpu.utils import metrics
 
@@ -150,7 +151,7 @@ class DispatchEngine:
         self.max_wave = max(1, int(max_wave))
         self.max_inflight = max(1, int(max_inflight))
         self.stage_ahead_depth = max(0, int(stage_ahead))
-        self._mu = threading.Lock()
+        self._mu = OrderedLock("dispatch.mu")
         self._cond = threading.Condition(self._mu)
         self._q: deque[_Item] = deque()
         self._closing = False

@@ -24,7 +24,7 @@ import threading
 import time
 from dataclasses import dataclass
 from datetime import datetime
-from typing import Any, Callable, Optional
+from typing import Any, Optional
 
 import numpy as np
 
@@ -32,9 +32,8 @@ from pilosa_tpu.utils import metrics, trace
 
 from pilosa_tpu import SHARD_WIDTH, ops
 from pilosa_tpu.core import Row, TopOptions, VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD
-from pilosa_tpu.core.cache import CACHE_TYPE_NONE, sort_pairs
+from pilosa_tpu.core.cache import sort_pairs
 from pilosa_tpu.core.cache import pairs_arrays as cache_pairs_arrays
-from pilosa_tpu.core.field import FIELD_TYPE_SET
 from pilosa_tpu.core.fragment import DEFAULT_MIN_THRESHOLD
 from pilosa_tpu.core.timequantum import TIME_FORMAT, views_by_time_range
 from pilosa_tpu.executor.batcher import BatchedScorer

@@ -50,6 +50,7 @@ import jax
 import numpy as np
 
 from pilosa_tpu import SHARD_WIDTH, ops
+from pilosa_tpu.analysis.locks import OrderedLock
 from pilosa_tpu.utils import metrics, trace
 
 _W32 = SHARD_WIDTH // 32  # u32 words per staged row
@@ -123,7 +124,7 @@ class DeviceStager:
         self.delta_max_ratio = delta_max_ratio
         self._cache: OrderedDict[tuple, _Entry] = OrderedDict()
         self._bytes = 0
-        self._mu = threading.Lock()
+        self._mu = OrderedLock("stager.mu")
         self._inflight: dict[tuple, _InFlight] = {}
         # bumped by reset_after_wedge: a builder that started before a
         # wedge publishes to its own waiters but must never re-insert a
@@ -136,7 +137,7 @@ class DeviceStager:
         # prefetch side-thread drains a bounded thunk queue — same
         # idiom as the chunked TopN walk's _prefetch thread
         self._ahead_q: deque = deque(maxlen=32)
-        self._ahead_mu = threading.Lock()
+        self._ahead_mu = OrderedLock("stager.ahead_mu")
         self._ahead_cv = threading.Condition(self._ahead_mu)
         self._ahead_thread: Optional[threading.Thread] = None
 
@@ -785,16 +786,21 @@ class DeviceStager:
         and the real execution path re-stages anything missed. The
         thread retires after a few idle seconds and restarts on the
         next call."""
+        start: Optional[threading.Thread] = None
         with self._ahead_mu:
             self._ahead_q.append(thunk)
-            if self._ahead_thread is None or not self._ahead_thread.is_alive():
-                self._ahead_thread = threading.Thread(
+            t = self._ahead_thread
+            # ident None = created by a racing caller but not yet
+            # started (start() happens below, outside the lock)
+            if t is None or (t.ident is not None and not t.is_alive()):
+                start = self._ahead_thread = threading.Thread(
                     target=self._stage_ahead_loop,
                     name="stage-ahead",
                     daemon=True,
                 )
-                self._ahead_thread.start()
             self._ahead_cv.notify()
+        if start is not None:
+            start.start()
 
     def _stage_ahead_loop(self) -> None:
         while True:

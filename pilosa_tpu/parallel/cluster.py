@@ -32,12 +32,7 @@ from pilosa_tpu.parallel.multihost import GangUnavailable
 from pilosa_tpu.parallel.node import Node
 from pilosa_tpu.utils import metrics, trace
 from pilosa_tpu.utils.errors import NotFoundError
-from pilosa_tpu.parallel.wire import (
-    decode_shard_result,
-    encode_shard_result,
-    pairs_to_tuples,
-    tuples_to_pairs,
-)
+from pilosa_tpu.parallel.wire import pairs_to_tuples
 
 STATE_STARTING = "STARTING"
 STATE_NORMAL = "NORMAL"

@@ -64,6 +64,7 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from pilosa_tpu.analysis.locks import OrderedLock
 from pilosa_tpu.utils import metrics
 
 # -- gang lifecycle ----------------------------------------------------------
@@ -344,7 +345,7 @@ class LoopbackChannel:
 
         self.frame_bytes = frame_bytes
         self._q: "collections.deque[bytes]" = collections.deque()
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(OrderedLock("multihost.loopback.mu"))
         self._closed = False
 
     def send(self, frames) -> None:
@@ -742,11 +743,18 @@ class MultiHostRuntime:
         self.on_reform: Optional[Callable[[], None]] = None
         self.on_state_change: Optional[Callable[[str, int], None]] = None
         self._in_gang = threading.local()
-        self._mu = threading.Lock()
+        self._mu = OrderedLock("multihost.gang.mu")
         self._cond = threading.Condition(self._mu)
         self._queue: list[tuple[Descriptor, "_Future"]] = []
         self._closing = False
         self._loop_gen = 0  # bumped at degrade/reform: zombie loops exit
+        # degrade swap fence: between the DEGRADED verdict and the
+        # on_degrade hook finishing, local execution would still target
+        # the dead collective plane — route decisions wait this out
+        self._degrading = False
+        self._degrading_thread: Optional[int] = None
+        self._degrade_evt = threading.Event()
+        self._degrade_evt.set()
         self._leader_thread: Optional[threading.Thread] = None
         self._ticker_thread: Optional[threading.Thread] = None
         self._last_send = time.monotonic()
@@ -836,6 +844,16 @@ class MultiHostRuntime:
     def _exit_gang(self):
         self._in_gang.value = False
 
+    def _degrade_fence(self) -> None:
+        """Block (bounded) while a degrade is mid-swap. The moment
+        ``state`` reads DEGRADED callers run on the local executor,
+        and that is only safe after ``on_degrade`` has swapped it off
+        the dead collective plane — so route decisions made during the
+        swap wait for it to finish. The degrading thread itself (it
+        runs the hook) must never wait on its own fence."""
+        if self._degrading and self._degrading_thread != threading.get_ident():
+            self._degrade_evt.wait(timeout=self.dispatch_timeout)
+
     def should_dispatch(self) -> bool:
         """Should work on THIS thread be routed through the gang?
         Leader only, gang alive, and not already inside a gang replay
@@ -846,6 +864,7 @@ class MultiHostRuntime:
         next reform()."""
         if not (self.active and self.rank == 0 and not self.in_gang_thread()):
             return False
+        self._degrade_fence()
         if self.state == STATE_REFORMING:
             # control messages apply locally-only during the (brief)
             # re-form fence — the rejoin push carries full state anyway,
@@ -869,6 +888,7 @@ class MultiHostRuntime:
         """
         if not (self.active and self.rank == 0 and not self.in_gang_thread()):
             return False
+        self._degrade_fence()
         if not self.federated:
             return not remote and not self.degraded
         if self.mode == MODE_COLLECTIVE:
@@ -884,6 +904,7 @@ class MultiHostRuntime:
         LOCAL leg (the ``import_*_local`` entry points)."""
         if not (self.active and self.rank == 0 and not self.in_gang_thread()):
             return False
+        self._degrade_fence()
         if self.federated:
             if self.mode == MODE_COLLECTIVE and self.degraded:
                 return False
@@ -924,6 +945,7 @@ class MultiHostRuntime:
         with self._mu:
             refused = (
                 self._closing
+                or self._degrading
                 or not self.active
                 or self.state == STATE_REFORMING
                 or (self.state == STATE_DEGRADED and self.mode == MODE_COLLECTIVE)
@@ -1088,21 +1110,36 @@ class MultiHostRuntime:
         replicas, and reform() restores ACTIVE when a follower
         rejoins."""
         with self._mu:
-            if self.state in (STATE_DEGRADED, STATE_REFORMING):
+            if self._degrading or self.state in (STATE_DEGRADED, STATE_REFORMING):
                 return
+            # fence BEFORE the state flip: dispatch refuses new work and
+            # route decisions wait in _degrade_fence until on_degrade has
+            # swapped the executor — if state read DEGRADED first, a
+            # query could run locally on the dead collective plane
+            # (observed: post-degrade Count on the global mesh → 'Gloo
+            # all-reduce failed: Connection reset by peer')
+            self._degrading = True
+            self._degrading_thread = threading.get_ident()
+            self._degrade_evt.clear()
             stale, self._queue = self._queue, []
             self._loop_gen += 1  # a wedged leader loop must not touch new work
         for _, fut in stale:
             fut.error = GangUnavailable(f"multihost gang degraded: {reason}")
             fut.event.set()
         metrics.count(metrics.MULTIHOST_ABORTS, role="leader")
-        self._set_state(STATE_DEGRADED, reason)
-        if self.on_degrade is not None:
-            try:
-                self.on_degrade()
-            except Exception as e:
-                if self.logger is not None:
-                    self.logger.printf("multihost degrade hook error: %s", e)
+        try:
+            if self.on_degrade is not None:
+                try:
+                    self.on_degrade()
+                except Exception as e:
+                    if self.logger is not None:
+                        self.logger.printf("multihost degrade hook error: %s", e)
+        finally:
+            self._set_state(STATE_DEGRADED, reason)
+            with self._mu:
+                self._degrading = False
+                self._degrading_thread = None
+            self._degrade_evt.set()
         if self.federated and self.active and self.rank == 0:
             # keep serving: replicated-solo on the local mesh the
             # degrade hook just installed. Writes apply locally-only;

@@ -18,8 +18,6 @@ by design, scaling to multi-host by making the "shards" axis span hosts
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

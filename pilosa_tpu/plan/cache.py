@@ -39,6 +39,7 @@ import time
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
+from pilosa_tpu.analysis.locks import OrderedLock
 from pilosa_tpu.utils import metrics
 
 DEFAULT_MAX_BYTES = 256 << 20
@@ -124,7 +125,7 @@ class PlanCache:
         # 50 us Count costs more in bookkeeping + eviction pressure
         # than it saves. 0 caches everything (the tested default).
         self.min_cost = float(min_cost)
-        self._mu = threading.Lock()
+        self._mu = OrderedLock("plancache.mu")
         self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
         self._building: dict[tuple, threading.Event] = {}
         self.bytes = 0

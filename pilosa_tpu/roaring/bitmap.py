@@ -26,7 +26,7 @@ can be ingested directly and vice versa.
 from __future__ import annotations
 
 import struct
-from typing import Callable, Iterable, Iterator, Optional
+from typing import Callable, Iterator, Optional
 
 import numpy as np
 

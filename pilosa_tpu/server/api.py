@@ -9,7 +9,7 @@ restricted set is callable.
 from __future__ import annotations
 
 import io
-from typing import Any, Optional
+from typing import Optional
 
 import numpy as np
 

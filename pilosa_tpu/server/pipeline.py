@@ -57,6 +57,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Optional
 
+from pilosa_tpu.analysis.locks import OrderedLock
 from pilosa_tpu.server import deadline as deadline_mod
 from pilosa_tpu.server.deadline import Deadline, DeadlineExceeded
 from pilosa_tpu.utils import metrics
@@ -198,7 +199,7 @@ class QueryPipeline:
         queue_limits = queue_limits or {}
         defaults_w = {CLASS_INTERACTIVE: 8, CLASS_BULK: 2, CLASS_INTERNAL: 8}
         defaults_q = {CLASS_INTERACTIVE: 64, CLASS_BULK: 16, CLASS_INTERNAL: 128}
-        self._mu = threading.Lock()
+        self._mu = OrderedLock("pipeline.mu")
         self._cond = threading.Condition(self._mu)
         self._classes = {
             c: _ClassQueue(
@@ -452,14 +453,17 @@ class QueryPipeline:
         for t in self._threads:
             t.join(timeout=max(0.0, drain - (time.monotonic() - t0)))
         clean = True
+        # pop under the lock, finish outside it: _finish re-acquires
+        # _mu to drop the coalescing-inflight entry, so calling it here
+        # with _mu held self-deadlocks on any queued signatured request
+        leftovers: list[_Entry] = []
         with self._mu:
             for cq in self._classes.values():
                 while cq.q:
                     clean = False
-                    e = cq.q.popleft()
-                    self._finish(
-                        e, error=Overloaded("server shut down", status=503)
-                    )
+                    leftovers.append(cq.q.popleft())
+        for e in leftovers:
+            self._finish(e, error=Overloaded("server shut down", status=503))
         metrics.observe(metrics.PIPELINE_DRAIN_SECONDS, time.monotonic() - t0)
         return clean and all(not t.is_alive() for t in self._threads)
 

@@ -143,6 +143,9 @@ DISPATCH_WAVE_SIZE = "dispatch.wave_size"
 DISPATCH_INFLIGHT_DEPTH = "dispatch.inflight_depth"
 DISPATCH_DEVICE_IDLE_FRACTION = "dispatch.device_idle_fraction"
 DISPATCH_QUEUE_WAIT_SECONDS = "dispatch.queue_wait_seconds"
+# invariant checker — dynamic lock-order detection (analysis/locks.py)
+ANALYSIS_LOCK_CYCLES = "analysis.lock_cycles"
+ANALYSIS_LOCK_GRAPH_EDGES = "analysis.lock_graph_edges"
 # device health gate
 DEVICEHEALTH_HEALTHY = "devicehealth.healthy"
 DEVICEHEALTH_TRIPS = "devicehealth.trips"
@@ -356,6 +359,15 @@ METRICS: dict[str, tuple[str, str]] = {
     DISPATCH_QUEUE_WAIT_SECONDS: (
         "summary",
         "time a submitted query waited in the dispatch queue before its wave launched",
+    ),
+    ANALYSIS_LOCK_CYCLES: (
+        "gauge",
+        "distinct lock-order cycles observed by the OrderedLock graph "
+        "(any nonzero value is a latent deadlock; strict mode raises instead)",
+    ),
+    ANALYSIS_LOCK_GRAPH_EDGES: (
+        "gauge",
+        "acquired-while-holding edges recorded in the global lock graph",
     ),
     DEVICEHEALTH_HEALTHY: ("gauge", "1 while the device path is open, 0 while gated"),
     DEVICEHEALTH_TRIPS: ("counter", "device health gate trips (device gated off)"),

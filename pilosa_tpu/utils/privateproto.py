@@ -26,14 +26,9 @@ publicproto — two dozen flat structs don't warrant a protobuf runtime.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Callable
 
-from pilosa_tpu.utils.protometa import (
-    _read_varint,
-    _signed64,
-    _write_tag,
-    _write_varint,
-)
+from pilosa_tpu.utils.protometa import _signed64, _write_tag, _write_varint
 from pilosa_tpu.utils.publicproto import (
     _decode_multi,
     _first,

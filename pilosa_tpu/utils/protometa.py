@@ -13,7 +13,6 @@ dependency isn't warranted.
 
 from __future__ import annotations
 
-from typing import Optional
 
 
 def _read_varint(data: bytes, i: int) -> tuple[int, int]:

@@ -5,7 +5,6 @@ multi fan-out implementations."""
 from __future__ import annotations
 
 import threading
-import time
 from typing import Optional
 
 from pilosa_tpu.utils.metrics import LogHistogram

@@ -3,7 +3,6 @@ boltdb/attrstore.go:82), merge semantics, block-checksum diff
 (attr.go:90-120), LRU bounding, and round-3 JSONL migration."""
 
 import json
-import os
 
 from pilosa_tpu.utils.attrstore import ATTR_BLOCK_SIZE, AttrStore
 
@@ -42,7 +41,8 @@ class TestBasics:
         diff = AttrStore.diff_blocks(a.blocks(), b.blocks())
         assert diff == [150 // ATTR_BLOCK_SIZE]
         assert b.block_data(1)[150] == {"v": -1}
-        a.close(); b.close()
+        a.close()
+        b.close()
 
 
 class TestBoundedMemory:
@@ -153,4 +153,5 @@ class TestMigration:
         fresh = AttrStore(str(tmp_path / "new.db"))
         fresh.set_attrs(7, {"a": 1, "b": 2})
         assert migrated.blocks() == fresh.blocks()
-        migrated.close(); fresh.close()
+        migrated.close()
+        fresh.close()

@@ -3,7 +3,6 @@ comes from measured dispatch RTT vs per-container CPU cost, a high-RTT
 rig routes small queries to CPU with NO env var, and a wedged device
 never stalls startup."""
 
-import numpy as np
 
 from pilosa_tpu import SHARD_WIDTH
 from pilosa_tpu.core import Holder

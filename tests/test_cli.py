@@ -1,11 +1,8 @@
 """CLI tests — each subcommand against live servers (mirrors reference
 ctl/*_test.go)."""
 
-import io
 import json
 import os
-import sys
-import threading
 import urllib.request
 
 import pytest

@@ -9,7 +9,7 @@ import urllib.request
 import pytest
 
 from pilosa_tpu import SHARD_WIDTH
-from pilosa_tpu.parallel.hashing import Jmphasher, ModHasher, fnv64a, jump_hash, partition
+from pilosa_tpu.parallel.hashing import fnv64a, jump_hash, partition
 from pilosa_tpu.parallel.node import Node, URI
 from pilosa_tpu.server import ClusterConfig, Config, Server
 

@@ -17,7 +17,7 @@ from pilosa_tpu.core import (
     TopOptions,
     VIEW_STANDARD,
 )
-from pilosa_tpu.core.field import FIELD_TYPE_INT, FIELD_TYPE_SET, FIELD_TYPE_TIME
+from pilosa_tpu.core.field import FIELD_TYPE_INT, FIELD_TYPE_TIME
 
 
 def mem_fragment(shard=0, **kw):

@@ -5,9 +5,8 @@ tail of reference executor_test.go)."""
 import numpy as np
 import pytest
 
-from pilosa_tpu import SHARD_WIDTH
 from pilosa_tpu.core import FieldOptions, Holder
-from pilosa_tpu.executor import ExecOptions, Executor
+from pilosa_tpu.executor import Executor
 from pilosa_tpu.utils.attrstore import AttrStore
 from pilosa_tpu.utils.translate import TranslateStore
 

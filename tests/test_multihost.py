@@ -15,7 +15,6 @@ import pytest
 
 from pilosa_tpu.parallel import multihost
 from pilosa_tpu.parallel.multihost import (
-    ChannelClosed,
     Descriptor,
     GangFollower,
     GangUnavailable,
@@ -25,6 +24,7 @@ from pilosa_tpu.parallel.multihost import (
     KIND_TICK,
     LoopbackChannel,
     MultiHostRuntime,
+    STATE_DEGRADED,
     decode_frame,
     decode_message,
     encode_message,
@@ -244,6 +244,55 @@ def test_leader_dispatch_timeout_degrades_and_503s():
     with pytest.raises(GangUnavailable):
         rt.dispatch(Descriptor(KIND_QUERY, {}))
     assert time.monotonic() - t0 < 0.1
+
+
+def test_degrade_swaps_executor_before_state_flip():
+    """Regression: degrade() used to flip state to DEGRADED before the
+    on_degrade hook had swapped the executor off the dead collective
+    plane — a query routed in that window ran a cross-process
+    collective on the poisoned gloo context ('Gloo all-reduce failed:
+    Connection reset by peer'). The hook must complete before the
+    state flip is visible, and route decisions made mid-swap must
+    wait for it."""
+    hook_entered = threading.Event()
+    release_hook = threading.Event()
+    seen = {}
+
+    def hook():
+        seen["state_in_hook"] = rt.state
+        hook_entered.set()
+        assert release_hook.wait(timeout=5)
+
+    rt = MultiHostRuntime(
+        rank=0,
+        world=2,
+        channel=LoopbackChannel(4096),
+        apply_fn=lambda k, p: None,
+        idle_interval=0,
+        dispatch_timeout=5.0,
+        on_degrade=hook,
+    )
+    deg = threading.Thread(target=rt.degrade, args=("test",))
+    deg.start()
+    assert hook_entered.wait(timeout=5)
+    # mid-swap: the verdict is in (new dispatches refuse) but the
+    # executor handoff is not done — route decisions must block here
+    with pytest.raises(GangUnavailable):
+        rt.dispatch(Descriptor(KIND_QUERY, {}))
+    decided = []
+    router = threading.Thread(
+        target=lambda: decided.append(rt.should_dispatch_query(False))
+    )
+    router.start()
+    router.join(timeout=0.3)
+    assert router.is_alive(), "route decision did not wait for the swap"
+    release_hook.set()
+    deg.join(timeout=5)
+    router.join(timeout=5)
+    assert not router.is_alive() and decided == [False]
+    assert seen["state_in_hook"] != STATE_DEGRADED
+    assert rt.state == STATE_DEGRADED
+    rt.close()
 
 
 def test_leader_request_deadline_does_not_degrade():

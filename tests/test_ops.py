@@ -6,7 +6,6 @@ so a transcription bug in both places can't hide."""
 
 import numpy as np
 import pytest
-import jax.numpy as jnp
 
 from pilosa_tpu import ops
 

@@ -2,7 +2,6 @@
 plus serialization round-trips (mirrors the reference's
 roaring/roaring_internal_test.go strategy)."""
 
-import io
 
 import numpy as np
 import pytest

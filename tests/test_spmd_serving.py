@@ -13,7 +13,7 @@ import pytest
 
 from pilosa_tpu import SHARD_WIDTH
 from pilosa_tpu.core import FieldOptions, Holder
-from pilosa_tpu.core.field import FIELD_TYPE_INT, FIELD_TYPE_TIME
+from pilosa_tpu.core.field import FIELD_TYPE_INT
 from pilosa_tpu.executor import Executor
 from pilosa_tpu.parallel.spmd import make_mesh
 

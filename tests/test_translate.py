@@ -213,8 +213,10 @@ class TestCheckpoint:
         assert ts2.translate_columns_to_ids("i", ["k42"], create=False) == [43]
         # mint a tail, then simulate a crash (no checkpoint refresh)
         ts2.translate_columns_to_ids("i", ["tail1", "tail2"])
-        ts2._log.close(); ts2._log = None
-        os.close(ts2._read_fd); ts2._read_fd = None
+        ts2._log.close()
+        ts2._log = None
+        os.close(ts2._read_fd)
+        ts2._read_fd = None
         ts3 = TranslateStore(p)
         assert ts3.translate_columns_to_ids("i", ["tail2"], create=False) == [5002]
         assert ts3.translate_columns_to_ids("i", ["k0"], create=False) == [1]
