@@ -188,6 +188,36 @@ def _spawn(env: dict, tmp: str, name: str, **overrides):
     return p
 
 
+def _http_h(
+    port: int,
+    method: str,
+    path: str,
+    body: bytes = b"",
+    headers: dict | None = None,
+    timeout: float = 60,
+):
+    """Like dryrun_multihost._http, plus request headers (the
+    observability phase sends a traceparent)."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request(method, path, body, headers or {})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _collect_pids(d: dict, out: set) -> set:
+    meta = d.get("meta") or {}
+    if "pid" in meta:
+        out.add(meta["pid"])
+    for c in d.get("children") or []:
+        _collect_pids(c, out)
+    return out
+
+
 def _gang_status(port: int) -> dict:
     status, body = _http(port, "GET", "/status", timeout=10)
     if status != 200:
@@ -357,6 +387,69 @@ def parent(quick: bool) -> int:
                 },
             }
 
+            # -- phase 1.5: fleet observability (ISSUE 10) ----------------
+            # one traceparent-tagged cross-gang query must come back as
+            # ONE stitched trace with spans from >=3 distinct processes
+            # (A leader root+replay, A follower's pushed replay, B
+            # leader's envelope), and the A leader's fleet scrape must
+            # carry every rank's build_info, instance-labeled
+            tid = os.urandom(16).hex()
+            tp = f"00-{tid}-{os.urandom(8).hex()}-01"
+            # a write + TopN chain NOT in READ_QUERIES (and cache=false
+            # at ingress): a plan-cache hit on either leader would
+            # short-circuit the dispatch and emit no gang replay spans.
+            # Row 88 / column 9001 stay outside the oracle's rows so
+            # later bit-identity checks are unaffected
+            st_t, _ = _http_h(
+                http_a,
+                "POST",
+                "/index/i/query?cache=false",
+                b"Set(9001, f=88) TopN(f, n=3)",
+                headers={"traceparent": tp},
+                timeout=120,
+            )
+            pids: set = set()
+            n_entries = 0
+            t_end = time.monotonic() + 30
+            while time.monotonic() < t_end:
+                st, body = _http(http_a, "GET", f"/debug/traces?trace_id={tid}")
+                if st == 200:
+                    entries = json.loads(body).get("traces") or []
+                    n_entries = len(entries)
+                    pids = set()
+                    for d in entries:
+                        _collect_pids(d, pids)
+                    if len(pids) >= 3:
+                        break
+                time.sleep(0.5)
+            trace_ok = st_t == 200 and n_entries >= 1 and len(pids) >= 3
+            fleet_instances: set = set()
+            t_end = time.monotonic() + 30
+            while time.monotonic() < t_end:
+                st, body = _http(http_a, "GET", "/metrics?fleet=true")
+                if st == 200:
+                    fleet_instances = {
+                        line.split('instance="', 1)[1].split('"', 1)[0]
+                        for line in body.decode().splitlines()
+                        if line.startswith("pilosa_build_info{")
+                        and 'instance="' in line
+                    }
+                    if len(fleet_instances) >= 4:
+                        break
+                time.sleep(0.5)
+            fleet_ok = len(fleet_instances) >= 4
+            obs_ok = trace_ok and fleet_ok
+            ok &= obs_ok
+            summary["observability"] = {
+                "ok": obs_ok,
+                "trace_id": tid,
+                "stitched_trace_found": n_entries >= 1,
+                "distinct_pids_in_trace": sorted(pids),
+                "trace_spans_from_3plus_processes": trace_ok,
+                "fleet_build_info_instances": sorted(fleet_instances),
+                "fleet_scrape_all_ranks": fleet_ok,
+            }
+
             # -- phase 2: follower SIGKILL -> bounded fence + DEGRADED ----
             t_kill = time.monotonic()
             procs["A1"].kill()
@@ -444,6 +537,33 @@ def parent(quick: bool) -> int:
                 "write_replicated_to_rejoined_follower": repl == [1],
                 "leader_a_bit_identical_after_reform": ok_a3,
             }
+
+            # -- phase 3.5: the kill/rejoin cycle in the event journal ----
+            # A's leader must journal ACTIVE->DEGRADED, then
+            # DEGRADED->REFORMING, then REFORMING->ACTIVE, in seq order,
+            # with the epoch bumped across the cycle
+            st, body = _http(http_a, "GET", "/debug/events?kind=gang.transition")
+            edges = [
+                (e.get("frm"), e.get("to"), e.get("epoch", 0))
+                for e in (json.loads(body).get("events") or [])
+            ] if st == 200 else []
+
+            def _edge_idx(frm: str, to: str) -> int:
+                for i, (f, t, _) in enumerate(edges):
+                    if f == frm and t == to:
+                        return i
+                return -1
+
+            i_deg = _edge_idx("ACTIVE", "DEGRADED")
+            i_ref = _edge_idx("DEGRADED", "REFORMING")
+            i_act = _edge_idx("REFORMING", "ACTIVE")
+            events_ok = (
+                0 <= i_deg < i_ref < i_act
+                and edges[i_act][2] > edges[i_deg][2]
+            )
+            ok &= events_ok
+            summary["observability"]["events_ok"] = events_ok
+            summary["observability"]["gang_a_transitions"] = edges
 
             # -- phase 4: leader SIGKILL -> failover -> solo restart ------
             t_kill = time.monotonic()

@@ -1,8 +1,8 @@
 """Command-line interface (L8) — reference cmd/ + ctl/.
 
-Subcommands: server, import, export, check, inspect, config,
-generate-config. Config precedence: flags > env (PILOSA_TPU_*) > TOML
-file (reference cmd/root.go:90-146).
+Subcommands: server, import, export, check, inspect, metrics, events,
+config, generate-config. Config precedence: flags > env (PILOSA_TPU_*)
+> TOML file (reference cmd/root.go:90-146).
 
 Run as ``python -m pilosa_tpu <subcommand>``.
 """
@@ -16,6 +16,7 @@ import os
 import signal
 import sys
 import time
+import urllib.parse
 import urllib.request
 from datetime import datetime
 
@@ -163,7 +164,31 @@ def main(argv=None) -> int:
         help="fetch /debug/dispatch (continuous-batching dispatch engine "
         "wave/queue/idle snapshot) instead",
     )
+    p.add_argument(
+        "--fleet",
+        action="store_true",
+        help="fetch the fleet-aggregated exposition (/metrics?fleet=true, "
+        "gang/federation leaders only) instead",
+    )
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser(
+        "events",
+        help="fetch a node's lifecycle event journal (/debug/events)",
+    )
+    p.add_argument("--host", default="http://localhost:10101")
+    p.add_argument(
+        "--kind",
+        help="only events of this kind (e.g. gang.transition, gang.degrade, "
+        "gang.reform, client.retry_exhausted)",
+    )
+    p.add_argument(
+        "--since",
+        type=int,
+        default=0,
+        help="only events with a sequence number above this",
+    )
+    p.set_defaults(fn=cmd_events)
 
     p = sub.add_parser("config", help="print the effective configuration")
     p.add_argument("-c", "--config", help="TOML config file")
@@ -553,8 +578,26 @@ def cmd_metrics(args) -> int:
         with urllib.request.urlopen(host + path, timeout=60) as resp:
             print(json.dumps(json.loads(resp.read().decode()), indent=2))
         return 0
+    if getattr(args, "fleet", False):
+        path = "/metrics?fleet=true"
     with urllib.request.urlopen(host + path, timeout=60) as resp:
         print(resp.read().decode(), end="")
+    return 0
+
+
+def cmd_events(args) -> int:
+    """Dump a node's lifecycle event journal: gang state transitions,
+    degrades, re-formations, and retry exhaustions, each stamped with
+    seq/time/trace/gang/rank/epoch."""
+    host = args.host if args.host.startswith("http") else f"http://{args.host}"
+    query = []
+    if args.kind:
+        query.append(f"kind={urllib.parse.quote(args.kind)}")
+    if args.since:
+        query.append(f"since={args.since}")
+    path = "/debug/events" + (("?" + "&".join(query)) if query else "")
+    with urllib.request.urlopen(host + path, timeout=60) as resp:
+        print(json.dumps(json.loads(resp.read().decode()), indent=2))
     return 0
 
 

@@ -62,7 +62,7 @@ from typing import Any, Optional
 
 from pilosa_tpu.analysis.locks import OrderedLock
 from pilosa_tpu.pql import Query
-from pilosa_tpu.utils import metrics
+from pilosa_tpu.utils import metrics, trace
 
 # Request-deadline seam (server/deadline.py), imported lazily for the
 # same L4→L6 layering reason as executor.py.
@@ -94,9 +94,12 @@ class _Item:
         "error",
         "t_enq",
         "wait_s",
+        "trace_ctx",
     )
 
-    def __init__(self, index, query, shards, opt, deadline, signature) -> None:
+    def __init__(
+        self, index, query, shards, opt, deadline, signature, trace_ctx=None
+    ) -> None:
         self.index = index
         self.query = query
         self.shards = shards
@@ -109,6 +112,9 @@ class _Item:
         self.error: Optional[BaseException] = None
         self.t_enq = 0.0
         self.wait_s = 0.0
+        # distributed trace context (utils/trace.py tuple): a deduped
+        # item span-links the executed item it shared results with
+        self.trace_ctx = trace_ctx
 
     def finish(self, result=None, error=None) -> None:
         self.value = result
@@ -188,6 +194,7 @@ class DispatchEngine:
         opt,
         deadline=None,
         text: Optional[str] = None,
+        trace_ctx=None,
     ) -> Optional[_Item]:
         """Enqueue a read-only query for the next wave and return its
         future — or ``None`` when the engine is closing, in which case
@@ -198,7 +205,7 @@ class DispatchEngine:
             from pilosa_tpu.plan import canon
 
             sig = canon.query_signature(text)
-        item = _Item(index, query, shards, opt, deadline, sig)
+        item = _Item(index, query, shards, opt, deadline, sig, trace_ctx=trace_ctx)
         with self._mu:
             if self._closing:
                 return None
@@ -240,6 +247,7 @@ class DispatchEngine:
                     self._slots.release()
                     continue
                 self.waves += 1
+                wave_no = self.waves
                 self._inflight += 1
                 if self._inflight == 1:
                     self._busy_since = time.monotonic()
@@ -249,14 +257,14 @@ class DispatchEngine:
             self._stage_ahead_peek()
             threading.Thread(
                 target=self._run_wave_slot,
-                args=(wave,),
+                args=(wave, wave_no),
                 name="dispatch-wave",
                 daemon=True,
             ).start()
 
-    def _run_wave_slot(self, wave: list[_Item]) -> None:
+    def _run_wave_slot(self, wave: list[_Item], wave_no: int = 0) -> None:
         try:
-            self._run_wave(wave)
+            self._run_wave(wave, wave_no)
         finally:
             with self._mu:
                 self._inflight -= 1
@@ -285,7 +293,7 @@ class DispatchEngine:
 
     # -- wave execution ------------------------------------------------------
 
-    def _run_wave(self, wave: list[_Item]) -> None:
+    def _run_wave(self, wave: list[_Item], wave_no: int = 0) -> None:
         self._in_wave.active = True
         try:
             now = time.monotonic()
@@ -318,11 +326,11 @@ class DispatchEngine:
                 )
                 groups.setdefault(key, []).append(it)
             for members in groups.values():
-                self._run_group(members)
+                self._run_group(members, wave_no)
         finally:
             self._in_wave.active = False
 
-    def _run_group(self, members: list[_Item]) -> None:
+    def _run_group(self, members: list[_Item], wave_no: int = 0) -> None:
         """Dedup by canonical signature, then execute the distinct
         plans as one combined multi-call query."""
         leaders: list[_Item] = []
@@ -334,6 +342,17 @@ class DispatchEngine:
                 dups.setdefault(id(lead), []).append(it)
                 with self._mu:
                     self.dedup_hits += 1
+                if it.trace_ctx is not None and it.trace_ctx[2]:
+                    # wave-level singleflight: the deduped item's trace
+                    # span-links the executed item and names the wave
+                    lctx = lead.trace_ctx
+                    trace.record_link(
+                        metrics.STAGE_DISPATCH_DEDUP,
+                        it.trace_ctx,
+                        lctx if lctx is not None else ("", ""),
+                        wave=wave_no,
+                        signature=it.signature,
+                    )
                 continue
             if it.signature is not None:
                 by_sig[it.signature] = it

@@ -447,6 +447,7 @@ class Executor:
                 query if isinstance(query, str) else str(query),
                 shards,
                 opt or ExecOptions(),
+                trace_ctx=trace.current_ctx(),
             )
             dl = _deadline().current()
             sp = trace.current()
@@ -465,6 +466,7 @@ class Executor:
                     opt or ExecOptions(),
                     deadline=_deadline().current(),
                     text=query if isinstance(query, str) else None,
+                    trace_ctx=trace.current_ctx(),
                 )
                 if fut is not None:  # None: engine closing -> inline
                     return fut.result()

@@ -11,7 +11,7 @@ import urllib.parse
 import urllib.request
 from typing import Any, Callable, Optional
 
-from pilosa_tpu.utils import metrics, privateproto
+from pilosa_tpu.utils import events, metrics, privateproto, trace
 
 # retry backoff cap: one fence window, not a liveness probe interval —
 # a leg that can't land in ~2s should fail over, not keep waiting
@@ -78,12 +78,24 @@ class InternalClient:
                 if not _retryable(e) or attempt >= self.retries:
                     if attempt:
                         metrics.count(metrics.CLIENT_RETRY_EXHAUSTED, op=op)
+                        events.record(
+                            events.CLIENT_RETRY_EXHAUSTED,
+                            op=op,
+                            attempts=attempt + 1,
+                            error=str(e),
+                        )
                     raise
                 delay = min(_BACKOFF_CAP, self.retry_backoff * (2 ** attempt))
                 delay *= 0.5 + random.random() * 0.5  # jitter
                 dl = _deadline.current()
                 if dl is not None and dl.remaining() <= delay:
                     metrics.count(metrics.CLIENT_RETRY_EXHAUSTED, op=op)
+                    events.record(
+                        events.CLIENT_RETRY_EXHAUSTED,
+                        op=op,
+                        attempts=attempt + 1,
+                        error=f"deadline too close for retry: {e}",
+                    )
                     raise
                 attempt += 1
                 metrics.count(metrics.CLIENT_RETRIES, op=op)
@@ -129,10 +141,18 @@ class InternalClient:
         query: str,
         shards: Optional[list[int]] = None,
         remote: bool = True,
+        trace_ctx: Optional[tuple] = None,
     ) -> list[dict]:
         q = {"remote": "true" if remote else "false"}
         if shards is not None:
             q["shards"] = ",".join(str(s) for s in shards)
+        # distributed trace propagation: the remote leg runs under the
+        # caller's trace id (traceparent header); a sampled leg answers
+        # with its serialized spans and we graft them into the live
+        # tree right here — the one place every outbound query passes
+        headers = None
+        if trace_ctx is not None:
+            headers = {"traceparent": trace.format_traceparent(trace_ctx)}
         # safe to retry even for writes: Set/Clear are idempotent and a
         # transport failure means the request may or may not have
         # landed either way — at-least-once is the existing contract
@@ -144,8 +164,18 @@ class InternalClient:
                 f"/index/{index}/query",
                 body=query.encode(),
                 query=q,
+                headers=headers,
             ),
         )
+        spans = resp.get("spans")
+        if spans:
+            sp = trace.current()
+            if sp is not None:
+                for d in spans:
+                    sp.graft(d)
+                metrics.count(
+                    metrics.TRACE_REMOTE_SPANS, len(spans), source="envelope"
+                )
         return resp.get("results", [])
 
     # -- imports (reference Import/ImportValue, http/client.go:276,428) --
@@ -353,6 +383,36 @@ class InternalClient:
                 ).encode(),
             ),
         )
+
+    # -- fleet observability (server/fleet.py, utils/trace.py) --
+
+    def push_spans(self, uri: str, trace_id: str, spans: list[dict]) -> None:
+        """Ship serialized span dicts to the trace owner's stitch
+        buffer (gang follower → leader; the collective plane is one-way
+        so spans ride HTTP)."""
+        self._request(
+            "POST",
+            uri,
+            "/internal/trace/push",
+            body=json.dumps({"trace_id": trace_id, "spans": spans}).encode(),
+        )
+
+    def fleet_register(self, uri: str, member_uri: str, rank: int = -1, gang: str = "") -> None:
+        """Announce ``member_uri``'s scrape endpoint to the fleet
+        collector at ``uri``."""
+        self._request(
+            "POST",
+            uri,
+            "/internal/fleet/register",
+            body=json.dumps(
+                {"uri": member_uri, "rank": rank, "gang": gang}
+            ).encode(),
+        )
+
+    def fleet_snapshots(self, uri: str) -> list:
+        """One member's gang-local ``[[label, snapshot], ...]`` list."""
+        resp = self._request("GET", uri, "/internal/fleet/snapshots")
+        return resp.get("snapshots", [])
 
     def gang_rejoin(self, uri: str, follower_uri: str) -> dict:
         """Announce a re-staged follower to its gang leader; the leader

@@ -871,9 +871,17 @@ class Cluster:
 
     def _map_remote(self, node, index, c, shards):
         """Remote leg: ship the call string; decode the single result
-        (reference remoteExec, executor.go:1393-1440)."""
+        (reference remoteExec, executor.go:1393-1440). The current
+        trace context rides the RPC as a traceparent header — inside a
+        traced query this runs under the cluster.map_remote child span,
+        so the remote process's spans graft back exactly there."""
         results = self.client.query_node(
-            node.uri, index, str(c), shards=shards, remote=True
+            node.uri,
+            index,
+            str(c),
+            shards=shards,
+            remote=True,
+            trace_ctx=trace.current_ctx(),
         )
         if not results:
             return None
@@ -923,7 +931,12 @@ class Cluster:
                     ret = True
             elif not opt.remote:
                 res = self.client.query_node(
-                    node.uri, index, str(c), shards=None, remote=True
+                    node.uri,
+                    index,
+                    str(c),
+                    shards=None,
+                    remote=True,
+                    trace_ctx=trace.current_ctx(),
                 )
                 if res and res[0] is True:
                     ret = True
@@ -946,7 +959,14 @@ class Cluster:
         if opt.remote:
             return
         for node in self._other_nodes():
-            self.client.query_node(node.uri, index, str(c), shards=None, remote=True)
+            self.client.query_node(
+                node.uri,
+                index,
+                str(c),
+                shards=None,
+                remote=True,
+                trace_ctx=trace.current_ctx(),
+            )
 
     # -- resize (reference cluster.go:1080-1423) -----------------------------
 

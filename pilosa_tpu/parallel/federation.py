@@ -169,6 +169,11 @@ def handle_rejoin(server, follower_uri: str) -> dict:
     # not evict the first
     replicas = [u for u in mh.health()["replicas"] if u != follower_uri]
     replicas.append(follower_uri)
+    fleet = getattr(server, "fleet", None)
+    if fleet is not None:
+        # the re-staged replica is a fleet member again: its registry
+        # shows up in the leader's /metrics?fleet=true on the next scrape
+        fleet.register(follower_uri, gang=server.config.distributed_coordinator)
     out = mh.reform(replicas, reason=f"follower {follower_uri} rejoined")
     out["fragments"] = pushed
     out["reformSeconds"] = round(time.monotonic() - t0, 3)
@@ -205,6 +210,19 @@ def rejoin_follower(server, leader_uri: str) -> bool:
                 return False
             time.sleep(0.25)
     server.gang_epoch = int(resp.get("epoch", 0))
+    if server.multihost is not None:
+        # replay spans from this process push to the leader's stitch
+        # buffer; fleet registration makes this rank scrapeable
+        server.multihost.leader_uri = leader_uri
+    try:
+        client.fleet_register(
+            leader_uri,
+            server.uri,
+            rank=getattr(server, "_mh_rank", -1),
+            gang=server.config.distributed_coordinator,
+        )
+    except Exception:
+        pass
     server.logger.printf(
         "rejoined gang at %s: epoch %d", leader_uri, server.gang_epoch
     )

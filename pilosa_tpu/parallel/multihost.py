@@ -65,7 +65,7 @@ import time
 from typing import Any, Callable, Optional
 
 from pilosa_tpu.analysis.locks import OrderedLock
-from pilosa_tpu.utils import metrics
+from pilosa_tpu.utils import events, metrics, trace
 
 # -- gang lifecycle ----------------------------------------------------------
 
@@ -202,12 +202,12 @@ class Descriptor:
         return cls(kind, json.loads(raw.decode()))
 
 
-def query_descriptor(index: str, query_text: str, shards, opt) -> Descriptor:
+def query_descriptor(
+    index: str, query_text: str, shards, opt, trace_ctx: Optional[tuple] = None
+) -> Descriptor:
     from pilosa_tpu.plan.canon import query_signature
 
-    return Descriptor(
-        KIND_QUERY,
-        {
+    payload = {
             "index": index,
             "query": query_text,
             "shards": list(shards) if shards is not None else None,
@@ -220,8 +220,12 @@ def query_descriptor(index: str, query_text: str, shards, opt) -> Descriptor:
                 # only, never re-route through the cluster plane
                 "remote": bool(getattr(opt, "remote", False)),
             },
-        },
-    )
+    }
+    if trace_ctx is not None:
+        # originating trace context rides the broadcast, so every rank
+        # replays under the same trace id (rank-tagged replay spans)
+        payload["trace"] = trace.format_traceparent(trace_ctx)
+    return Descriptor(KIND_QUERY, payload)
 
 
 # -- channels ----------------------------------------------------------------
@@ -742,6 +746,11 @@ class MultiHostRuntime:
         self.replicate_fn: Optional[Callable[[str, int, dict, int], None]] = None
         self.on_reform: Optional[Callable[[], None]] = None
         self.on_state_change: Optional[Callable[[str, int], None]] = None
+        # the leader's HTTP URI, learned by followers from the leader's
+        # boot-time broadcast (server.py "leader-uri" message) or the
+        # rejoin config — the push target for replay spans and fleet
+        # registration
+        self.leader_uri: str = ""
         self._in_gang = threading.local()
         self._mu = OrderedLock("multihost.gang.mu")
         self._cond = threading.Condition(self._mu)
@@ -924,6 +933,9 @@ class MultiHostRuntime:
             epoch = self.epoch
         metrics.gauge(metrics.MULTIHOST_DEGRADED, 1 if to == STATE_DEGRADED else 0)
         metrics.gauge(metrics.MULTIHOST_STATE, _STATE_CODES.get(to, -1))
+        events.record(
+            events.GANG_TRANSITION, frm=frm, to=to, reason=reason, epoch=epoch
+        )
         if self.logger is not None:
             self.logger.printf("multihost gang %s -> %s: %s", frm, to, reason)
         hook = self.on_state_change
@@ -1136,6 +1148,7 @@ class MultiHostRuntime:
                         self.logger.printf("multihost degrade hook error: %s", e)
         finally:
             self._set_state(STATE_DEGRADED, reason)
+            events.record(events.GANG_DEGRADE, reason=reason, epoch=self.epoch)
             with self._mu:
                 self._degrading = False
                 self._degrading_thread = None
@@ -1187,6 +1200,12 @@ class MultiHostRuntime:
             self._replicas = list(replicas)
         self._set_state(
             STATE_ACTIVE, f"re-formed at epoch {epoch} ({len(replicas)} replicas)"
+        )
+        events.record(
+            events.GANG_REFORM,
+            reason=reason,
+            epoch=epoch,
+            replicas=len(replicas),
         )
         metrics.count(metrics.MULTIHOST_REFORMS)
         self._start_leader_loop()
@@ -1277,16 +1296,43 @@ def make_apply_fn(server) -> Callable[[int, dict], Any]:
     def apply(kind: int, payload: dict) -> Any:
         if kind == KIND_QUERY:
             opt_kw = payload.get("opt") or {}
-            return server.executor.execute(
-                payload["index"],
-                payload["query"],
-                payload.get("shards"),
-                _gang_opt(
-                    exclude_row_attrs=opt_kw.get("exclude_row_attrs", False),
-                    exclude_columns=opt_kw.get("exclude_columns", False),
-                    remote=opt_kw.get("remote", False),
-                ),
+
+            def run():
+                return server.executor.execute(
+                    payload["index"],
+                    payload["query"],
+                    payload.get("shards"),
+                    _gang_opt(
+                        exclude_row_attrs=opt_kw.get("exclude_row_attrs", False),
+                        exclude_columns=opt_kw.get("exclude_columns", False),
+                        remote=opt_kw.get("remote", False),
+                    ),
+                )
+
+            ctx = trace.parse_traceparent(payload.get("trace"))
+            if ctx is None or not ctx[2]:
+                # untraced (or unsampled) dispatch: propagate the bare
+                # context span-free — the zero-allocation contract holds
+                with trace.push_ctx(ctx):
+                    return run()
+            # sampled: this rank's replay becomes a span under the
+            # ORIGINATING trace id, rank/epoch/pid-tagged, recorded in
+            # this process's ring AND shipped to the trace owner so the
+            # root process stitches one complete tree
+            mh = server.multihost
+            sp = trace.TRACER.trace(
+                metrics.STAGE_MH_REPLAY,
+                ctx=ctx,
+                rank=mh.rank if mh is not None else getattr(server, "_mh_rank", 0),
+                epoch=mh.epoch if mh is not None else getattr(server, "gang_epoch", 0),
+                pid=os.getpid(),
+                plan=payload.get("plan"),
             )
+            try:
+                with sp:
+                    return run()
+            finally:
+                _ship_replay_span(server, sp)
         if kind == KIND_IMPORT:
             # federated legs carry local=True: the cluster plane already
             # routed the shard group here (and translated any keys), so
@@ -1333,6 +1379,42 @@ def make_apply_fn(server) -> Callable[[int, dict], Any]:
         raise ValueError(f"unknown descriptor kind: {kind}")
 
     return apply
+
+
+def _replay_push_target(server) -> str:
+    """Where this process ships replay spans: '' on the trace-owning
+    gang leader (local graft), else the leader's HTTP URI — learned
+    from the boot-time leader-uri broadcast (collective followers) or
+    the rejoin config (replicated followers)."""
+    mh = server.multihost
+    if mh is not None and mh.rank == 0:
+        return ""
+    if mh is not None and mh.leader_uri:
+        return mh.leader_uri
+    return getattr(server.config, "federation_rejoin", "") or ""
+
+
+def _ship_replay_span(server, sp) -> None:
+    """Deliver one completed replay span to the trace owner's stitch
+    buffer. Best-effort: span shipping must never fail (or slow) the
+    replay itself."""
+    if sp is trace.NOP_SPAN or not getattr(sp, "trace_id", ""):
+        return
+    try:
+        d = sp.to_dict()
+        target = _replay_push_target(server)
+        if not target:
+            # leader rank: the HTTP root span lives in this process —
+            # graft straight into the local stitch buffer
+            trace.TRACER.graft_remote(sp.trace_id, [d])
+            return
+        from pilosa_tpu.parallel.client import InternalClient
+
+        InternalClient(
+            timeout=5.0, ssl_context=server.client_ssl_context()
+        ).push_spans(target, sp.trace_id, [d])
+    except Exception:
+        pass
 
 
 def _gang_opt(**kw):

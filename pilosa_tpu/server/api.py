@@ -97,6 +97,7 @@ class API:
         column_attrs: bool = False,
         profile: bool = False,
         cache: bool = True,
+        trace_ctx: Optional[tuple] = None,
     ) -> dict:
         self._validate("query")
         # deadline boundary: cancel BEFORE the parse — an expired
@@ -113,11 +114,19 @@ class API:
             # a cache hit's absence of spans
             cache=cache and not profile,
         )
-        # root span: forced by profile=true, else admitted by the
-        # tracer's sample rate / slow-query threshold (NOP when off —
-        # the untraced query allocates no span anywhere below)
-        root = trace.TRACER.trace(metrics.STAGE_QUERY, force=profile, index=index)
-        with root:
+        # root span: forced by profile=true or a sampled upstream
+        # traceparent (the ingress point ADOPTS the caller's trace id),
+        # else admitted by the tracer's sample rate / slow-query
+        # threshold (NOP when off — the untraced query allocates no
+        # span anywhere below)
+        root = trace.TRACER.trace(
+            metrics.STAGE_QUERY, force=profile, ctx=trace_ctx, index=index
+        )
+        # an UNSAMPLED upstream context still propagates its ids to
+        # dispatch items and outbound RPC headers, span-free
+        with root, trace.push_ctx(
+            trace_ctx if root is trace.NOP_SPAN else None
+        ):
             # when this query came through the serving pipeline, its
             # admission-queue wait predates the root span — backfill it
             # so profile=true shows where serving latency went
@@ -136,7 +145,14 @@ class API:
             results = self.executor.execute(index, q, shards, opt)
         resp: dict = {"results": results}
         if profile:
-            resp["profile"] = root.to_dict()
+            resp["profile"] = trace.TRACER.stitched(root.to_dict())
+        if remote and root is not trace.NOP_SPAN:
+            # federation remote leg: return this process's serialized
+            # span tree in the response envelope so the root process
+            # grafts it into ONE stitched trace (Dapper-style)
+            # stitched: a rank-0 replay span grafts into this leader's
+            # buffer synchronously, so it rides back in the envelope too
+            resp["spans"] = [trace.TRACER.stitched(root.to_dict())]
         if column_attrs and idx.column_attrs is not None:
             cols = set()
             for r in results:

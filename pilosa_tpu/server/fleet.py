@@ -1,0 +1,139 @@
+"""Fleet telemetry collector (ISSUE 10) — the pull plane behind
+``/metrics?fleet=true`` and ``/debug/fleet``.
+
+Every server owns a collector; only the one on a gang/federation leader
+ever accumulates members. Gang followers announce their scrape endpoint
+at boot (POST ``/internal/fleet/register``, triggered by the leader-URI
+handshake in server.py), and each registered member answers
+``GET /internal/fleet/snapshots`` with its gang-local snapshot list —
+its own registry plus its OWN registered members'. A federation leader
+therefore aggregates the whole fleet in two hops: its own gang list,
+plus one pull per peer gang leader on the cluster plane (each of which
+returns that gang's list). Every snapshot carries an ``instance`` label
+(the member's URI) in the rendered exposition, so per-rank series stay
+distinct in the aggregate.
+
+Scrape failures are per-member: an unreachable rank costs its series
+and a ``fleet.scrapes{outcome=error}`` count, never the whole scrape.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from pilosa_tpu.utils import metrics
+
+# per-member pull budget: a wedged rank must not stall the scrape for
+# longer than a Prometheus scrape interval tolerates
+_PULL_TIMEOUT = 5.0
+
+
+class FleetCollector:
+    def __init__(self, server) -> None:
+        self.server = server
+        self._mu = threading.Lock()
+        # uri -> {"uri","rank","gang","registered_at"}
+        self._members: dict[str, dict] = {}
+        # uri -> last pull outcome {"ok","error","t"} for /debug/fleet
+        self._pulls: dict[str, dict] = {}
+        self._client = None
+
+    # -- membership ----------------------------------------------------------
+
+    def register(self, uri: str, rank: int = -1, gang: str = "") -> None:
+        """Idempotent: a re-registering member (restart, rejoin) just
+        refreshes its row."""
+        with self._mu:
+            self._members[uri] = {
+                "uri": uri,
+                "rank": rank,
+                "gang": gang,
+                "registered_at": time.time(),
+            }
+
+    def members(self) -> list[dict]:
+        with self._mu:
+            return [dict(m) for m in self._members.values()]
+
+    # -- snapshots -----------------------------------------------------------
+
+    def local_label(self) -> str:
+        import os
+
+        return getattr(self.server, "uri", "") or f"pid:{os.getpid()}"
+
+    def local_snapshot(self) -> dict:
+        """This process's registry merged with its expvar stats — the
+        same two sources the plain ``/metrics`` exposition renders."""
+        snap = dict(metrics.snapshot())
+        ev = getattr(self.server, "_expvar", None)
+        if ev is not None:
+            for k, v in ev.snapshot().items():
+                snap.setdefault(k, v)
+        return snap
+
+    def _get_client(self):
+        if self._client is None:
+            from pilosa_tpu.parallel.client import InternalClient
+
+            self._client = InternalClient(
+                timeout=_PULL_TIMEOUT,
+                ssl_context=self.server.client_ssl_context(),
+            )
+        return self._client
+
+    def _pull(self, uri: str) -> list:
+        """One member's gang-local snapshot list; failures are recorded
+        and return empty (the scrape degrades, never dies)."""
+        try:
+            out = self._get_client().fleet_snapshots(uri)
+            metrics.count(metrics.FLEET_SCRAPES, outcome="ok")
+            with self._mu:
+                self._pulls[uri] = {"ok": True, "error": "", "t": time.time()}
+            return out
+        except Exception as e:
+            metrics.count(metrics.FLEET_SCRAPES, outcome="error")
+            with self._mu:
+                self._pulls[uri] = {"ok": False, "error": str(e), "t": time.time()}
+            return []
+
+    def gang_snapshots(self) -> list:
+        """``[[label, snapshot], ...]`` for this process and every
+        member registered here (its gang, when this is a gang leader)."""
+        out = [[self.local_label(), self.local_snapshot()]]
+        for m in self.members():
+            out.extend(self._pull(m["uri"]))
+        return out
+
+    def collect(self) -> list:
+        """The full fleet: this gang plus one pull per peer gang leader
+        on the cluster plane, deduped by instance label (a peer list
+        can overlap its own registration)."""
+        pairs = list(self.gang_snapshots())
+        cluster = getattr(self.server, "cluster", None)
+        if cluster is not None:
+            for node in cluster._other_nodes():
+                pairs.extend(self._pull(node.uri))
+        seen: set = set()
+        out = []
+        for pair in pairs:
+            try:
+                label, snap = pair[0], pair[1]
+            except (IndexError, TypeError):
+                continue
+            if label in seen or not isinstance(snap, dict):
+                continue
+            seen.add(label)
+            out.append((label, snap))
+        return out
+
+    def debug(self) -> dict:
+        with self._mu:
+            pulls = {u: dict(p) for u, p in self._pulls.items()}
+        return {
+            "self": self.local_label(),
+            "members": self.members(),
+            "pulls": pulls,
+        }

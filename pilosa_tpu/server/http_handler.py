@@ -29,7 +29,7 @@ from pilosa_tpu.server.pipeline import (
 )
 from pilosa_tpu.parallel.multihost import GangUnavailable
 from pilosa_tpu.utils.errors import NotFoundError as ExecNotFound
-from pilosa_tpu.utils import metrics, privateproto, publicproto, trace
+from pilosa_tpu.utils import events, metrics, privateproto, publicproto, trace
 from pilosa_tpu.utils.stats import NOP_STATS
 
 # conservative write detector for coalescing/batching eligibility: any
@@ -174,6 +174,11 @@ class Handler:
             Route("POST", r"/internal/probe", self.post_probe),
             Route("POST", r"/internal/gang/apply", self.post_gang_apply),
             Route("POST", r"/internal/gang/rejoin", self.post_gang_rejoin),
+            # fleet observability plane (ISSUE 10): follower span push,
+            # fleet membership registration, per-gang registry pulls
+            Route("POST", r"/internal/trace/push", self.post_trace_push),
+            Route("POST", r"/internal/fleet/register", self.post_fleet_register),
+            Route("GET", r"/internal/fleet/snapshots", self.get_fleet_snapshots),
             Route("GET", r"/internal/translate/data", self.get_translate_data),
             Route("POST", r"/internal/translate/keys", self.post_translate_keys),
             Route(
@@ -193,6 +198,8 @@ class Handler:
             Route("GET", r"/debug/plancache", self.get_debug_plancache),
             Route("GET", r"/debug/vars", self.get_debug_vars),
             Route("GET", r"/debug/traces", self.get_debug_traces),
+            Route("GET", r"/debug/events", self.get_debug_events),
+            Route("GET", r"/debug/fleet", self.get_debug_fleet),
             # index (with and without trailing slash, as net/http/pprof
             # serves it) plus the thread-dump profile; unknown names 404
             Route("GET", r"/debug/pprof/?", self.get_debug_pprof),
@@ -201,13 +208,18 @@ class Handler:
 
     # -- route handlers --
 
-    def _submit(self, cls, thunk, dl, signature=None, batch=None):
+    def _submit(self, cls, thunk, dl, signature=None, batch=None, trace_ctx=None):
         """Run ``thunk`` through the serving pipeline (admission,
         deadline, coalescing, batching) — or directly, deadline still
         honored, when no pipeline is wired."""
         if self.pipeline is not None:
             return self.pipeline.submit(
-                cls, thunk, deadline=dl, signature=signature, batch=batch
+                cls,
+                thunk,
+                deadline=dl,
+                signature=signature,
+                batch=batch,
+                trace_ctx=trace_ctx,
             )
         with deadline_mod.activate(dl):
             return thunk()
@@ -236,6 +248,10 @@ class Handler:
             column_attrs = q.get("columnAttrs", ["false"])[0] == "true"
         profile = q.get("profile", ["false"])[0] == "true"
         cache = q.get("cache", ["true"])[0] != "false"
+        # W3C trace context ingress: a sampled traceparent makes this
+        # request a leg of a distributed trace (api.query adopts the
+        # id); malformed headers parse to None and never fail the query
+        trace_ctx = trace.parse_traceparent(req.headers.get("traceparent"))
         dl = deadline_mod.from_request(req.headers, q, self.default_timeout)
         # pipeline classification: remote legs of distributed queries
         # are internal traffic (their own queue — a user-query flood
@@ -264,7 +280,14 @@ class Handler:
                 column_attrs,
                 cache,
             )
-            if shards is None and not column_attrs:
+            # sampled-trace requests stay out of cross-request batching
+            # (a combined execution has no per-request span tree); they
+            # still coalesce — the follower records a span link
+            if (
+                shards is None
+                and not column_attrs
+                and not (trace_ctx is not None and trace_ctx[2])
+            ):
                 batch = {
                     "key": (index, exclude_row_attrs, exclude_columns, cache),
                     "index": index,
@@ -287,10 +310,13 @@ class Handler:
                 column_attrs=column_attrs,
                 profile=profile,
                 cache=cache,
+                trace_ctx=trace_ctx,
             )
 
         t0 = time.monotonic()
-        resp = self._submit(cls, thunk, dl, signature=signature, batch=batch)
+        resp = self._submit(
+            cls, thunk, dl, signature=signature, batch=batch, trace_ctx=trace_ctx
+        )
         dur = time.monotonic() - t0
         # slow-query logging (reference handler.go:257-261)
         if self.long_query_time and dur > self.long_query_time and self.logger:
@@ -303,6 +329,10 @@ class Handler:
         if "profile" in resp:
             # JSON-only: the protobuf QueryResponse has no profile field
             out["profile"] = resp["profile"]
+        if "spans" in resp:
+            # remote-leg envelope: this process's serialized spans ride
+            # back so the root process stitches one complete tree
+            out["spans"] = resp["spans"]
         if req.accepts_proto:
             return RawResponse(
                 publicproto.encode_query_response(
@@ -622,7 +652,24 @@ class Handler:
     def get_metrics(self, req):
         """Prometheus text exposition: the process-global registry
         merged with this server's expvar snapshot plus scrape-time
-        freshness gauges (device health, HBM staging residency)."""
+        freshness gauges (device health, HBM staging residency).
+        ``?fleet=true`` on a fleet collector (gang/federation leader)
+        returns the AGGREGATED view instead: every registered rank's
+        registry snapshot, each sample tagged ``instance=<label>``."""
+        if req.query.get("fleet", ["false"])[0] == "true":
+            fleet = self._fleet()
+            if fleet is None:
+                raise APIError(
+                    "fleet metrics need a fleet collector (server-attached "
+                    "handler); this process has none",
+                    status=400,
+                )
+            text = metrics.render_prometheus(
+                registry=metrics.Registry(), instances=fleet.collect()
+            )
+            return RawResponse(
+                text.encode(), "text/plain; version=0.0.4; charset=utf-8"
+            )
         health = getattr(self.api.executor, "health", None)
         if health is not None:
             metrics.gauge(
@@ -637,6 +684,11 @@ class Handler:
         return RawResponse(
             text.encode(), "text/plain; version=0.0.4; charset=utf-8"
         )
+
+    def _fleet(self):
+        """The server's fleet collector (server/fleet.py), or None on a
+        bare handler."""
+        return getattr(getattr(self.api, "server", None), "fleet", None)
 
     def get_debug_plancache(self, req) -> dict:
         """Plan result-cache snapshot: entries/bytes, hit ratio,
@@ -674,8 +726,79 @@ class Handler:
 
     def get_debug_traces(self, req) -> dict:
         """Recent completed query traces (the tracer's ring buffer) as
-        JSON span trees, newest last."""
-        return {"traces": trace.TRACER.recent()}
+        JSON span trees, newest last; stitched with any remote spans
+        pushed for their trace ids. Filters: ``?trace_id=``,
+        ``?min_ms=``, ``?gang=``."""
+        q = req.query
+        min_ms = q.get("min_ms", [None])[0]
+        try:
+            min_ms_f = float(min_ms) if min_ms is not None else None
+        except ValueError:
+            raise APIError(f"invalid min_ms: {min_ms!r}", status=400)
+        return {
+            "traces": trace.TRACER.recent(
+                trace_id=q.get("trace_id", [None])[0],
+                min_ms=min_ms_f,
+                gang=q.get("gang", [None])[0],
+            )
+        }
+
+    def get_debug_events(self, req) -> dict:
+        """The lifecycle event journal (utils/events.py): gang state
+        transitions, degrades, re-forms, retry exhaustion — bounded,
+        ordered by seq. Filters: ``?kind=``, ``?since=<seq>``."""
+        q = req.query
+        try:
+            since = int(q.get("since", ["0"])[0])
+        except ValueError:
+            raise APIError("invalid since: must be an integer seq", status=400)
+        return {"events": events.snapshot(kind=q.get("kind", [None])[0], since_seq=since)}
+
+    def get_debug_fleet(self, req) -> dict:
+        """Fleet collector membership + scrape health (JSON twin of
+        ``/metrics?fleet=true``)."""
+        fleet = self._fleet()
+        if fleet is None:
+            return {"enabled": False}
+        out = fleet.debug()
+        out["enabled"] = True
+        return out
+
+    def post_trace_push(self, req) -> dict:
+        """Gang followers push their replay span dicts here (the
+        collective plane is one-way, so spans ride this HTTP side
+        channel); ``recent()``/``stitched()`` merge them at read time."""
+        body = json.loads(req.body or b"{}")
+        _require(body, "trace_id", "spans")
+        spans = body["spans"] or []
+        trace.TRACER.graft_remote(body["trace_id"], spans)
+        if spans:
+            metrics.count(metrics.TRACE_REMOTE_SPANS, len(spans), source="push")
+        return {}
+
+    def post_fleet_register(self, req) -> dict:
+        """A gang member announcing its scrape endpoint to its leader's
+        fleet collector."""
+        body = json.loads(req.body or b"{}")
+        _require(body, "uri")
+        fleet = self._fleet()
+        if fleet is None:
+            return {"registered": False}
+        fleet.register(
+            body["uri"],
+            rank=int(body.get("rank", -1)),
+            gang=body.get("gang", ""),
+        )
+        return {"registered": True}
+
+    def get_fleet_snapshots(self, req) -> dict:
+        """Gang-local registry snapshots: this process plus every member
+        registered with its collector — what a federation leader pulls
+        from peer gang leaders to build the fleet view."""
+        fleet = self._fleet()
+        if fleet is None:
+            return {"snapshots": []}
+        return {"snapshots": fleet.gang_snapshots()}
 
     def get_debug_pprof(self, req):
         """Live thread stack dump — the CPython analog of the reference's

@@ -60,7 +60,7 @@ from typing import Any, Callable, Optional
 from pilosa_tpu.analysis.locks import OrderedLock
 from pilosa_tpu.server import deadline as deadline_mod
 from pilosa_tpu.server.deadline import Deadline, DeadlineExceeded
-from pilosa_tpu.utils import metrics
+from pilosa_tpu.utils import metrics, trace
 
 CLASS_INTERACTIVE = "interactive"
 CLASS_BULK = "bulk"
@@ -100,6 +100,7 @@ class _Entry:
         "error",
         "t_enq",
         "wait_s",
+        "trace_ctx",
     )
 
     def __init__(
@@ -110,6 +111,7 @@ class _Entry:
         batch_key=None,
         batch_payload=None,
         deadline: Optional[Deadline] = None,
+        trace_ctx: Optional[tuple] = None,
     ) -> None:
         self.cls = cls
         self.thunk = thunk
@@ -122,6 +124,9 @@ class _Entry:
         self.error: Optional[BaseException] = None
         self.t_enq = 0.0
         self.wait_s = 0.0
+        # distributed trace context (utils/trace.py tuple): carried so
+        # a coalesced follower can link the leader's trace
+        self.trace_ctx = trace_ctx
 
 
 class _ClassQueue:
@@ -247,6 +252,7 @@ class QueryPipeline:
         deadline: Optional[Deadline] = None,
         signature=None,
         batch: Optional[dict] = None,
+        trace_ctx: Optional[tuple] = None,
     ) -> Any:
         """Run ``thunk`` through the pipeline and return its result.
         Raises Overloaded (shed / draining), DeadlineExceeded, or
@@ -259,6 +265,7 @@ class QueryPipeline:
             batch_key=batch["key"] if batch else None,
             batch_payload=batch,
             deadline=deadline,
+            trace_ctx=trace_ctx,
         )
         leader: Optional[_Entry] = None
         with self._mu:
@@ -290,8 +297,22 @@ class QueryPipeline:
                 metrics.count(metrics.PIPELINE_ADMITTED, cls=cls)
                 metrics.gauge(metrics.PIPELINE_QUEUE_DEPTH, len(cq.q), cls=cls)
                 self._cond.notify_all()
+        if leader is not None and trace_ctx is not None and trace_ctx[2]:
+            # singleflight made this request a follower: it never
+            # executes, so its trace gets a point entry span-linking
+            # the leader's execution (outside _mu — the tracer has its
+            # own lock and link recording must not extend admission)
+            lctx = leader.trace_ctx
+            trace.record_link(
+                metrics.STAGE_PIPELINE_COALESCE,
+                trace_ctx,
+                lctx if lctx is not None else ("", ""),
+                cls=cls,
+                leader_traced=bool(lctx is not None and lctx[2]),
+            )
         # wait OUTSIDE the lock (workers need it to make progress)
         return self._await(leader if leader is not None else entry, deadline)
+
     def _await(self, entry: _Entry, dl: Optional[Deadline]):
         """Block until ``entry`` resolves; a waiter whose own deadline
         passes first stops waiting (its queued work is skipped by the

@@ -23,7 +23,7 @@ from pilosa_tpu import __version__
 from pilosa_tpu.utils.attrstore import new_attr_store
 from pilosa_tpu.utils.diagnostics import DiagnosticsCollector
 from pilosa_tpu.utils.logger import NOP_LOGGER, StandardLogger
-from pilosa_tpu.utils import metrics, trace
+from pilosa_tpu.utils import events, logger as logger_mod, metrics, trace
 from pilosa_tpu.utils.gcnotify import GCNotifier
 from pilosa_tpu.utils.stats import (
     ExpvarStatsClient,
@@ -250,6 +250,33 @@ class Server:
                 logger=self.logger,
             )
             self.executor.gang = self.multihost
+        # fleet identity (ISSUE 10): stamp every trace root and journal
+        # event with this process's gang/rank, and give log records the
+        # live epoch. Standalone servers keep empty tags — span meta
+        # stays exactly what the caller passed.
+        ident: dict = {}
+        if self.config.distributed_coordinator:
+            ident["gang"] = self.config.distributed_coordinator
+        if self.config.distributed_enabled:
+            ident["rank"] = self._mh_rank
+        if ident:
+            trace.TRACER.tags = dict(ident)
+            events.JOURNAL.tags = dict(ident)
+        if self.multihost is not None:
+            _mh = self.multihost
+
+            logger_mod.set_context_provider(
+                lambda: {
+                    "gang": self.config.distributed_coordinator or "",
+                    "rank": self._mh_rank,
+                    "epoch": _mh.epoch,
+                }
+            )
+        # fleet telemetry collector (server/fleet.py): every server owns
+        # one; only a gang/federation leader accumulates members
+        from pilosa_tpu.server.fleet import FleetCollector
+
+        self.fleet = FleetCollector(self)
         # serving pipeline (server/pipeline.py): every query/import
         # request flows through bounded per-class admission queues with
         # deadline scheduling, singleflight coalescing, and
@@ -456,6 +483,21 @@ class Server:
         self.logger.printf(
             "pilosa_tpu server listening on %s://%s:%d", self.scheme, *self.address()
         )
+        # build_info gauge: one constant-1 sample whose labels identify
+        # this process in a fleet scrape (version, backend, gang, rank)
+        import jax
+
+        metrics.gauge(
+            metrics.BUILD_INFO,
+            1.0,
+            version=__version__,
+            jax=jax.__version__,
+            backend=jax.default_backend(),
+            pid=str(os.getpid()),
+            gang=self.config.distributed_coordinator or "",
+            rank=str(self._mh_rank),
+            leader=str(self._mh_rank == 0).lower(),
+        )
         if self.cluster is None and not self.config.cluster.disabled:
             if self.config.distributed_enabled and self._mh_rank != 0:
                 # federation: the cluster plane runs on gang LEADERS
@@ -489,6 +531,14 @@ class Server:
             from pilosa_tpu.parallel import federation
 
             federation.start_rejoin(self)
+        if self.multihost is not None and self._mh_rank == 0:
+            # leader-URI handshake: followers learn where to push replay
+            # spans and register their scrape endpoints (gang-only — the
+            # cluster plane's peer leaders announce their own)
+            try:
+                self._gang_message({"type": "leader-uri", "uri": self.uri})
+            except Exception as e:
+                self.logger.printf("leader-uri broadcast failed: %s", e)
         # measure the device-policy crossover for THIS deployment
         # (dispatch RTT / per-container CPU cost) unless the operator
         # pinned one via config or env — measured beats guessed
@@ -1004,5 +1054,37 @@ class Server:
                             frag.cache.recalculate()
         elif typ == "schema":
             self.holder.apply_schema(msg.get("schema", []))
+        elif typ == "leader-uri":
+            # gang replay of the leader's boot-time handshake: followers
+            # adopt the push target and register with the leader's fleet
+            # collector; the leader (and peer leaders) ignore it
+            if self.multihost is not None and self._mh_rank != 0:
+                self.multihost.leader_uri = msg.get("uri", "")
+                self._register_with_leader()
         elif self.cluster is not None:
             self.cluster.receive_message(msg)
+
+    def _register_with_leader(self) -> None:
+        """Best-effort, off-thread: the gang apply loop must not block
+        on an HTTP round-trip back to the leader."""
+        mh = self.multihost
+        if mh is None or not mh.leader_uri:
+            return
+        target = mh.leader_uri
+
+        def _go():
+            try:
+                from pilosa_tpu.parallel.client import InternalClient
+
+                InternalClient(
+                    timeout=5.0, ssl_context=self.client_ssl_context()
+                ).fleet_register(
+                    target,
+                    self.uri,
+                    rank=self._mh_rank,
+                    gang=self.config.distributed_coordinator or "",
+                )
+            except Exception as e:
+                self.logger.printf("fleet register with %s failed: %s", target, e)
+
+        threading.Thread(target=_go, name="fleet-register", daemon=True).start()

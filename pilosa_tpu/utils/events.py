@@ -1,0 +1,80 @@
+"""Fleet lifecycle event journal (ISSUE 10) — a bounded structured ring
+of gang/federation state-machine transitions, degrades, re-forms, and
+retry-exhaustion events.
+
+Post-morteming a kill/re-form cycle used to mean scraping logs across
+processes; the journal keeps the machine-readable record in-process:
+every entry carries a monotonically increasing sequence number, a wall
+timestamp, the event kind, and whatever identifies the actor — gang,
+rank, epoch, state edge, trace id of the request that observed it.
+Export: ``GET /debug/events`` and ``pilosa_tpu events``.
+
+The ring is process-global (like the metric registry): producers call
+``record()`` from any thread; a full ring drops the oldest entry.
+Recording must never fail or block the caller meaningfully — one lock,
+one append.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from pilosa_tpu.utils import metrics, trace
+
+# event kinds (the journal is open-ended; these are the producers wired
+# in-tree — gang lifecycle edges and cross-gang RPC retry exhaustion)
+GANG_TRANSITION = "gang.transition"
+GANG_DEGRADE = "gang.degrade"
+GANG_REFORM = "gang.reform"
+CLIENT_RETRY_EXHAUSTED = "client.retry_exhausted"
+
+
+class EventJournal:
+    """Bounded ring of structured lifecycle events."""
+
+    def __init__(self, ring_size: int = 256) -> None:
+        self._ring: deque[dict] = deque(maxlen=ring_size)
+        self._mu = threading.Lock()
+        self._seq = 0
+        # fleet identity stamped into every event (gang, rank) — set
+        # once at server boot, like trace.TRACER.tags
+        self.tags: dict = {}
+
+    def record(self, kind: str, **fields) -> dict:
+        d = {"seq": 0, "t": time.time(), "kind": kind}
+        if self.tags:
+            d.update(self.tags)
+        d.update(fields)
+        ctx = trace.current_ctx()
+        if ctx is not None and "trace_id" not in d:
+            d["trace_id"] = ctx[0]
+        with self._mu:
+            self._seq += 1
+            d["seq"] = self._seq
+            self._ring.append(d)
+        metrics.count(metrics.EVENTS_RECORDED, kind=kind)
+        return d
+
+    def snapshot(
+        self, kind: Optional[str] = None, since_seq: int = 0
+    ) -> list[dict]:
+        with self._mu:
+            entries = list(self._ring)
+        if kind:
+            entries = [e for e in entries if e["kind"] == kind]
+        if since_seq:
+            entries = [e for e in entries if e["seq"] > since_seq]
+        return entries
+
+    def clear(self) -> None:
+        with self._mu:
+            self._ring.clear()
+
+
+# process-global journal, mirroring metrics.REGISTRY / trace.TRACER
+JOURNAL = EventJournal()
+record = JOURNAL.record
+snapshot = JOURNAL.snapshot

@@ -152,6 +152,12 @@ DEVICEHEALTH_TRIPS = "devicehealth.trips"
 DEVICEHEALTH_RESTORES = "devicehealth.restores"
 DEVICEHEALTH_SLOW_CALLS = "devicehealth.slow_calls"
 DEVICEHEALTH_SATURATIONS = "devicehealth.saturations"
+# fleet observability (ISSUE 10): self-identifying scrapes, telemetry
+# federation, lifecycle event journal, remote trace stitching
+BUILD_INFO = "build_info"
+EVENTS_RECORDED = "events.recorded"
+FLEET_SCRAPES = "fleet.scrapes"
+TRACE_REMOTE_SPANS = "trace.remote_spans"
 # server-level (emitted through the server's expvar/statsd stats client;
 # merged into /metrics from the expvar snapshot)
 QUERY_TIME = "query_time"
@@ -377,6 +383,26 @@ METRICS: dict[str, tuple[str, str]] = {
         "guarded calls past their deadline whose probe cleared the device",
     ),
     DEVICEHEALTH_SATURATIONS: ("counter", "guard-pool admission timeouts"),
+    BUILD_INFO: (
+        "gauge",
+        "always 1; the process identifies itself via labels (version, "
+        "jax, backend, pid, gang, rank, leader) — fleet scrapes are "
+        "self-identifying",
+    ),
+    EVENTS_RECORDED: (
+        "counter",
+        "lifecycle events appended to the /debug/events journal (label: kind)",
+    ),
+    FLEET_SCRAPES: (
+        "counter",
+        "per-instance registry pulls attempted by the fleet telemetry "
+        "collector (label: outcome = ok | error)",
+    ),
+    TRACE_REMOTE_SPANS: (
+        "counter",
+        "remote span subtrees stitched into local traces (label: "
+        "source = push | envelope)",
+    ),
     QUERY_TIME: ("summary", "whole-query wall time, server-level (label: index)"),
     SLOW_QUERY: ("counter", "queries slower than cluster.long-query-time"),
     MAX_RSS_KB: ("gauge", "process max RSS in KB"),
@@ -404,6 +430,9 @@ STAGE_DELTA = "stager.delta_apply"
 STAGE_MAP_REMOTE = "cluster.map_remote"
 STAGE_MAP_LOCAL = "cluster.map_local"
 STAGE_GANG = "multihost.gang"
+STAGE_PIPELINE_COALESCE = "pipeline.coalesce"
+STAGE_DISPATCH_DEDUP = "dispatch.dedup"
+STAGE_MH_REPLAY = "multihost.replay"
 
 STAGES: dict[str, str] = {
     STAGE_QUERY: "root span, one per query (API layer)",
@@ -421,6 +450,18 @@ STAGES: dict[str, str] = {
     STAGE_MAP_REMOTE: "distributed map-reduce remote leg (meta: node)",
     STAGE_MAP_LOCAL: "distributed map-reduce local leg",
     STAGE_GANG: "gang-dispatched multihost execution (meta: plan, kind)",
+    STAGE_PIPELINE_COALESCE: (
+        "point entry for a coalesced pipeline follower: a span-link to "
+        "the in-flight leader execution that served it"
+    ),
+    STAGE_DISPATCH_DEDUP: (
+        "point entry for a wave-deduped dispatch item: a span-link to "
+        "the executed item (meta: wave)"
+    ),
+    STAGE_MH_REPLAY: (
+        "gang-follower replay of a dispatched descriptor under the "
+        "originating trace id (meta: rank, epoch)"
+    ),
 }
 
 
@@ -561,24 +602,40 @@ def _fmt(v: float) -> str:
     return repr(v) if isinstance(v, float) else str(v)
 
 
+def _merge_snapshot(fams: dict, snap: dict, extra: tuple = ()) -> None:
+    """Fold one expvar-style snapshot into the family map, optionally
+    tagging every sample with extra labels (the fleet collector's
+    ``instance`` label)."""
+    for key, v in snap.items():
+        if isinstance(v, dict) and "count" in v and "sum" in v:
+            name, labels = _parse_expvar_key(key)
+            fams.setdefault(name, []).append((labels + extra, v))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            name, labels = _parse_expvar_key(key)
+            fams.setdefault(name, []).append((labels + extra, v))
+        # strings (stats .set values) have no Prometheus shape: skip
+
+
 def render_prometheus(
     extra_snapshots: Optional[list[dict]] = None,
     registry: Optional[Registry] = None,
+    instances: Optional[list[tuple[str, dict]]] = None,
 ) -> str:
     """Render the global registry (plus optional expvar-style snapshots,
     e.g. the server's per-instance stats) as Prometheus text exposition.
     Histogram summaries render as summary-typed families (quantile
-    labels + _sum/_count); everything else as its declared type."""
+    labels + _sum/_count); everything else as its declared type.
+
+    ``instances`` is the telemetry-federation surface: a list of
+    ``(instance_label, snapshot)`` pairs pulled from other processes by
+    the fleet collector — every sample from such a snapshot carries an
+    ``instance="<label>"`` label so per-rank series stay distinct in
+    the aggregated ``/metrics?fleet=true`` view."""
     fams: dict[str, list] = (registry if registry is not None else REGISTRY)._families()
     for snap in extra_snapshots or []:
-        for key, v in snap.items():
-            if isinstance(v, dict) and "count" in v and "sum" in v:
-                name, labels = _parse_expvar_key(key)
-                fams.setdefault(name, []).append((labels, v))
-            elif isinstance(v, (int, float)) and not isinstance(v, bool):
-                name, labels = _parse_expvar_key(key)
-                fams.setdefault(name, []).append((labels, v))
-            # strings (stats .set values) have no Prometheus shape: skip
+        _merge_snapshot(fams, snap)
+    for inst, snap in instances or []:
+        _merge_snapshot(fams, snap, extra=(("instance", inst),))
 
     lines: list[str] = []
     for name in sorted(fams):

@@ -21,19 +21,47 @@ the ring buffer; ``force=True`` (the ``profile=true`` query option)
 always traces; a non-zero ``slow_threshold`` traces every query so the
 span tree exists for whichever ones turn out slow, and fires
 ``on_slow`` with the tree dict for those.
+
+Distributed context (ISSUE 10): every traced query owns a W3C
+traceparent-style context — a 128-bit ``trace_id``, a per-span 64-bit
+``span_id``, and a sampled flag — carried across process boundaries as
+a ``traceparent`` header (``00-<32hex>-<16hex>-<2hex>``). A process
+receiving a sampled context adopts the trace id (``Tracer.trace(ctx=)``)
+so every leg of a federated query lands in some ring under ONE id; the
+root process stitches the remote legs back in two ways:
+
+* **synchronous** — a remote federation leg returns its serialized
+  child spans in the response envelope and the caller ``graft()``s them
+  into the live tree;
+* **asynchronous** — gang followers (one-way collective plane, no
+  response path) push their replay span dicts to the leader's
+  ``graft_remote`` buffer over HTTP, and ``recent()`` merges them into
+  the matching ring entry at read time.
+
+Span links (``Span.link``) record causal edges that aren't
+parent/child: a coalesced pipeline follower links the leader's trace, a
+wave-deduped dispatch item links the executed item.
 """
 
 from __future__ import annotations
 
 import contextvars
+import os
 import random
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Optional
 
 _current: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
     "pilosa_tpu_span", default=None
+)
+
+# distributed context of the current request even when it is NOT locally
+# sampled (flags 00): the tuple still has to reach dispatch items and
+# outbound RPC headers without allocating any Span
+_ctx_var: contextvars.ContextVar[Optional[tuple]] = contextvars.ContextVar(
+    "pilosa_tpu_trace_ctx", default=None
 )
 
 # monotonic count of real Span objects ever created — the overhead
@@ -50,12 +78,93 @@ def current() -> Optional["Span"]:
     return _current.get()
 
 
+# -- W3C traceparent-style context -------------------------------------------
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def format_traceparent(ctx: tuple) -> str:
+    """``(trace_id, span_id, sampled)`` → ``00-<32hex>-<16hex>-<2hex>``."""
+    trace_id, span_id, sampled = ctx
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[tuple]:
+    """Parse a traceparent header into ``(trace_id, span_id, sampled)``;
+    malformed input returns None (the request simply starts a fresh
+    trace — propagation must never fail a query)."""
+    if not header:
+        return None
+    parts = header.strip().lower().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if len(version) != 2 or len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    if len(flags) != 2:
+        return None
+    try:
+        int(version, 16)
+        int(trace_id, 16)
+        int(span_id, 16)
+        fl = int(flags, 16)
+    except ValueError:
+        return None
+    if version == "ff" or trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return (trace_id, span_id, bool(fl & 1))
+
+
+def current_ctx() -> Optional[tuple]:
+    """The distributed context of this request: the active span's ids
+    when traced, else the adopted-but-unsampled ingress context, else
+    None. What outbound RPC legs and dispatch items carry."""
+    sp = _current.get()
+    if sp is not None and sp.trace_id:
+        return (sp.trace_id, sp.span_id, True)
+    return _ctx_var.get()
+
+
+class _CtxActivation:
+    """Carry an unsampled distributed context through a request without
+    allocating spans (flags 00: propagate the id, trace nothing)."""
+
+    __slots__ = ("_ctx", "_token")
+
+    def __init__(self, ctx: Optional[tuple]) -> None:
+        self._ctx = ctx
+        self._token = None
+
+    def __enter__(self) -> Optional[tuple]:
+        if self._ctx is not None:
+            self._token = _ctx_var.set(self._ctx)
+        return self._ctx
+
+    def __exit__(self, *exc) -> bool:
+        if self._token is not None:
+            _ctx_var.reset(self._token)
+        return False
+
+
+def push_ctx(ctx: Optional[tuple]) -> _CtxActivation:
+    return _CtxActivation(ctx)
+
+
 class _NopSpan:
     """Shared do-nothing span: every method is a no-op and ``child``
     returns itself, so untraced code paths can use the same call shapes
     without allocating."""
 
     __slots__ = ()
+
+    trace_id = ""
+    span_id = ""
 
     def __enter__(self) -> "_NopSpan":
         return self
@@ -75,6 +184,12 @@ class _NopSpan:
     def annotate(self, **meta) -> None:
         pass
 
+    def link(self, trace_id: str, span_id: str = "", **attrs) -> None:
+        pass
+
+    def graft(self, subtree: dict) -> None:
+        pass
+
     def to_dict(self, base: Optional[float] = None) -> dict:
         return {}
 
@@ -88,7 +203,20 @@ class Span:
     instrumentation attaches implicitly; ``child()``/``event()`` attach
     explicitly (usable from any thread — list.append is atomic)."""
 
-    __slots__ = ("name", "meta", "t0", "duration", "children", "_token", "_tracer")
+    __slots__ = (
+        "name",
+        "meta",
+        "t0",
+        "duration",
+        "children",
+        "_token",
+        "_tracer",
+        "trace_id",
+        "span_id",
+        "parent_id",
+        "links",
+        "_grafts",
+    )
 
     def __init__(self, name: str, _tracer: Optional["Tracer"] = None, **meta) -> None:
         global _spans_created
@@ -100,9 +228,16 @@ class Span:
         self.children: list[Span] = []
         self._token = None
         self._tracer = _tracer
+        self.trace_id = ""
+        self.span_id = new_span_id()
+        self.parent_id = ""
+        self.links: Optional[list[dict]] = None
+        self._grafts: Optional[list[dict]] = None
 
     def child(self, name: str, **meta) -> "Span":
         sp = Span(name, **meta)
+        sp.trace_id = self.trace_id
+        sp.parent_id = self.span_id
         self.children.append(sp)
         return sp
 
@@ -110,6 +245,7 @@ class Span:
         """Zero-duration child (a point annotation, e.g. one routing
         decision)."""
         sp = Span(name, **meta)
+        sp.trace_id = self.trace_id
         sp.t0 = time.monotonic()
         sp.duration = 0.0
         self.children.append(sp)
@@ -121,6 +257,7 @@ class Span:
         timing cache, the pipeline's admission-queue wait), where
         enter/exit timing can't be used."""
         sp = Span(name, **meta)
+        sp.trace_id = self.trace_id
         sp.t0 = t0
         sp.duration = duration
         self.children.append(sp)
@@ -128,6 +265,28 @@ class Span:
 
     def annotate(self, **meta) -> None:
         self.meta.update(meta)
+
+    def link(self, trace_id: str, span_id: str = "", **attrs) -> None:
+        """A causal edge to a span that is NOT this span's parent —
+        singleflight coalescing, wave dedup (Canopy-style links)."""
+        d = {"trace_id": trace_id}
+        if span_id:
+            d["span_id"] = span_id
+        if attrs:
+            d.update(attrs)
+        if self.links is None:
+            self.links = []
+        self.links.append(d)
+
+    def graft(self, subtree: dict) -> None:
+        """Attach a pre-serialized span dict from ANOTHER process (a
+        remote federation leg's response envelope) as a child of this
+        span. The subtree keeps its own clock: its ``start_ms`` values
+        are relative to the remote process's root."""
+        if subtree:
+            if self._grafts is None:
+                self._grafts = []
+            self._grafts.append(subtree)
 
     def __enter__(self) -> "Span":
         self.t0 = time.monotonic()
@@ -144,6 +303,7 @@ class Span:
         return False
 
     def to_dict(self, base: Optional[float] = None) -> dict:
+        root = base is None
         if base is None:
             base = self.t0
         out = {
@@ -151,10 +311,21 @@ class Span:
             "start_ms": round((self.t0 - base) * 1000.0, 3),
             "duration_ms": round((self.duration or 0.0) * 1000.0, 3),
         }
+        if self.trace_id:
+            out["span_id"] = self.span_id
+            if root:
+                out["trace_id"] = self.trace_id
+                if self.parent_id:
+                    out["parent_id"] = self.parent_id
         if self.meta:
             out["meta"] = self.meta
-        if self.children:
-            out["children"] = [c.to_dict(base) for c in self.children]
+        if self.links:
+            out["links"] = list(self.links)
+        if self.children or self._grafts:
+            kids = [c.to_dict(base) for c in self.children]
+            if self._grafts:
+                kids.extend(self._grafts)
+            out["children"] = kids
         return out
 
 
@@ -195,6 +366,11 @@ def child(name: str, **meta):
 class Tracer:
     """Trace admission + the ring buffer of recent completed traces."""
 
+    # bounds on the remote-span stitch buffer: trace ids retained, and
+    # span dicts retained per trace (a runaway pusher can't grow it)
+    STITCH_TRACES = 64
+    STITCH_SPANS = 64
+
     def __init__(self, sample_rate: float = 0.0, ring_size: int = 128) -> None:
         self.sample_rate = sample_rate
         self.slow_threshold = 0.0  # seconds; >0 traces everything
@@ -202,15 +378,34 @@ class Tracer:
         self._ring: deque[dict] = deque(maxlen=ring_size)
         self._mu = threading.Lock()
         self.traces_recorded = 0
+        # fleet identity stamped into every sampled root span's meta
+        # (gang, rank, ...) so ring entries filter by gang and stitched
+        # trees are self-identifying; empty on a standalone node
+        self.tags: dict = {}
+        # trace_id -> pushed remote span dicts (gang-follower replay
+        # legs arriving over the one-way plane's HTTP side channel)
+        self._stitch: "OrderedDict[str, list[dict]]" = OrderedDict()
 
-    def trace(self, name: str, force: bool = False, **meta):
+    def trace(self, name: str, force: bool = False, ctx: Optional[tuple] = None, **meta):
         """A root span (context manager), or NOP_SPAN when this query is
-        not sampled."""
-        if not force and self.slow_threshold <= 0.0:
+        not sampled. ``ctx`` is a parsed traceparent tuple from an
+        upstream process: a sampled ctx forces tracing and the span
+        adopts its trace id (the upstream made the sampling decision);
+        an unsampled ctx only propagates the id via ``push_ctx``."""
+        sampled_upstream = ctx is not None and ctx[2]
+        if not force and not sampled_upstream and self.slow_threshold <= 0.0:
             r = self.sample_rate
             if r <= 0.0 or random.random() >= r:
                 return NOP_SPAN
-        return Span(name, _tracer=self, **meta)
+        if self.tags:
+            meta = {**self.tags, **meta}
+        sp = Span(name, _tracer=self, **meta)
+        if ctx is not None:
+            sp.trace_id = ctx[0]
+            sp.parent_id = ctx[1]
+        else:
+            sp.trace_id = new_trace_id()
+        return sp
 
     def _record(self, span: Span) -> None:
         d = span.to_dict()
@@ -228,13 +423,76 @@ class Tracer:
             except Exception:
                 pass  # a logging hook must never fail the query
 
-    def recent(self) -> list[dict]:
+    # -- remote stitching ----------------------------------------------------
+
+    def graft_remote(self, trace_id: str, spans: list[dict]) -> None:
+        """Buffer span dicts pushed by another process for ``trace_id``;
+        ``recent()``/``stitched()`` merge them into the matching ring
+        entry at read time. Bounded both ways."""
+        if not trace_id or not spans:
+            return
         with self._mu:
-            return list(self._ring)
+            bucket = self._stitch.get(trace_id)
+            if bucket is None:
+                while len(self._stitch) >= self.STITCH_TRACES:
+                    self._stitch.popitem(last=False)
+                bucket = self._stitch[trace_id] = []
+            room = self.STITCH_SPANS - len(bucket)
+            if room > 0:
+                bucket.extend(spans[:room])
+
+    def stitched(self, entry: dict) -> dict:
+        """A copy of one ring entry with any buffered remote spans for
+        its trace id appended as children (marked by their own meta:
+        rank/pid). The ring entry itself is never mutated."""
+        tid = entry.get("trace_id")
+        if not tid:
+            return entry
+        with self._mu:
+            extra = list(self._stitch.get(tid) or ())
+        # a leader-rank replay span lands in this ring AND the stitch
+        # buffer: never stitch an entry onto itself
+        sid = entry.get("span_id")
+        if sid:
+            extra = [e for e in extra if e.get("span_id") != sid]
+        if not extra:
+            return entry
+        out = dict(entry)
+        out["children"] = list(entry.get("children") or ()) + extra
+        return out
+
+    def recent(
+        self,
+        trace_id: Optional[str] = None,
+        min_ms: Optional[float] = None,
+        gang: Optional[str] = None,
+    ) -> list[dict]:
+        with self._mu:
+            entries = list(self._ring)
+        if trace_id:
+            entries = [d for d in entries if d.get("trace_id") == trace_id]
+        if min_ms is not None:
+            entries = [d for d in entries if d.get("duration_ms", 0.0) >= min_ms]
+        if gang:
+            entries = [d for d in entries if (d.get("meta") or {}).get("gang") == gang]
+        return [self.stitched(d) for d in entries]
 
     def clear(self) -> None:
         with self._mu:
             self._ring.clear()
+            self._stitch.clear()
+
+
+def record_link(name: str, ctx: tuple, target: tuple, tracer: Optional[Tracer] = None, **meta) -> None:
+    """Record a standalone point entry under ``ctx``'s trace id whose
+    only content is a link to ``target`` — how a request that never
+    executes (a coalesced pipeline follower, a wave-deduped dispatch
+    item) still appears in the trace of the work that served it."""
+    t = tracer if tracer is not None else TRACER
+    sp = t.trace(name, ctx=(ctx[0], ctx[1], True), **meta)
+    sp.link(target[0], target[1])
+    with sp:
+        pass
 
 
 # process-global default tracer; the server applies its config knobs

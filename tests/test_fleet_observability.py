@@ -1,0 +1,609 @@
+"""Fleet-wide distributed tracing + telemetry federation (ISSUE 10):
+W3C traceparent propagation, coalesce/dedup span links, gang replay
+under the originating trace id, remote-leg span envelopes, the stitch
+buffer, the lifecycle event journal, fleet metric aggregation, log
+correlation, and the zero-allocation contract for unsampled contexts.
+
+Server-level pieces run against a real in-process server on :0 under
+JAX_PLATFORMS=cpu (the tier-1 environment)."""
+
+import io
+import json
+import os
+import threading
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+from pilosa_tpu.server import Config, Server
+from pilosa_tpu.utils import events, logger as logger_mod, metrics, trace
+
+
+@pytest.fixture()
+def server(tmp_path):
+    cfg = Config(
+        data_dir=str(tmp_path / "data"),
+        bind="127.0.0.1:0",
+        metric="expvar",
+        device_policy="always",
+        device_timeout=0,
+    )
+    s = Server(cfg)
+    s.open()
+    yield s
+    s.close()
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    """The tracer/journal are process-global; every test starts and
+    ends with empty rings and no fleet identity."""
+    trace.TRACER.clear()
+    events.JOURNAL.clear()
+    saved_tags = (dict(trace.TRACER.tags), dict(events.JOURNAL.tags))
+    yield
+    trace.TRACER.clear()
+    events.JOURNAL.clear()
+    trace.TRACER.tags, events.JOURNAL.tags = saved_tags
+    logger_mod.set_context_provider(None)
+
+
+def req(server, method, path, body=None, raw=False, headers=None):
+    url = server.uri + path
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    r = urllib.request.Request(url, data=data, method=method)
+    for k, v in (headers or {}).items():
+        r.add_header(k, v)
+    try:
+        with urllib.request.urlopen(r) as resp:
+            payload = resp.read()
+            return resp.status, payload if raw else json.loads(payload or b"{}")
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, payload if raw else json.loads(payload or b"{}")
+
+
+def _ctx(sampled=True):
+    return (trace.new_trace_id(), trace.new_span_id(), sampled)
+
+
+# -- traceparent parse/format -------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    ctx = _ctx()
+    assert trace.parse_traceparent(trace.format_traceparent(ctx)) == ctx
+    ctx0 = _ctx(sampled=False)
+    hdr = trace.format_traceparent(ctx0)
+    assert hdr.endswith("-00")
+    assert trace.parse_traceparent(hdr) == ctx0
+    # uppercase + whitespace normalize; unknown flag bits keep bit 0
+    tid, sid, _ = ctx
+    assert trace.parse_traceparent(f"  00-{tid.upper()}-{sid}-03 ") == (
+        tid,
+        sid,
+        True,
+    )
+
+
+@pytest.mark.parametrize(
+    "header",
+    [
+        None,
+        "",
+        "garbage",
+        "00-abc-def-01",  # short ids
+        "00-" + "g" * 32 + "-" + "1" * 16 + "-01",  # non-hex
+        "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",  # forbidden version
+        "00-" + "0" * 32 + "-" + "2" * 16 + "-01",  # zero trace id
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",  # zero span id
+        "00-" + "1" * 32 + "-" + "2" * 16 + "-01-extra",
+        "00-" + "1" * 32 + "-" + "2" * 16 + "-0x",
+    ],
+)
+def test_traceparent_malformed_is_none(header):
+    assert trace.parse_traceparent(header) is None
+
+
+def test_record_link_point_entry():
+    tr = trace.Tracer()
+    ctx, target = _ctx(), _ctx()
+    trace.record_link("pipeline.coalesce", ctx, target, tracer=tr, cls="interactive")
+    (d,) = tr.recent()
+    assert d["trace_id"] == ctx[0] and d["parent_id"] == ctx[1]
+    assert d["links"] == [{"trace_id": target[0], "span_id": target[1]}]
+    assert d["meta"]["cls"] == "interactive"
+
+
+# -- stitch buffer ------------------------------------------------------------
+
+
+def test_graft_remote_stitches_and_bounds():
+    tr = trace.Tracer()
+    with tr.trace("query", force=True, ctx=(_tid := trace.new_trace_id(), "", True)):
+        pass
+    tr.graft_remote(_tid, [{"name": "multihost.replay", "span_id": "a" * 16}])
+    (d,) = tr.recent(trace_id=_tid)
+    assert [c["name"] for c in d["children"]] == ["multihost.replay"]
+    # the ring entry itself is never mutated
+    with tr._mu:
+        raw = [e for e in tr._ring if e.get("trace_id") == _tid]
+    assert "children" not in raw[0]
+    # per-trace span bound
+    tr.graft_remote(_tid, [{"name": f"s{i}"} for i in range(200)])
+    assert len(tr._stitch[_tid]) <= tr.STITCH_SPANS
+    # trace-id bound evicts oldest
+    for i in range(tr.STITCH_TRACES + 5):
+        tr.graft_remote(trace.new_trace_id(), [{"name": "x"}])
+    assert len(tr._stitch) <= tr.STITCH_TRACES
+    # empty pushes are no-ops
+    tr.graft_remote("", [{"name": "x"}])
+    tr.graft_remote(_tid, [])
+
+
+def test_stitched_never_attaches_entry_to_itself():
+    tr = trace.Tracer()
+    tid = trace.new_trace_id()
+    with tr.trace("multihost.replay", force=True, ctx=(tid, "", True)) as sp:
+        pass
+    # the leader-rank replay grafts its OWN dict into the local buffer
+    tr.graft_remote(tid, [sp.to_dict()])
+    (d,) = tr.recent(trace_id=tid)
+    assert "children" not in d  # not its own child
+
+
+def test_recent_filters():
+    tr = trace.Tracer()
+    tr.tags = {"gang": "g1", "rank": 0}
+    tid = trace.new_trace_id()
+    with tr.trace("query", force=True, ctx=(tid, "", True)):
+        pass
+    tr.tags = {}
+    with tr.trace("query", force=True):
+        time.sleep(0.002)
+    assert [d["trace_id"] for d in tr.recent(trace_id=tid)] == [tid]
+    assert all(
+        (d.get("meta") or {}).get("gang") == "g1" for d in tr.recent(gang="g1")
+    )
+    assert len(tr.recent(gang="g1")) == 1
+    slow = tr.recent(min_ms=1.5)
+    assert len(slow) == 1 and slow[0]["trace_id"] != tid
+    assert len(tr.recent()) == 2
+
+
+# -- event journal ------------------------------------------------------------
+
+
+def test_event_journal_record_snapshot_bounds():
+    j = events.EventJournal(ring_size=4)
+    j.tags = {"gang": "g1", "rank": 2}
+    for i in range(6):
+        j.record(events.GANG_TRANSITION, frm="ACTIVE", to="DEGRADED", epoch=i)
+    j.record(events.GANG_REFORM, epoch=9)
+    snap = j.snapshot()
+    assert len(snap) == 4  # ring bounded
+    assert [e["seq"] for e in snap] == sorted(e["seq"] for e in snap)
+    assert all(e["gang"] == "g1" and e["rank"] == 2 for e in snap)
+    reforms = j.snapshot(kind=events.GANG_REFORM)
+    assert len(reforms) == 1 and reforms[0]["epoch"] == 9
+    last = snap[-1]["seq"]
+    assert j.snapshot(since_seq=last) == []
+    assert j.snapshot(since_seq=last - 1) == [snap[-1]]
+
+
+def test_event_journal_stamps_active_trace():
+    j = events.EventJournal()
+    ctx = _ctx(sampled=False)
+    with trace.push_ctx(ctx):
+        j.record(events.CLIENT_RETRY_EXHAUSTED, op="query")
+    (e,) = j.snapshot()
+    assert e["trace_id"] == ctx[0]
+    j.clear()
+    j.record(events.GANG_DEGRADE, reason="x")
+    assert "trace_id" not in j.snapshot()[0]
+
+
+def test_gang_lifecycle_records_events():
+    """degrade() and reform() on a replicated runtime journal the
+    DEGRADED -> REFORMING -> ACTIVE story with epochs."""
+    from pilosa_tpu.parallel.multihost import MultiHostRuntime
+
+    mh = MultiHostRuntime.replicated(apply_fn=lambda kind, payload: None)
+    # replicated boot starts DEGRADED; join a follower to reach ACTIVE
+    mh.reform(["http://f:1"], reason="boot join")
+    base = mh.epoch
+    mh.degrade("follower died")
+    mh.reform(["http://f:1"], reason="follower rejoined")
+    kinds = [e["kind"] for e in events.snapshot()]
+    assert events.GANG_DEGRADE in kinds and events.GANG_REFORM in kinds
+    transitions = [
+        (e["frm"], e["to"])
+        for e in events.snapshot(kind=events.GANG_TRANSITION)
+    ]
+    assert ("DEGRADED", "REFORMING") in transitions
+    assert ("REFORMING", "ACTIVE") in transitions
+    reform = events.snapshot(kind=events.GANG_REFORM)[-1]
+    assert reform["epoch"] > base
+
+
+# -- coalesce / dedup span links ---------------------------------------------
+
+
+def test_pipeline_coalesced_follower_links_leader_trace():
+    from pilosa_tpu.server.pipeline import QueryPipeline
+
+    pl = QueryPipeline(workers={"interactive": 1})
+    lead_ctx, fol_ctx = _ctx(), _ctx()
+    started, release = threading.Event(), threading.Event()
+
+    def leader_thunk():
+        started.set()
+        release.wait(5)
+        return "L"
+
+    out = {}
+    t1 = threading.Thread(
+        target=lambda: out.setdefault(
+            "lead",
+            pl.submit("interactive", leader_thunk, signature="sig", trace_ctx=lead_ctx),
+        )
+    )
+    t1.start()
+    assert started.wait(5)
+    t2 = threading.Thread(
+        target=lambda: out.setdefault(
+            "fol",
+            pl.submit("interactive", lambda: "F", signature="sig", trace_ctx=fol_ctx),
+        )
+    )
+    t2.start()
+    try:
+        # the follower records its link synchronously at admission
+        deadline = time.monotonic() + 5
+        while not trace.TRACER.recent(trace_id=fol_ctx[0]):
+            assert time.monotonic() < deadline, "coalesce link never recorded"
+            time.sleep(0.005)
+    finally:
+        release.set()
+        t1.join(5)
+        t2.join(5)
+    assert out["fol"] == "L"  # served by the leader's execution
+    (d,) = trace.TRACER.recent(trace_id=fol_ctx[0])
+    assert d["name"] == metrics.STAGE_PIPELINE_COALESCE
+    assert d["links"][0]["trace_id"] == lead_ctx[0]
+    assert d["meta"]["leader_traced"] is True
+    pl.close()
+
+
+def test_dispatch_deduped_item_links_executed_item():
+    from pilosa_tpu.executor.dispatch import DispatchEngine, _Item
+    from pilosa_tpu.pql import parse
+
+    ex = SimpleNamespace(
+        _execute=lambda index, q, shards, opt: [42] * len(q.calls),
+        stager=SimpleNamespace(),
+    )
+    eng = DispatchEngine(ex)
+    q = parse("Count(Row(f=1))")
+    lead_ctx, dup_ctx = _ctx(), _ctx()
+    opt = SimpleNamespace(
+        remote=False, exclude_row_attrs=False, exclude_columns=False, cache=True
+    )
+    a = _Item("i", q, None, opt, None, "sig", trace_ctx=lead_ctx)
+    b = _Item("i", q, None, opt, None, "sig", trace_ctx=dup_ctx)
+    eng._run_group([a, b], wave_no=7)
+    assert a.value == [42] and b.value == [42]
+    assert eng.dedup_hits == 1
+    (d,) = trace.TRACER.recent(trace_id=dup_ctx[0])
+    assert d["name"] == metrics.STAGE_DISPATCH_DEDUP
+    assert d["links"][0]["trace_id"] == lead_ctx[0]
+    assert d["meta"]["wave"] == 7 and d["meta"]["signature"] == "sig"
+    # the executed item records no link entry
+    assert trace.TRACER.recent(trace_id=lead_ctx[0]) == []
+
+
+# -- gang replay --------------------------------------------------------------
+
+
+def _stub_gang_server(rank=1, seen=None):
+    def execute(index, query, shards, opt):
+        if seen is not None:
+            seen.append((trace.current_ctx(), trace.current()))
+        return [7]
+
+    return SimpleNamespace(
+        executor=SimpleNamespace(execute=execute),
+        multihost=None,
+        _mh_rank=rank,
+        gang_epoch=3,
+        config=SimpleNamespace(federation_rejoin=""),
+        client_ssl_context=lambda: None,
+    )
+
+
+def test_gang_replay_runs_under_originating_trace_id():
+    from pilosa_tpu.parallel.multihost import KIND_QUERY, make_apply_fn
+
+    seen = []
+    apply = make_apply_fn(_stub_gang_server(rank=1, seen=seen))
+    ctx = _ctx()
+    out = apply(
+        KIND_QUERY,
+        {
+            "index": "i",
+            "query": "Count(Row(f=1))",
+            "shards": None,
+            "plan": "p",
+            "opt": {},
+            "trace": trace.format_traceparent(ctx),
+        },
+    )
+    assert out == [7]
+    # the replay executed inside a span of the ORIGINATING trace
+    (exec_ctx, exec_span) = seen[0]
+    assert exec_ctx[0] == ctx[0] and exec_span is not None
+    (d,) = trace.TRACER.recent(trace_id=ctx[0])
+    assert d["name"] == metrics.STAGE_MH_REPLAY
+    assert d["parent_id"] == ctx[1]
+    assert d["meta"]["rank"] == 1 and d["meta"]["epoch"] == 3
+    assert d["meta"]["pid"] == os.getpid()
+    # rank != leader with no leader URI known: shipped into the local
+    # stitch buffer as the best-effort fallback target is empty
+    assert ctx[0] in trace.TRACER._stitch
+
+
+def test_gang_replay_unsampled_allocates_no_spans():
+    from pilosa_tpu.parallel.multihost import KIND_QUERY, make_apply_fn
+
+    seen = []
+    apply = make_apply_fn(_stub_gang_server(seen=seen))
+    ctx = _ctx(sampled=False)
+    before = trace.span_count()
+    apply(
+        KIND_QUERY,
+        {
+            "index": "i",
+            "query": "Count(Row(f=1))",
+            "shards": None,
+            "opt": {},
+            "trace": trace.format_traceparent(ctx),
+        },
+    )
+    assert trace.span_count() == before
+    # ...but the bare context still propagated to the execution
+    exec_ctx, exec_span = seen[0]
+    assert exec_ctx == ctx and exec_span is None
+    assert trace.TRACER.recent() == []
+
+
+# -- fleet collector ----------------------------------------------------------
+
+
+def test_fleet_collector_local_and_render():
+    metrics.count("executor.calls", call="Count")
+    srv = SimpleNamespace(
+        uri="http://a:1", _expvar=None, cluster=None, client_ssl_context=lambda: None
+    )
+    from pilosa_tpu.server.fleet import FleetCollector
+
+    fleet = FleetCollector(srv)
+    pairs = fleet.collect()
+    assert [label for label, _ in pairs] == ["http://a:1"]
+    assert any(k.startswith("executor.calls") for k in pairs[0][1])
+    text = metrics.render_prometheus(
+        registry=metrics.Registry(), instances=pairs
+    )
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        assert 'instance="http://a:1"' in line, line
+    dbg = fleet.debug()
+    assert dbg["self"] == "http://a:1" and dbg["members"] == []
+
+
+def test_fleet_collector_member_pull_failure_is_isolated():
+    srv = SimpleNamespace(
+        uri="http://a:1", _expvar=None, cluster=None, client_ssl_context=lambda: None
+    )
+    from pilosa_tpu.server.fleet import FleetCollector
+
+    fleet = FleetCollector(srv)
+    # unreachable member: nothing listens on this port
+    fleet.register("http://127.0.0.1:1", rank=1, gang="g")
+    fleet._client = SimpleNamespace(
+        fleet_snapshots=lambda uri: (_ for _ in ()).throw(OSError("down"))
+    )
+    pairs = fleet.collect()
+    assert [label for label, _ in pairs] == ["http://a:1"]  # scrape survived
+    assert fleet.debug()["pulls"]["http://127.0.0.1:1"]["ok"] is False
+    snap = metrics.snapshot()
+    assert any(
+        k.startswith(metrics.FLEET_SCRAPES) and "error" in k for k in snap
+    )
+
+
+# -- log correlation ----------------------------------------------------------
+
+
+def test_logger_emits_trace_and_gang_context():
+    from pilosa_tpu.utils.logger import StandardLogger
+
+    buf = io.StringIO()
+    lg = StandardLogger(stream=buf)
+    lg.printf("plain")
+    assert "[" not in buf.getvalue()
+    logger_mod.set_context_provider(lambda: {"gang": "g1", "rank": 2, "epoch": 5})
+    tr = trace.Tracer()
+    with tr.trace("query", force=True):
+        lg.printf("inside span")
+    out = buf.getvalue().splitlines()[-1]
+    assert "trace=" in out and "gang=g1" in out
+    assert "rank=2" in out and "epoch=5" in out
+    # provider alone (no active span) still correlates gang context
+    lg.printf("no span")
+    out = buf.getvalue().splitlines()[-1]
+    assert "trace=" not in out and "gang=g1" in out
+    # a raising provider never breaks logging
+    logger_mod.set_context_provider(lambda: 1 / 0)
+    lg.printf("still works")
+    assert "still works" in buf.getvalue()
+
+
+# -- server-level: ingress, debug surfaces, fleet scrape ----------------------
+
+
+def _seed(server, index="fo"):
+    req(server, "POST", f"/index/{index}", {})
+    req(server, "POST", f"/index/{index}/field/f", {})
+    req(server, "POST", f"/index/{index}/query", b"Set(1, f=1)")
+
+
+def test_ingress_adopts_sampled_traceparent(server):
+    _seed(server)
+    ctx = _ctx()
+    st, body = req(
+        server,
+        "POST",
+        "/index/fo/query",
+        b"Count(Row(f=1))",
+        headers={"traceparent": trace.format_traceparent(ctx)},
+    )
+    assert st == 200 and body["results"] == [1]
+    st, body = req(server, "GET", f"/debug/traces?trace_id={ctx[0]}")
+    assert st == 200 and len(body["traces"]) == 1
+    d = body["traces"][0]
+    assert d["trace_id"] == ctx[0] and d["parent_id"] == ctx[1]
+    assert d["name"] == metrics.STAGE_QUERY
+    # other filters reach the same entry
+    st, body = req(server, "GET", "/debug/traces?min_ms=0")
+    assert st == 200 and body["traces"]
+    st, body = req(server, "GET", f"/debug/traces?trace_id={'f' * 32}")
+    assert st == 200 and body["traces"] == []
+    st, _ = req(server, "GET", "/debug/traces?min_ms=bogus")
+    assert st == 400
+
+
+def test_ingress_unsampled_traceparent_allocates_no_spans(server):
+    _seed(server, index="uns")
+    # warm so lazy pools/jits don't muddy the probe
+    req(server, "POST", "/index/uns/query", b"Count(Row(f=1))")
+    ctx = _ctx(sampled=False)
+    before = trace.span_count()
+    st, body = req(
+        server,
+        "POST",
+        "/index/uns/query",
+        b"Count(Row(f=1))",
+        headers={"traceparent": trace.format_traceparent(ctx)},
+    )
+    assert st == 200 and body["results"] == [1]
+    assert trace.span_count() == before
+    # malformed headers are ignored, never an error
+    st, body = req(
+        server,
+        "POST",
+        "/index/uns/query",
+        b"Count(Row(f=1))",
+        headers={"traceparent": "not-a-traceparent"},
+    )
+    assert st == 200 and body["results"] == [1]
+
+
+def test_remote_query_returns_span_envelope(server):
+    _seed(server, index="env")
+    ctx = _ctx()
+    resp = server.api.query(
+        "env", "Count(Row(f=1))", remote=True, trace_ctx=ctx
+    )
+    assert resp["results"] == [1]
+    (d,) = resp["spans"]
+    assert d["trace_id"] == ctx[0] and d["parent_id"] == ctx[1]
+    # unsampled remote legs carry no envelope
+    resp = server.api.query(
+        "env", "Count(Row(f=1))", remote=True, trace_ctx=_ctx(sampled=False)
+    )
+    assert "spans" not in resp
+
+
+def test_trace_push_endpoint_feeds_stitch_buffer(server):
+    tid = trace.new_trace_id()
+    st, body = req(
+        server,
+        "POST",
+        "/internal/trace/push",
+        {"trace_id": tid, "spans": [{"name": "multihost.replay", "meta": {"rank": 1}}]},
+    )
+    assert st == 200
+    assert tid in trace.TRACER._stitch
+    snap = metrics.snapshot()
+    assert any(
+        k.startswith(metrics.TRACE_REMOTE_SPANS) and "push" in k for k in snap
+    )
+    st, _ = req(server, "POST", "/internal/trace/push", {"spans": []})
+    assert st == 400  # trace_id required
+
+
+def test_debug_events_endpoint_and_cli_filters(server):
+    events.record(events.GANG_DEGRADE, reason="test", epoch=1)
+    events.record(events.GANG_REFORM, reason="test", epoch=2)
+    st, body = req(server, "GET", "/debug/events")
+    assert st == 200
+    kinds = [e["kind"] for e in body["events"]]
+    assert events.GANG_DEGRADE in kinds and events.GANG_REFORM in kinds
+    st, body = req(server, "GET", f"/debug/events?kind={events.GANG_REFORM}")
+    assert st == 200
+    assert all(e["kind"] == events.GANG_REFORM for e in body["events"])
+    assert body["events"]
+    last = body["events"][-1]["seq"]
+    st, body = req(server, "GET", f"/debug/events?since={last}")
+    assert st == 200 and body["events"] == []
+    st, _ = req(server, "GET", "/debug/events?since=bogus")
+    assert st == 400
+
+
+def test_build_info_and_fleet_scrape(server):
+    st, raw = req(server, "GET", "/metrics", raw=True)
+    assert st == 200
+    text = raw.decode()
+    assert "pilosa_build_info{" in text
+    (line,) = [
+        l for l in text.splitlines() if l.startswith("pilosa_build_info{")
+    ]
+    assert 'rank="0"' in line and 'leader="true"' in line
+    assert f'pid="{os.getpid()}"' in line
+    # fleet aggregation on a standalone server: one instance (itself),
+    # every sample instance-labeled
+    st, raw = req(server, "GET", "/metrics?fleet=true", raw=True)
+    assert st == 200
+    for l in raw.decode().splitlines():
+        if l.startswith("#") or not l:
+            continue
+        assert f'instance="{server.uri}"' in l, l
+    st, body = req(server, "GET", "/debug/fleet")
+    assert st == 200 and body["enabled"] is True
+    assert body["self"] == server.uri
+
+
+def test_fleet_register_and_snapshots_endpoints(server):
+    st, body = req(
+        server,
+        "POST",
+        "/internal/fleet/register",
+        {"uri": "http://127.0.0.1:1", "rank": 1, "gang": "g"},
+    )
+    assert st == 200 and body["registered"] is True
+    members = server.fleet.members()
+    assert members and members[0]["uri"] == "http://127.0.0.1:1"
+    assert members[0]["rank"] == 1 and members[0]["gang"] == "g"
+    st, _ = req(server, "POST", "/internal/fleet/register", {})
+    assert st == 400  # uri required
+    # drop the dead member so the snapshot pull doesn't wait on it
+    server.fleet._members.clear()
+    st, body = req(server, "GET", "/internal/fleet/snapshots")
+    assert st == 200
+    (pair,) = body["snapshots"]
+    assert pair[0] == server.uri and isinstance(pair[1], dict)
