@@ -617,6 +617,357 @@ def _rw_mix_probe(budget_s: float) -> dict:
     return out
 
 
+def _hist_count_delta(snap0: dict, snap1: dict, name: str) -> int:
+    """Observation-count delta of a summary metric between two
+    ``metrics.snapshot()`` calls, summed over label sets."""
+    tot = 0
+    for k, v in snap1.items():
+        if not isinstance(v, dict) or k.split(";")[0] != name:
+            continue
+        prev = snap0.get(k)
+        tot += v.get("count", 0) - (prev.get("count", 0) if prev else 0)
+    return tot
+
+
+def _ingest_sustained_probe(budget_s: float) -> dict:
+    """Durable streaming ingest steady state (ISSUE 11): c12
+    closed-loop TopN/chain reads on the device executor while >=10% of
+    operations submit 16-mutation batches through the write-ahead
+    IngestQueue — each submit blocks until its wave is group-committed
+    + fsynced — interleaved with read-only segments on the same warm
+    state (median of adjacent-pair ratios, because this rig's core
+    speed drifts 2x within a minute). Reports the read-qps ratio
+    (acceptance: >=0.8x at >=10% writes), write-ack p50/p99, wave
+    coalescing stats, and the bounded-staleness figure (coalesce window
+    + observed ack p99). The post-ingest state is checked bit-for-bit
+    against an uncached CPU oracle, and a federated sub-arm drives
+    write waves through a replicated-solo leader while a follower
+    rejoins mid-stream and must converge. Chip-independent (the
+    contrast is queue/commit economics, not kernel speed)."""
+    import shutil as _shutil
+    import tempfile
+
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import DeviceStager, Executor
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.server.ingest import IngestQueue
+    from pilosa_tpu.utils import metrics as _metrics
+
+    R, BITS = 128, 3000
+    WRITE_FRAC = 0.10  # fraction of ops that are batch submits
+    BATCH = 16  # mutations per submit
+    # a much wider coalesce window than the server default (2 ms):
+    # this rig is 1-core, and every wave carries a fixed read-side tax
+    # (full-matrix delta scatter on next TopN + fsync + commit, ~5-8 ms
+    # total) — at 10% batch submits the wave rate, not the mutation
+    # count, decides the read hit, so coalescing harder trades ack
+    # latency for most of the read throughput
+    WAVE_INTERVAL = 0.050
+    # enough closed-loop workers that ack waits (mostly coalesce-window
+    # sleep, GIL-free) overlap with reads instead of idling the core;
+    # both arms run the same count so the baseline is comparable
+    N_WORKERS = 12
+    tmp = tempfile.mkdtemp(prefix="pilosa_ingest_probe_")
+    out = {
+        "note": (
+            "c12 closed-loop device reads with 10% of ops submitting "
+            "16-mutation batches through the durable IngestQueue (ack "
+            "= group commit + fsync), interleaved with read-only "
+            "segments on the same warm state; ratio = median of "
+            "adjacent pairs; staleness bound = coalesce window + ack "
+            "p99"
+        ),
+        "write_frac": WRITE_FRAC,
+        "batch_size": BATCH,
+        "wave_interval_s": WAVE_INTERVAL,
+    }
+    h = Holder(tmp)
+    h.open()
+    try:
+        idx = h.create_index("ing")
+        fld = idx.create_field("f")
+        rng = np.random.default_rng(53)
+        rows, cols = [], []
+        for r_ in range(R):
+            rows += [r_] * BITS
+            cols += rng.integers(0, 1 << 20, size=BITS).tolist()
+        fld.import_bits(rows, cols)
+        queries = [
+            "TopN(f, n=10)",
+            "TopN(f, Row(f=3), n=8)",
+            "Count(Intersect(Row(f=1), Row(f=2)))",
+            "Count(Union(Row(f=4), Row(f=5), Row(f=6)))",
+        ]
+
+        def _batch_muts(wrng):
+            # streaming-shaped writes: uniform over the whole row space
+            # (an event stream lands anywhere, unlike rw_mix's
+            # adversarial hot-row writes), mostly sets plus some clears
+            # so OP_REMOVE coalescing and replay ride along. The staged
+            # read set still pays — the full-matrix TopN entry absorbs
+            # every wave, per-row entries only the waves touching them
+            rs = wrng.integers(0, R, size=BATCH)
+            cs = wrng.integers(0, 1 << 20, size=BATCH)
+            ss = wrng.random(BATCH) > 0.2
+            return rs.tolist(), cs.tolist(), ss.tolist()
+
+        # one executor + one queue for the WHOLE probe: segments
+        # toggle the write mix on warm shared state, so pairing adjacent
+        # segments cancels the rig's drift (this shared core's speed
+        # moves 2x+ within a minute — a single A/B split mismeasures)
+        ex = Executor(
+            h,
+            device_policy="always",
+            stager=DeviceStager(delta_enabled=True),
+        )
+        for qq in queries:  # warm: compile + stage
+            ex.execute("ing", qq)
+        iq = IngestQueue(API(h, ex), wave_max=2048, wave_interval=WAVE_INTERVAL)
+        wrng = np.random.default_rng(9000)
+        for _ in range(40):
+            # absorb the write-path compiles (wave apply, delta scatter
+            # shapes) AND drive the fragment's ranked cache to its
+            # written-to steady state — wave applies maintain the rank
+            # cache, which makes the filtered-TopN read ~3x cheaper, so
+            # a cold-cache read-only baseline would understate the
+            # denominator and flatter the ratio
+            rs, cs, ss = _batch_muts(wrng)
+            iq.submit("ing", "f", rs, cs, ss)
+            for qq in queries:
+                ex.execute("ing", qq)
+
+        ack_lat: list = []
+        lat_mu = threading.Lock()
+
+        def run_seg(write_frac, seconds, nonce):
+            stop = time.perf_counter() + seconds
+            reads = [0] * N_WORKERS
+            acked = [0] * N_WORKERS
+            errors: list = []
+
+            def worker(ci):
+                wr = np.random.default_rng(2000 + nonce * N_WORKERS + ci)
+                i = ci
+                try:
+                    while time.perf_counter() < stop and not errors:
+                        if write_frac and wr.random() < write_frac:
+                            rs, cs, ss = _batch_muts(wr)
+                            t1 = time.perf_counter()
+                            iq.submit("ing", "f", rs, cs, ss)
+                            lat = time.perf_counter() - t1
+                            acked[ci] += BATCH
+                            with lat_mu:
+                                ack_lat.append(lat)
+                        else:
+                            ex.execute("ing", queries[i % len(queries)])
+                            reads[ci] += 1
+                        i += 1
+                except BaseException as e:
+                    errors.append(e)
+
+            ts = [
+                threading.Thread(target=worker, args=(ci,))
+                for ci in range(N_WORKERS)
+            ]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errors:
+                raise errors[0]
+            dt = time.perf_counter() - t0
+            return sum(reads) / dt, sum(acked) / dt
+
+        # interleaved pairs: ro seg then ingest seg, repeated; the
+        # reported ratio is the MEDIAN of per-pair ratios
+        n_pairs = 3
+        seg = max(1.5, min(4.0, budget_s / (2 * n_pairs + 1)))
+        snap0 = _metrics.snapshot()
+        st0_waves = iq.stats()["waves"]
+        ro_qps, ing_qps, ing_mut = [], [], []
+        for k in range(n_pairs):
+            r_qps, _ = run_seg(0.0, seg, nonce=2 * k)
+            w_qps, w_mut = run_seg(WRITE_FRAC, seg, nonce=2 * k + 1)
+            ro_qps.append(round(r_qps, 1))
+            ing_qps.append(round(w_qps, 1))
+            ing_mut.append(w_mut)
+        snap1 = _metrics.snapshot()
+        st = iq.stats()
+        iq.close()
+
+        def delta_of(name):
+            tot = 0.0
+            for k, v in snap1.items():
+                if isinstance(v, dict) or k.split(";")[0] != name:
+                    continue
+                tot += v - (snap0.get(k) or 0)
+            return tot
+
+        lats = np.array(ack_lat)
+        waves = st["waves"] - st0_waves
+        acked_total = seg * sum(ing_mut)
+        out["read_only"] = {
+            "read_qps": round(float(np.median(ro_qps)), 1),
+            "segments": ro_qps,
+        }
+        out["sustained_ingest"] = {
+            "read_qps": round(float(np.median(ing_qps)), 1),
+            "segments": ing_qps,
+            "acked_mutations_per_s": round(sum(ing_mut) / len(ing_mut), 1),
+            "submits": len(ack_lat),
+            "write_ack_p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 2),
+            "write_ack_p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 2),
+            "waves": waves,
+            "mean_wave_size": round(acked_total / waves, 1) if waves else None,
+            "fsyncs": _hist_count_delta(
+                snap0, snap1, "ingest.fsync_seconds.hist"
+            ),
+            "delta_applied": int(delta_of("stager.delta_applied")),
+            "restaged_bytes": int(delta_of("stager.restaged_bytes")),
+            # readers lag a submitted mutation by at most the coalesce
+            # window + one wave commit — the observed ack p99 bounds
+            # the latter
+            "staleness_bound_ms": round(
+                WAVE_INTERVAL * 1e3 + float(np.percentile(lats, 99)) * 1e3, 2
+            ),
+        }
+        out["ingest_vs_read_only"] = round(
+            float(np.median([w / r for r, w in zip(ro_qps, ing_qps) if r])), 3
+        )
+        # post-ingest oracle: the warm device path (staged deltas from
+        # all committed waves) must match a fresh uncached CPU executor
+        oracle = Executor(h, device_policy="never")
+        checks = queries + [f"Count(Row(f={r_}))" for r_ in range(16)]
+        mism = 0
+        for qq in checks:
+            (got,) = ex.execute("ing", qq)
+            (want,) = oracle.execute("ing", qq)
+            if str(got) != str(want):
+                mism += 1
+        out["oracle_checks"] = len(checks)
+        out["result_mismatches_vs_uncached_oracle"] = mism
+    finally:
+        h.close()
+        _shutil.rmtree(tmp, ignore_errors=True)
+
+    # federated sub-arm: write waves through a replicated-solo leader
+    # (one KIND_WRITE_WAVE descriptor per wave) while a follower
+    # rejoins mid-stream; the follower must re-stage the pre-rejoin
+    # waves and receive the post-rejoin ones through replication
+    if budget_s > 12:
+        try:
+            out["federated"] = _ingest_federated_subarm()
+        except Exception as e:
+            out["federated"] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def _ingest_federated_subarm() -> dict:
+    """Boot a replicated-solo federated leader in-process, ingest write
+    waves through HTTP, rejoin a follower mid-stream, and verify the
+    follower converges to the leader's bit state — the wave-replication
+    leg of the durability story (tests/test_federation.py exercises
+    the full lifecycle; this records the numbers)."""
+    import json as _json
+    import shutil as _shutil
+    import socket as _socket
+    import tempfile
+    import urllib.request
+
+    from pilosa_tpu.server import ClusterConfig, Config, Server
+
+    tmp = tempfile.mkdtemp(prefix="pilosa_ingest_fed_")
+    out: dict = {}
+    servers: list = []
+    try:
+        # the leader needs the cluster plane wired (federation.wire
+        # installs the gang's replicate hook on it) — a 1-node cluster
+        # is enough, the follower rides the gang plane only
+        with _socket.socket() as _s:
+            _s.bind(("127.0.0.1", 0))
+            pa = _s.getsockname()[1]
+        a = Server(
+            Config(
+                data_dir=os.path.join(tmp, "lead"),
+                bind=f"127.0.0.1:{pa}",
+                device_policy="never",
+                metric="none",
+                federation_leader=True,
+                cluster=ClusterConfig(
+                    disabled=False,
+                    coordinator=True,
+                    hosts=[f"127.0.0.1:{pa}"],
+                    probe_interval=0,
+                ),
+            )
+        )
+        a.open()
+        servers.append(a)
+
+        def post(uri, path, body):
+            r = urllib.request.Request(uri + path, data=body, method="POST")
+            with urllib.request.urlopen(r, timeout=30) as resp:
+                return _json.loads(resp.read() or b"{}")
+
+        post(a.uri, "/index/i", b"{}")
+        post(a.uri, "/index/i/field/f", b"{}")
+        rng = np.random.default_rng(31)
+
+        def ingest_waves(n_batches, batch=32):
+            total = 0
+            for _ in range(n_batches):
+                rs = rng.integers(0, 64, size=batch).tolist()
+                cs = rng.integers(0, 1 << 20, size=batch).tolist()
+                body = _json.dumps({"rowIDs": rs, "columnIDs": cs}).encode()
+                r = post(a.uri, "/index/i/field/f/ingest", body)
+                total += r["acked"]
+            return total
+
+        out["pre_rejoin_acked"] = ingest_waves(8)
+        f = Server(
+            Config(
+                data_dir=os.path.join(tmp, "fol"),
+                bind="127.0.0.1:0",
+                device_policy="never",
+                metric="none",
+                federation_rejoin=a.uri,
+            )
+        )
+        f.open()
+        servers.append(f)
+        t0 = time.perf_counter()
+        t_end = time.monotonic() + 30
+        while a.multihost.state != "ACTIVE" and time.monotonic() < t_end:
+            time.sleep(0.05)
+        out["rejoin_seconds"] = round(time.perf_counter() - t0, 2)
+        out["gang_state"] = a.multihost.state
+        out["post_rejoin_acked"] = ingest_waves(8)
+
+        def count_on(uri):
+            r = post(uri, "/index/i/query", b"Count(Union(Row(f=0), Row(f=1)))")
+            return r["results"][0]
+
+        want = count_on(a.uri)
+        t0 = time.perf_counter()
+        t_end = time.monotonic() + 30
+        while count_on(f.uri) != want and time.monotonic() < t_end:
+            time.sleep(0.05)
+        got = count_on(f.uri)
+        out["follower_convergence_seconds"] = round(time.perf_counter() - t0, 2)
+        out["follower_converged"] = got == want
+        out["leader_count"] = want
+        out["follower_count"] = got
+    finally:
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+        _shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def _continuous_batching_probe(budget_s: float) -> dict:
     """Continuous-batching dispatch engine A/B (ISSUE 8): closed-loop
     c8/c32 heterogeneous reads (TopN/Count/Intersect/chain) against two
@@ -1158,6 +1509,23 @@ def main():
                 print(
                     f"continuous-batching probe failed: "
                     f"{type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
+
+    # ---- durable ingest probe (ISSUE 11): sustained >=10% writes
+    # through the write-ahead queue (ack = group commit + fsync) vs a
+    # read-only baseline, write-ack p50/p99, bounded staleness, an
+    # uncached oracle check, and a federated rejoin-mid-stream sub-arm.
+    if os.environ.get("PILOSA_BENCH_INGEST", "1") != "0":
+        rem = child_budget - (time.monotonic() - _T_PROC_START)
+        if rem > 60:
+            try:
+                result["ingest_sustained"] = _ingest_sustained_probe(
+                    min(30.0, rem - 35)
+                )
+            except Exception as e:
+                print(
+                    f"ingest probe failed: {type(e).__name__}: {e}",
                     file=sys.stderr,
                 )
 

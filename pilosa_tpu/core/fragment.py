@@ -24,6 +24,7 @@ import math
 import mmap
 import os
 import threading
+import time
 from collections import deque
 from typing import Iterable, Optional
 
@@ -31,8 +32,10 @@ import numpy as np
 
 from pilosa_tpu import SHARD_WIDTH
 from pilosa_tpu.roaring import Bitmap
+from pilosa_tpu.roaring import bitmap as bitmap_mod
 from pilosa_tpu.core.row import Row
 from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.utils import events, metrics
 
 # reference fragment.go:55-64
 HASH_BLOCK_SIZE = 100
@@ -48,7 +51,133 @@ MAX_OP_N = 2000
 # class attribute).
 DELTA_LOG_MAX = 4096
 
+# Bulk imports at or under this many positions route through the
+# batched delta path (one OP_BATCH group-commit append + one device
+# scatter) instead of the merge+snapshot path that `_delta_reset()`s
+# and forces staged blocks to full-rebuild — the bulk-import cliff.
+# Overridable per process via the `ingest-delta-max-batch` config knob
+# (server/server.py sets the module attribute).
+DELTA_MAX_BATCH = 512
+
 DEFAULT_MIN_THRESHOLD = 1  # reference executor.go defaultMinThreshold
+
+
+# -- storage fault injection (tests/dryruns only) ----------------------------
+
+STORAGE_FAULTS_ENV = "PILOSA_TPU_STORAGE_FAULTS"
+
+
+class StorageFaultSpec:
+    """Deterministic fault schedule for the fragment op-log write path,
+    parsed from the ``storage-faults`` config knob (or
+    ``PILOSA_TPU_STORAGE_FAULTS``): ``fsync_fail_every=N`` raises EIO
+    on every Nth fsync (the record reached the page cache but
+    durability is unproven), ``torn_at=N`` tears the first append that
+    would push the cumulative appended byte count past N — only a
+    prefix reaches the file, then EIO (a partial sector landing before
+    power loss), ``enospc_after=K`` fails every append after the first
+    K with ENOSPC, writing nothing. No RNG — crash-recovery tests
+    reproduce exactly. Injected failures journal ``ingest.fault``."""
+
+    __slots__ = (
+        "fsync_fail_every",
+        "torn_at",
+        "enospc_after",
+        "_fsyncs",
+        "_bytes",
+        "_appends",
+        "_torn_done",
+        "_mu",
+    )
+
+    def __init__(
+        self,
+        fsync_fail_every: int = 0,
+        torn_at: int = 0,
+        enospc_after: int = 0,
+    ) -> None:
+        self.fsync_fail_every = fsync_fail_every
+        self.torn_at = torn_at
+        self.enospc_after = enospc_after
+        self._fsyncs = 0
+        self._bytes = 0
+        self._appends = 0
+        self._torn_done = False
+        self._mu = threading.Lock()
+
+    @classmethod
+    def parse(cls, text: str) -> "StorageFaultSpec":
+        spec = cls()
+        for part in (text or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, value = part.partition("=")
+            key = key.strip()
+            if key in ("fsync_fail_every", "torn_at", "enospc_after"):
+                setattr(spec, key, int(value))
+            else:
+                raise ValueError(f"unknown storage fault knob: {key!r}")
+        return spec
+
+    def __bool__(self) -> bool:
+        return bool(self.fsync_fail_every or self.torn_at or self.enospc_after)
+
+    def _injected(self, fault: str) -> None:
+        metrics.count(metrics.INGEST_FAULTS_INJECTED, fault=fault)
+        events.record(events.INGEST_FAULT, fault=fault)
+
+    def write(self, f, rec: bytes) -> None:
+        """Append ``rec`` under the fault schedule; raises OSError on an
+        injected failure (a torn write lands its prefix first)."""
+        with self._mu:
+            self._appends += 1
+            n_appends = self._appends
+            start = self._bytes
+            self._bytes += len(rec)
+            tear = (
+                self.torn_at
+                and not self._torn_done
+                and start < self.torn_at < start + len(rec)
+            )
+            if tear:
+                self._torn_done = True
+        if self.enospc_after and n_appends > self.enospc_after:
+            self._injected("enospc")
+            raise OSError(28, "No space left on device (injected)")
+        if tear:
+            f.write(rec[: self.torn_at - start])
+            f.flush()
+            os.fsync(f.fileno())  # the torn prefix really lands
+            self._injected("torn_write")
+            raise OSError(5, f"torn write at byte {self.torn_at} (injected)")
+        f.write(rec)
+
+    def fsync(self, fd: int) -> None:
+        with self._mu:
+            self._fsyncs += 1
+            fail = (
+                self.fsync_fail_every
+                and self._fsyncs % self.fsync_fail_every == 0
+            )
+        if fail:
+            self._injected("fsync_fail")
+            raise OSError(5, "fsync failed (injected)")
+        os.fsync(fd)
+
+
+# Process-wide injected fault schedule (None = clean). Installed by the
+# server from the `storage-faults` config knob; tests install directly.
+FAULTS: Optional[StorageFaultSpec] = None
+
+
+def install_storage_faults(text: str = "") -> None:
+    """Parse and install the process-wide storage fault schedule; an
+    empty spec (or empty text) clears it."""
+    global FAULTS
+    text = text or os.environ.get(STORAGE_FAULTS_ENV, "")
+    spec = StorageFaultSpec.parse(text)
+    FAULTS = spec if spec else None
 
 
 def pos(row_id: int, column_id: int) -> int:
@@ -122,6 +251,7 @@ class Fragment:
         # .generation) desyncs it and deltas_since answers None until
         # the next tracked mutation re-anchors the log.
         self.delta_log_max = DELTA_LOG_MAX
+        self.delta_max_batch = DELTA_MAX_BATCH
         self._delta_log: deque[tuple[int, int, bool]] = deque()
         self._delta_floor = 0
         self._delta_synced = 0
@@ -169,11 +299,54 @@ class Fragment:
         views over the map, payloads decode on demand, the op-log tail
         replays into the overlay (reference openStorage,
         fragment.go:167-224). The mmap stays alive for as long as the
-        storage references it (numpy buffer export); no explicit close."""
+        storage references it (numpy buffer export); no explicit close.
+
+        Crash recovery runs FIRST: a torn op-log tail (a record cut by
+        SIGKILL or a torn sector write) is truncated to the last fully
+        valid record before the map is created, so every acknowledged
+        (fsynced) write replays and un-acked partials vanish instead of
+        failing the open."""
+        if os.path.getsize(self.path) == 0:
+            return
+        self._recover_storage_tail()
         if os.path.getsize(self.path) == 0:
             return
         self.storage = Bitmap.open_mmap_file(self.path)
         self.op_n = self.storage.op_n
+
+    def _recover_storage_tail(self) -> None:
+        """Validate the length-framed, checksummed op-log tail and
+        truncate anything past the last intact record. The snapshot
+        prefix is written atomically (tmp + fsync + rename), so only
+        the append-only tail can tear; a file too short to hold even
+        the snapshot header can hold no acknowledged op and resets to
+        empty. The scan maps the file read-only and closes the map
+        before truncating — no live views reference it."""
+        size = os.path.getsize(self.path)
+        if size < bitmap_mod.HEADER_BASE_SIZE:
+            valid_end, n_ops = 0, 0
+        else:
+            with open(self.path, "rb") as f:
+                mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+                try:
+                    ops_off = bitmap_mod.ops_offset_of(mm)
+                    valid_end, n_ops = bitmap_mod.scan_op_log(mm, ops_off)
+                finally:
+                    mm.close()
+        if valid_end >= size:
+            return
+        truncated = size - valid_end
+        os.truncate(self.path, valid_end)
+        metrics.count(metrics.INGEST_RECOVERY_REPLAYS)
+        metrics.count(metrics.INGEST_RECOVERY_TRUNCATED_BYTES, truncated)
+        events.record(
+            events.INGEST_RECOVERY,
+            index=self.index,
+            field=self.field,
+            shard=self.shard,
+            truncated_bytes=truncated,
+            replayed_ops=n_ops,
+        )
 
     def close(self) -> None:
         with self.mu:
@@ -334,6 +507,121 @@ class Fragment:
         if self.op_n > self.max_op_n:
             self.snapshot()
 
+    # -- group-committed write waves (server/ingest.py) ----------------------
+
+    def apply_bit_batch(self, row_ids, column_ids, is_set=None) -> int:
+        """Apply many single-bit mutations as ONE durable write wave:
+        every changed bit lands in a single length-framed, checksummed
+        OP_BATCH append followed by ONE fsync (group commit), the
+        device-delta log gains the whole wave under ONE generation bump
+        (one plan-cache invalidation, one stager scatter), and each
+        touched row recounts once. ``is_set`` defaults to all-True.
+        Returns the number of bits that actually changed. Raises
+        OSError when the append or fsync fails (real or injected) —
+        the caller must NOT acknowledge the wave."""
+        rows = np.asarray(_sized(row_ids), dtype=np.uint64)
+        cols = np.asarray(_sized(column_ids), dtype=np.uint64)
+        if is_set is None:
+            sets = np.ones(rows.size, dtype=bool)
+        else:
+            sets = np.asarray(_sized(is_set), dtype=bool)
+        if rows.size != cols.size or rows.size != sets.size:
+            raise ValueError("row/column/is_set length mismatch")
+        if rows.size == 0:
+            return 0
+        with self.mu:
+            pairs = [
+                (self._check_pos(r, c), bool(s), int(r))
+                for r, c, s in zip(rows.tolist(), cols.tolist(), sets.tolist())
+            ]
+            return self._apply_op_wave(pairs)
+
+    def _apply_op_wave(self, pairs: list[tuple[int, bool, int]]) -> int:
+        """Apply (position, is_set, row_id) mutations in arrival order
+        as one group-committed wave. Called with mu held. In-memory
+        state mutates before the append — a failed append nacks the
+        wave but its bits MAY still persist via a later snapshot; the
+        durability contract only promises that ACKED waves survive."""
+        ops: list[tuple[int, int]] = []
+        deltas: list[tuple[int, bool]] = []
+        touched: set[int] = set()
+        for p, s, r in pairs:
+            changed = (
+                self.storage.add_no_oplog(p)
+                if s
+                else self.storage.remove_no_oplog(p)
+            )
+            if changed:
+                ops.append((bitmap_mod.OP_ADD if s else bitmap_mod.OP_REMOVE, p))
+                deltas.append((p, s))
+                touched.add(r)
+        if not ops:
+            return 0
+        self.generation += 1
+        self._delta_extend(deltas)
+        try:
+            self._append_op_batch(ops)
+        finally:
+            # bits are already applied: caches must track the new state
+            # even when the append fails and the wave is nacked
+            for r in touched:
+                self._row_cache.pop(r, None)
+                self.checksums.pop(r // HASH_BLOCK_SIZE, None)
+            counts = self.row_counts_for(
+                np.fromiter(touched, dtype=np.uint64, count=len(touched))
+            )
+            for row_id, cnt in zip(touched, counts):
+                # drop first: bulk_add's threshold guard would keep a
+                # stale higher count for rows the wave cleared
+                self.cache.remove(row_id)
+                if cnt > 0:
+                    self.cache.bulk_add(row_id, int(cnt))
+            self.cache.invalidate()
+            top = max(touched)
+            if top > self.max_row_id:
+                self.max_row_id = top
+            self.op_n += len(ops)
+            self.storage.op_n += len(ops)
+            if self.op_n > self.max_op_n:
+                self.snapshot()
+        return len(ops)
+
+    def _append_op_batch(self, ops: list[tuple[int, int]]) -> None:
+        """One OP_BATCH append + ONE fsync for the whole wave — the
+        group commit. Storage faults (if installed) inject here.
+
+        A torn append leaves a partial record at the tail; LATER
+        appends must not land behind it (the recovery scan stops at
+        the first invalid record, which would strand every acked wave
+        after the tear). So on a write failure the log invariant is
+        restored in-place: truncate back to the pre-append offset
+        before re-raising the nack."""
+        f = self._op_file
+        if f is None:
+            return
+        rec = bitmap_mod.marshal_op_batch(ops)
+        spec = FAULTS
+        start = f.tell()
+        try:
+            if spec is not None:
+                spec.write(f, rec)
+            else:
+                f.write(rec)
+        except BaseException:
+            try:
+                f.flush()
+            except OSError:
+                pass  # repair below drops whatever couldn't land anyway
+            os.truncate(self.path, start)
+            raise
+        f.flush()
+        t0 = time.monotonic()
+        if spec is not None:
+            spec.fsync(f.fileno())
+        else:
+            os.fsync(f.fileno())
+        metrics.observe(metrics.INGEST_FSYNC_SECONDS, time.monotonic() - t0)
+
     # -- device-delta log (snapshot + delta staging model) -------------------
 
     def _delta_append(self, p: int, is_set: bool) -> None:
@@ -348,6 +636,29 @@ class Fragment:
         self._delta_log.append((self.generation, p, is_set))
         self._delta_synced = self.generation
         if len(self._delta_log) > self.delta_log_max:
+            dropped_gen, _, _ = self._delta_log.popleft()
+            self._delta_floor = dropped_gen
+
+    def _delta_extend(self, entries: list[tuple[int, bool]]) -> None:
+        """Batch form of :meth:`_delta_append`: the whole write wave
+        lands under ONE generation — the plan cache invalidates once
+        and the stager absorbs the wave as one coalesced scatter.
+        Called with mu held, AFTER the single generation bump."""
+        if self.generation != self._delta_synced + 1:
+            self._delta_log.clear()
+            self._delta_floor = self.generation - 1
+        self._delta_synced = self.generation
+        if len(entries) >= self.delta_log_max:
+            # the wave alone overflows the log: snapshots staged at any
+            # earlier generation full-rebuild, ones at THIS generation
+            # (staged after the wave) replay nothing — both provable
+            self._delta_log.clear()
+            self._delta_floor = self.generation
+            return
+        g = self.generation
+        for p, s in entries:
+            self._delta_log.append((g, p, s))
+        while len(self._delta_log) > self.delta_log_max:
             dropped_gen, _, _ = self._delta_log.popleft()
             self._delta_floor = dropped_gen
 
@@ -669,6 +980,19 @@ class Fragment:
                 cols % np.uint64(SHARD_WIDTH)
             )
             positions = np.unique(positions)
+            if positions.size <= self.delta_max_batch:
+                # small batch: the delta path (one group-commit append,
+                # one generation bump, one device scatter) — routing it
+                # through merge+snapshot would `_delta_reset()` and
+                # force every staged block to full-rebuild (the
+                # bulk-import cliff)
+                self._apply_op_wave(
+                    [
+                        (int(p), True, int(p // np.uint64(SHARD_WIDTH)))
+                        for p in positions
+                    ]
+                )
+                return
             self.storage.merge_positions(add=positions)
             self.generation += 1
             self._delta_reset()  # bulk rewrite: staged snapshots rebuild
@@ -818,6 +1142,22 @@ class Fragment:
     def import_block_pairs(self, rows: np.ndarray, cols: np.ndarray, clear_rows=None, clear_cols=None) -> None:
         """Apply an anti-entropy block merge: set the given pairs, clear others."""
         with self.mu:
+            n_pairs = len(rows) + (len(clear_rows) if clear_rows is not None else 0)
+            if 0 < n_pairs <= self.delta_max_batch:
+                # small merge: delta path — clears before sets, so a
+                # pair in both ends set (same order as the loop below)
+                wave: list[tuple[int, bool, int]] = []
+                if clear_rows is not None and len(clear_rows):
+                    wave += [
+                        (pos(int(r), int(c)), False, int(r))
+                        for r, c in zip(clear_rows, clear_cols)
+                    ]
+                wave += [
+                    (pos(int(r), int(c)), True, int(r))
+                    for r, c in zip(rows, cols)
+                ]
+                self._apply_op_wave(wave)
+                return
             if clear_rows is not None and len(clear_rows):
                 for r, c in zip(clear_rows, clear_cols):
                     p = pos(int(r), int(c))

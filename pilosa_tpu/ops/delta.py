@@ -60,6 +60,31 @@ def coalesce_bit_updates(
     return uniq_words.astype(np.int32), or_mask, andnot_mask
 
 
+def coalesce_position_updates(
+    positions: np.ndarray, is_set: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Wave form of ``coalesce_bit_updates``: positions are flat
+    fragment bit positions (row * SHARD_WIDTH + col), the coordinate an
+    ingest write wave carries, rather than pre-split (word, bit)
+    pairs. One coalesce per wave regardless of how many rows it
+    touches."""
+    pos = np.asarray(positions, dtype=np.int64)
+    return coalesce_bit_updates(
+        pos >> 5, (pos & 31).astype(np.int64), np.asarray(is_set, dtype=bool)
+    )
+
+
+def apply_position_wave(words, positions, is_set):
+    """One coalesced multi-bit device scatter for a whole write wave:
+    coalesce + pad + jit scatter in a single call against a staged
+    block of any shape. The pow2 padding keeps wave sizes from minting
+    new compile-cache entries per wave."""
+    idx, or_mask, andnot_mask = coalesce_position_updates(positions, is_set)
+    total_words = int(np.prod(words.shape))
+    idx, or_mask, andnot_mask = pad_updates(idx, or_mask, andnot_mask, total_words)
+    return apply_word_updates(words, idx, or_mask, andnot_mask)
+
+
 def _next_pow2(n: int) -> int:
     p = 1
     while p < n:

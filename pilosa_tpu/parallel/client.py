@@ -220,6 +220,23 @@ class InternalClient:
             ),
         )
 
+    def ingest(self, uri, index, field, row_ids, column_ids, sets=None):
+        """Owner-side ingest leg: the remote node's write-ahead queue
+        group-commits the batch and acks only after its fsync, so a
+        2xx here carries the same durability contract as a local ack."""
+        body = {"rowIDs": list(row_ids), "columnIDs": list(column_ids)}
+        if sets is not None:
+            body["sets"] = [bool(s) for s in sets]
+        self._with_retry(
+            "ingest",
+            lambda: self._request(
+                "POST",
+                uri,
+                f"/index/{index}/field/{field}/ingest",
+                body=json.dumps(body).encode(),
+            ),
+        )
+
     def import_values_local(self, uri, index, field, column_ids, values):
         body = {"columnIDs": list(column_ids), "values": list(values), "local": True}
         self._with_retry(

@@ -79,6 +79,10 @@ def wire(server) -> None:
     cluster.local_executor = local_executor
 
     def replicate(uri: str, kind: int, payload: dict, epoch: int) -> None:
+        # kind-agnostic: queries, imports, and coalesced ingest write
+        # waves (KIND_WRITE_WAVE) all cross as one epoch-fenced frame;
+        # waves committed while a follower is fenced reach it later
+        # through the rejoin anti-entropy catch-up below
         cluster.client.gang_apply(uri, kind, payload, epoch)
 
     mh.replicate_fn = replicate

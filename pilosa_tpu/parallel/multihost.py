@@ -113,6 +113,7 @@ KIND_QUERY = 2  # PQL query replay (reads and writes)
 KIND_IMPORT = 3  # import_bits replay
 KIND_IMPORT_VALUES = 4  # import_values replay
 KIND_MESSAGE = 5  # server broadcast message (schema ops, create-shard, ...)
+KIND_WRITE_WAVE = 6  # coalesced ingest write wave — one frame per wave, not per bit
 
 _MAGIC = 0xA5
 # frame = [magic u8][kind u8][seq u16][total u16][len u32] + payload chunk
@@ -1372,6 +1373,19 @@ def make_apply_fn(server) -> Callable[[int, dict], Any]:
                     payload["values"],
                     payload.get("column_keys"),
                 )
+            return None
+        if kind == KIND_WRITE_WAVE:
+            # coalesced ingest write wave: shard groups were routed by
+            # the cluster plane (if any) before the gang saw the wave,
+            # so every rank applies the local leg as-is — one group
+            # commit + one generation bump per touched fragment
+            server.api.apply_write_wave_local(
+                payload["index"],
+                payload["field"],
+                payload["row_ids"],
+                payload["column_ids"],
+                payload.get("sets"),
+            )
             return None
         if kind == KIND_MESSAGE:
             server.receive_message(payload)

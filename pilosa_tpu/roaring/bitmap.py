@@ -799,13 +799,13 @@ class Bitmap:
         off = ops_offset
         total = len(buf)
         while off < total:
-            op_typ, value = unmarshal_op(mv[off : off + OP_SIZE])
-            if op_typ == OP_ADD:
-                b.add_no_oplog(value)
-            else:
-                b.remove_no_oplog(value)
-            b.op_n += 1
-            off += OP_SIZE
+            ops, off = read_op_record(mv, off)
+            for op_typ, value in ops:
+                if op_typ == OP_ADD:
+                    b.add_no_oplog(value)
+                else:
+                    b.remove_no_oplog(value)
+                b.op_n += 1
         return b
 
     def is_mmap_backed(self) -> bool:
@@ -923,15 +923,15 @@ class Bitmap:
                 raise ValueError(f"unknown container type {typ}")
             self.containers[key] = c
         # Replay trailing op log.
-        buf = data[ops_offset:]
-        while buf:
-            op_typ, value = unmarshal_op(buf)
-            if op_typ == OP_ADD:
-                self.add_no_oplog(value)
-            else:
-                self.remove_no_oplog(value)
-            self.op_n += 1
-            buf = buf[OP_SIZE:]
+        off = ops_offset
+        while off < len(data):
+            ops, off = read_op_record(data, off)
+            for op_typ, value in ops:
+                if op_typ == OP_ADD:
+                    self.add_no_oplog(value)
+                else:
+                    self.remove_no_oplog(value)
+                self.op_n += 1
 
     # -- op log --
 
@@ -946,7 +946,13 @@ class Bitmap:
 
 OP_ADD = 0
 OP_REMOVE = 1
+OP_BATCH = 2  # group-commit record: many add/remove ops, one checksum
 OP_SIZE = 1 + 8 + 4
+# batch record layout: typ u8 + count u32, then count x (op u8 + value
+# u64), then one fnv32a u32 over header+payload — length-framed by the
+# count, so a torn tail is detected by bounds before the checksum runs
+OP_BATCH_HEADER_SIZE = 1 + 4
+OP_BATCH_ENTRY_SIZE = 1 + 8
 
 
 def _fnv32a(data: bytes) -> int:
@@ -973,6 +979,122 @@ def unmarshal_op(data: bytes) -> tuple[int, int]:
     if typ not in (OP_ADD, OP_REMOVE):
         raise ValueError(f"invalid op type: {typ}")
     return typ, value
+
+
+def marshal_op_batch(ops) -> bytes:
+    """One length-framed, checksummed group-commit record for a whole
+    write wave: N ops land with ONE checksum and (caller-side) ONE
+    fsync, instead of N x 13-byte singles."""
+    body = bytearray(struct.pack("<BI", OP_BATCH, len(ops)))
+    for typ, value in ops:
+        if typ not in (OP_ADD, OP_REMOVE):
+            raise ValueError(f"invalid op type in batch: {typ}")
+        body += struct.pack("<BQ", typ, value)
+    return bytes(body) + struct.pack("<I", _fnv32a(bytes(body)))
+
+
+def read_op_record(buf, off: int = 0) -> tuple[list[tuple[int, int]], int]:
+    """Parse ONE op-log record (single op or batch) at ``buf[off:]``.
+    Returns ``(ops, next_off)`` with ops as [(typ, value), ...]; raises
+    ValueError on a truncated, corrupt, or unknown-typed record —
+    the torn-tail signal recovery keys on."""
+    total = len(buf)
+    if off >= total:
+        raise ValueError("op data out of bounds: empty")
+    typ = buf[off]
+    if typ in (OP_ADD, OP_REMOVE):
+        t, v = unmarshal_op(bytes(buf[off : off + OP_SIZE]))
+        return [(t, v)], off + OP_SIZE
+    if typ == OP_BATCH:
+        if off + OP_BATCH_HEADER_SIZE > total:
+            raise ValueError("op batch header out of bounds")
+        count = struct.unpack_from("<I", buf, off + 1)[0]
+        size = OP_BATCH_HEADER_SIZE + count * OP_BATCH_ENTRY_SIZE
+        if off + size + 4 > total:
+            raise ValueError(
+                f"op batch out of bounds: need {size + 4}, have {total - off}"
+            )
+        body = bytes(buf[off : off + size])
+        chk = struct.unpack_from("<I", buf, off + size)[0]
+        want = _fnv32a(body)
+        if chk != want:
+            raise ValueError(
+                f"batch checksum mismatch: exp={want:08x}, got={chk:08x}"
+            )
+        ops = []
+        p = OP_BATCH_HEADER_SIZE
+        for _ in range(count):
+            t, v = struct.unpack_from("<BQ", body, p)
+            if t not in (OP_ADD, OP_REMOVE):
+                raise ValueError(f"invalid op type in batch: {t}")
+            ops.append((t, v))
+            p += OP_BATCH_ENTRY_SIZE
+        return ops, off + size + 4
+    raise ValueError(f"invalid op type: {typ}")
+
+
+def ops_offset_of(data) -> int:
+    """Offset where the trailing op log begins, computed from the
+    header, meta, and offset tables alone (plus one 2-byte run-count
+    read for a trailing run container) — no payload decode, so the
+    crash-recovery scan can bound the snapshot prefix before anything
+    mmaps the file."""
+    if len(data) < HEADER_BASE_SIZE:
+        raise ValueError("data too small")
+    file_magic = struct.unpack_from("<H", data, 0)[0]
+    file_version = struct.unpack_from("<H", data, 2)[0]
+    if file_magic != MAGIC_NUMBER:
+        raise ValueError(f"invalid roaring file, magic number {file_magic}")
+    if file_version != STORAGE_VERSION:
+        raise ValueError(f"wrong roaring version {file_version}")
+    key_n = struct.unpack_from("<I", data, 4)[0]
+    tables_end = HEADER_BASE_SIZE + key_n * (12 + 4)
+    if tables_end > len(data):
+        raise ValueError("container tables out of bounds")
+    if key_n == 0:
+        return HEADER_BASE_SIZE
+    # offsets are written ascending (write_to), so the LAST container's
+    # end is the op-log start
+    _, typ, n_minus_1 = struct.unpack_from(
+        "<QHH", data, HEADER_BASE_SIZE + (key_n - 1) * 12
+    )
+    c_off = struct.unpack_from(
+        "<I", data, HEADER_BASE_SIZE + key_n * 12 + (key_n - 1) * 4
+    )[0]
+    if typ == CONTAINER_RUN:
+        if c_off + RUN_COUNT_HEADER_SIZE > len(data):
+            raise ValueError("run container out of bounds")
+        run_count = struct.unpack_from("<H", data, c_off)[0]
+        end = c_off + RUN_COUNT_HEADER_SIZE + run_count * INTERVAL16_SIZE
+    elif typ == CONTAINER_ARRAY:
+        end = c_off + 2 * (n_minus_1 + 1)
+    elif typ == CONTAINER_BITMAP:
+        end = c_off + 8 * BITMAP_N
+    else:
+        raise ValueError(f"unknown container type {typ}")
+    if end > len(data):
+        raise ValueError("container payload out of bounds")
+    return end
+
+
+def scan_op_log(data, ops_offset: int) -> tuple[int, int]:
+    """Walk the op-log tail record by record, validating length framing
+    and checksums. Returns ``(valid_end, n_ops)`` — the byte offset
+    just past the last fully valid record and the op count it holds.
+    A torn or corrupt tail stops the scan instead of raising: callers
+    truncate the file to valid_end and every acknowledged (fsynced)
+    record before the tear survives."""
+    off = ops_offset
+    n_ops = 0
+    total = len(data)
+    while off < total:
+        try:
+            ops, nxt = read_op_record(data, off)
+        except ValueError:
+            break
+        off = nxt
+        n_ops += len(ops)
+    return off, n_ops
 
 
 # -- container pair ops ------------------------------------------------------

@@ -376,6 +376,89 @@ class API:
                         node.uri, index, field, rows, cols, tss
                     )
 
+    # -- ingest write waves (server/ingest.py group commit) --
+
+    def apply_write_wave(
+        self, index: str, field: str, row_ids, column_ids, sets=None
+    ) -> int:
+        """Apply one coalesced ingest write wave: sets AND clears in a
+        single batch, one op-log group commit + fsync and one
+        generation bump per touched fragment, one KIND_WRITE_WAVE gang
+        frame. Returns the number of bits that changed (or the wave
+        size when the gang replays it — follower counts aren't
+        collected). In a multi-node cluster, shard groups route to
+        their owners first; a remote owner acks only after its own
+        ingest queue group-commits, so durability is owner-side."""
+        self._validate("import")
+        if self.cluster is not None and len(self.cluster.nodes) > 1:
+            groups: dict[int, list[int]] = {}
+            for i, col in enumerate(column_ids):
+                groups.setdefault(int(col) // SHARD_WIDTH, []).append(i)
+            flags = sets if sets is not None else [True] * len(column_ids)
+            total = 0
+            for shard, idxs in sorted(groups.items()):
+                rows = [int(row_ids[i]) for i in idxs]
+                cols = [int(column_ids[i]) for i in idxs]
+                ss = [bool(flags[i]) for i in idxs]
+                for node in self.cluster.shard_nodes(index, shard):
+                    if node.id == self.cluster.node_id:
+                        total += self.apply_write_wave_local(
+                            index, field, rows, cols, ss
+                        )
+                    else:
+                        self.cluster.client.ingest(
+                            node.uri, index, field, rows, cols, ss
+                        )
+                        total += len(rows)
+            return total
+        return self.apply_write_wave_local(index, field, row_ids, column_ids, sets)
+
+    def apply_write_wave_local(
+        self, index: str, field: str, row_ids, column_ids, sets=None
+    ) -> int:
+        """Owner-side wave leg: on a gang leader the wave crosses the
+        collective plane as ONE replayed frame (vs one broadcast per
+        bit on the interactive path); every rank then applies the
+        identical batch below."""
+        mh = getattr(self.server, "multihost", None) if self.server else None
+        # dispatch flag mirrors _gang_import: a federated gang replays
+        # only local legs (pass local=True), a single-plane gang owns
+        # the top-level wave (local=False)
+        if mh is not None and mh.should_dispatch_import(mh.federated):
+            from pilosa_tpu.parallel.multihost import Descriptor, KIND_WRITE_WAVE
+
+            mh.dispatch(
+                Descriptor(
+                    KIND_WRITE_WAVE,
+                    {
+                        "index": index,
+                        "field": field,
+                        "row_ids": [int(r) for r in row_ids],
+                        "column_ids": [int(c) for c in column_ids],
+                        "sets": [bool(s) for s in sets] if sets is not None else None,
+                    },
+                ),
+                deadline=deadline.current(),
+            )
+            return len(row_ids)
+        f = self.holder.field(index, field)
+        if f is None:
+            raise NotFoundError(f"field not found: {field}")
+        flags = sets if sets is not None else [True] * len(row_ids)
+        groups: dict[int, list[int]] = {}
+        for i, col in enumerate(column_ids):
+            groups.setdefault(int(col) // SHARD_WIDTH, []).append(i)
+        v = f.create_view_if_not_exists(VIEW_STANDARD)
+        changed = 0
+        for shard, idxs in sorted(groups.items()):
+            frag = v.create_fragment_if_not_exists(shard)
+            changed += frag.apply_bit_batch(
+                [int(row_ids[i]) for i in idxs],
+                [int(column_ids[i]) for i in idxs],
+                [bool(flags[i]) for i in idxs],
+            )
+        return changed
+
     def import_values(
         self,
         index: str,

@@ -181,6 +181,29 @@ class Config:
     # graceful-drain budget at shutdown: queued + in-flight work gets
     # this long to complete before being failed 503
     pipeline_drain_timeout: float = 10.0
+    # durable streaming ingest (server/ingest.py): bounded write-ahead
+    # queue coalescing mutations into group-committed write waves (one
+    # fsync + one generation bump + one gang frame per wave). Acked
+    # writes survive SIGKILL; queue overflow sheds 429 + Retry-After.
+    ingest_enabled: bool = True
+    # max pending mutations (bits, not requests) before submits shed
+    ingest_queue_limit: int = 8192
+    # max mutations coalesced into one write wave
+    ingest_wave_max: int = 2048
+    # coalesce window (seconds) the committer waits before sealing a
+    # wave — bounds write-visibility staleness alongside commit latency
+    ingest_wave_interval: float = 0.002
+    # Retry-After seconds on an ingest queue-full 429
+    ingest_retry_after: float = 0.25
+    # bulk-import cliff threshold: import_block_pairs / bulk_import
+    # batches at or under this many bits apply through the batched
+    # delta path (one generation bump, delta log extended) instead of
+    # resetting the delta log and forcing a full re-stage
+    ingest_delta_max_batch: int = 512
+    # storage fault injection (tests/dryruns only, core/fragment.py):
+    # "fsync_fail_every=N,torn_at=N,enospc_after=N" — see
+    # fragment.StorageFaultSpec; "" disables
+    storage_faults: str = ""
     # continuous-batching dispatch engine (executor/dispatch.py): the
     # async executor↔device boundary. Callers submit futures; a
     # persistent loop admits queued queries into in-flight waves grouped
@@ -296,6 +319,13 @@ class Config:
             f"pipeline-batch-max = {self.pipeline_batch_max}",
             f"pipeline-default-timeout = {self.pipeline_default_timeout}",
             f"pipeline-drain-timeout = {self.pipeline_drain_timeout}",
+            f"ingest-enabled = {'true' if self.ingest_enabled else 'false'}",
+            f"ingest-queue-limit = {self.ingest_queue_limit}",
+            f"ingest-wave-max = {self.ingest_wave_max}",
+            f"ingest-wave-interval = {self.ingest_wave_interval}",
+            f"ingest-retry-after = {self.ingest_retry_after}",
+            f"ingest-delta-max-batch = {self.ingest_delta_max_batch}",
+            f'storage-faults = "{self.storage_faults}"',
             f"dispatch-enabled = {'true' if self.dispatch_enabled else 'false'}",
             f"dispatch-max-wave = {self.dispatch_max_wave}",
             f"dispatch-max-inflight = {self.dispatch_max_inflight}",

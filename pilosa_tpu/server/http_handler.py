@@ -98,6 +98,7 @@ class Handler:
         long_query_time: float = 0.0,
         pipeline=None,
         default_timeout: float = 0.0,
+        ingest=None,
     ) -> None:
         self.api = api
         self.logger = logger
@@ -107,6 +108,9 @@ class Handler:
         # (bare handlers in tests, pipeline-enabled = false)
         self.pipeline = pipeline
         self.default_timeout = default_timeout
+        # durable ingest queue (server/ingest.py); None = waves apply
+        # synchronously through the bulk class (ingest-enabled = false)
+        self.ingest = ingest
         a = api
         self.routes = [
             # public (reference handler.go:188-231)
@@ -145,6 +149,11 @@ class Handler:
                 "POST",
                 r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/import-value",
                 self.post_import_value,
+            ),
+            Route(
+                "POST",
+                r"/index/(?P<index>[^/]+)/field/(?P<field>[^/]+)/ingest",
+                self.post_ingest,
             ),
             Route(
                 "GET",
@@ -193,6 +202,7 @@ class Handler:
             ),
             Route("GET", r"/metrics", self.get_metrics),
             Route("GET", r"/debug/pipeline", self.get_debug_pipeline),
+            Route("GET", r"/debug/ingest", self.get_debug_ingest),
             Route("GET", r"/debug/dispatch", self.get_debug_dispatch),
             Route("GET", r"/debug/multihost", self.get_debug_multihost),
             Route("GET", r"/debug/plancache", self.get_debug_plancache),
@@ -415,6 +425,40 @@ class Handler:
             # empty ImportResponse message (reference handlePostImport)
             return RawResponse(b"", publicproto.CONTENT_TYPE)
         return {}
+
+    def post_ingest(self, req) -> dict:
+        """Durable streaming ingest (server/ingest.py): sets AND clears
+        in one batch; blocks until the batch's write wave is
+        group-committed (fsynced) — a 200 means the writes survive
+        SIGKILL. Queue overflow answers 429 + Retry-After."""
+        body = json.loads(req.body or b"{}")
+        rows = body.get("rowIDs", [])
+        cols = body.get("columnIDs", [])
+        sets = body.get("sets")
+        if self.ingest is not None:
+            # the queue is its own admission class — no pipeline leg
+            acked = self.ingest.submit(
+                req.params["index"], req.params["field"], rows, cols, sets
+            )
+            return {"acked": acked}
+        dl = deadline_mod.from_request(req.headers, req.query, self.default_timeout)
+        changed = self._submit(
+            CLASS_BULK,
+            lambda: self.api.apply_write_wave(
+                req.params["index"], req.params["field"], rows, cols, sets
+            ),
+            dl,
+        )
+        return {"acked": len(rows), "changed": changed}
+
+    def get_debug_ingest(self, req) -> dict:
+        """Ingest write-ahead queue snapshot: depth/limit, wave and
+        acked/shed counters, last wave size + commit latency."""
+        if self.ingest is None:
+            return {"enabled": False}
+        out = self.ingest.stats()
+        out["enabled"] = True
+        return out
 
     def post_import_value(self, req) -> dict:
         if req.is_proto:

@@ -156,6 +156,10 @@ class Server:
         from pilosa_tpu.core import fragment as fragment_mod
 
         fragment_mod.DELTA_LOG_MAX = self.config.stager_delta_log_max
+        # bulk-import cliff threshold + storage fault injection are
+        # process-wide for the same reason
+        fragment_mod.DELTA_MAX_BATCH = self.config.ingest_delta_max_batch
+        fragment_mod.install_storage_faults(self.config.storage_faults)
         # serving deployments get the device health gate: a wedged
         # accelerator (hung tunnel/PJRT call) degrades reads to the CPU
         # roaring path instead of hanging them, and a background probe
@@ -312,6 +316,20 @@ class Server:
                     self.executor.dispatch_engine is not None
                 ),
             )
+        # durable ingest queue (server/ingest.py): its own admission
+        # class beside interactive/bulk — bounded write-ahead queue,
+        # group-committed write waves, acks only after fsync
+        self.ingest = None
+        if self.config.ingest_enabled:
+            from pilosa_tpu.server.ingest import IngestQueue
+
+            self.ingest = IngestQueue(
+                self.api,
+                queue_limit=self.config.ingest_queue_limit,
+                wave_max=self.config.ingest_wave_max,
+                wave_interval=self.config.ingest_wave_interval,
+                retry_after=self.config.ingest_retry_after,
+            )
         self.handler = Handler(
             self.api,
             logger=self.logger,
@@ -319,6 +337,7 @@ class Server:
             long_query_time=self.config.cluster.long_query_time,
             pipeline=self.pipeline,
             default_timeout=self.config.pipeline_default_timeout,
+            ingest=self.ingest,
         )
         self.diagnostics = DiagnosticsCollector(
             host=getattr(self.config, "diagnostics_host", ""),
@@ -909,6 +928,11 @@ class Server:
 
     def close(self) -> None:
         self._closed.set()
+        # drain the ingest queue to durability first: every queued wave
+        # group-commits and its submitters ack before we take down the
+        # layers a wave needs (new submits answer 503)
+        if self.ingest is not None:
+            self.ingest.close()
         # graceful drain FIRST: stop admitting (new requests get 503),
         # complete queued + in-flight work within the drain budget, so
         # a restart loses nothing the server had accepted and could

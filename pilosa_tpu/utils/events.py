@@ -30,6 +30,13 @@ GANG_TRANSITION = "gang.transition"
 GANG_DEGRADE = "gang.degrade"
 GANG_REFORM = "gang.reform"
 CLIENT_RETRY_EXHAUSTED = "client.retry_exhausted"
+# durable streaming ingest (server/ingest.py + core/fragment.py):
+# write-wave group commits, queue-overflow sheds, crash-recovery
+# op-log truncation at fragment open, injected storage faults
+INGEST_WAVE = "ingest.wave"
+INGEST_SHED = "ingest.shed"
+INGEST_RECOVERY = "ingest.recovery"
+INGEST_FAULT = "ingest.fault"
 
 
 class EventJournal:

@@ -138,6 +138,16 @@ PIPELINE_BATCHES = "pipeline.batches"
 PIPELINE_BATCH_WIDTH = "pipeline.batch_width"
 PIPELINE_DEADLINE_EXPIRED = "pipeline.deadline_expired"
 PIPELINE_DRAIN_SECONDS = "pipeline.drain_seconds"
+# durable streaming ingest (server/ingest.py + core/fragment.py)
+INGEST_QUEUE_DEPTH = "ingest.queue_depth"
+INGEST_WAVE_SIZE = "ingest.wave_size"
+INGEST_WAVE_COMMIT_SECONDS = "ingest.wave_commit_seconds"
+INGEST_FSYNC_SECONDS = "ingest.fsync_seconds"
+INGEST_ACKED = "ingest.acked"
+INGEST_SHEDS = "ingest.sheds"
+INGEST_RECOVERY_REPLAYS = "ingest.recovery_replays"
+INGEST_RECOVERY_TRUNCATED_BYTES = "ingest.recovery_truncated_bytes"
+INGEST_FAULTS_INJECTED = "ingest.faults_injected"
 # async continuous-batching dispatch engine (executor/dispatch.py)
 DISPATCH_WAVE_SIZE = "dispatch.wave_size"
 DISPATCH_INFLIGHT_DEPTH = "dispatch.inflight_depth"
@@ -349,6 +359,46 @@ METRICS: dict[str, tuple[str, str]] = {
     PIPELINE_DRAIN_SECONDS: (
         "summary",
         "graceful-drain duration at shutdown",
+    ),
+    INGEST_QUEUE_DEPTH: (
+        "gauge",
+        "mutations queued in the write-ahead ingest queue awaiting a wave",
+    ),
+    INGEST_WAVE_SIZE: (
+        "summary",
+        "mutations coalesced per group-committed write wave",
+    ),
+    INGEST_WAVE_COMMIT_SECONDS: (
+        "summary",
+        "write-wave commit latency: dequeue through group-commit fsync "
+        "and gang replication — the write-ack latency submitters see",
+    ),
+    INGEST_FSYNC_SECONDS: (
+        "summary",
+        "fsync latency of one OP_BATCH group-commit append to a "
+        "fragment op log",
+    ),
+    INGEST_ACKED: (
+        "counter",
+        "mutations acknowledged durable (their wave's group commit "
+        "fsynced; acked writes survive SIGKILL)",
+    ),
+    INGEST_SHEDS: (
+        "counter",
+        "mutations shed 429 + Retry-After because the ingest queue was full",
+    ),
+    INGEST_RECOVERY_REPLAYS: (
+        "counter",
+        "fragment opens that truncated a torn op-log tail before replay",
+    ),
+    INGEST_RECOVERY_TRUNCATED_BYTES: (
+        "counter",
+        "bytes of torn/un-acked op-log tail truncated at fragment open",
+    ),
+    INGEST_FAULTS_INJECTED: (
+        "counter",
+        "storage faults injected by the storage-faults schedule "
+        "(label: fault = fsync_fail | torn_write | enospc)",
     ),
     DISPATCH_WAVE_SIZE: (
         "summary",

@@ -203,12 +203,28 @@ class TestExecutorReadWriteMix:
 
 
 class TestFallbacks:
-    def test_bulk_import_forces_full_restage(self, holder):
+    def test_small_bulk_import_rides_delta_path(self, holder):
+        # the bulk-import cliff fix: batches at or under
+        # ``delta_max_batch`` apply as one write wave (delta-extend +
+        # single generation bump), not a delta reset + full re-stage
         idx, f, frag = _seed_fragment(holder)
         st = DeviceStager()
         st.row(frag, 0)
         before = _delta_counters()
-        f.import_bits([0, 0], [17, 18])  # bulk path resets the delta log
+        f.import_bits([0, 0], [17, 18])
+        _assert_row_identical(st, frag, 0)
+        after = _delta_counters()
+        assert after["applied"] > before["applied"]
+        assert after["fallback"] == before["fallback"]
+
+    def test_large_bulk_import_forces_full_restage(self, holder):
+        idx, f, frag = _seed_fragment(holder)
+        frag.delta_max_batch = 4
+        st = DeviceStager()
+        st.row(frag, 0)
+        before = _delta_counters()
+        # over the wave threshold: bulk path resets the delta log
+        f.import_bits([0] * 8, list(range(17, 25)))
         _assert_row_identical(st, frag, 0)
         after = _delta_counters()
         assert after["invalidation"] == before["invalidation"] + 1
