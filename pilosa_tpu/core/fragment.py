@@ -259,6 +259,10 @@ class Fragment:
         self.mu = threading.RLock()
         self._row_cache: dict[int, Row] = {}
         self._op_file = None
+        # set when a failed append could not be repaired in-place: the
+        # tail is in an unknown state, so appends are refused until
+        # snapshot() rebuilds the file (fsyncgate-style containment)
+        self._op_log_dirty = False
         self._open = False
         # occupancy index cache keyed by generation (mmap stores cache
         # internally; dict stores would otherwise rebuild O(N log N)
@@ -518,7 +522,8 @@ class Fragment:
         touched row recounts once. ``is_set`` defaults to all-True.
         Returns the number of bits that actually changed. Raises
         OSError when the append or fsync fails (real or injected) —
-        the caller must NOT acknowledge the wave."""
+        the caller must NOT acknowledge the wave; the fragment is
+        left unmodified, so retrying the wave is safe."""
         rows = np.asarray(_sized(row_ids), dtype=np.uint64)
         cols = np.asarray(_sized(column_ids), dtype=np.uint64)
         if is_set is None:
@@ -538,66 +543,85 @@ class Fragment:
 
     def _apply_op_wave(self, pairs: list[tuple[int, bool, int]]) -> int:
         """Apply (position, is_set, row_id) mutations in arrival order
-        as one group-committed wave. Called with mu held. In-memory
-        state mutates before the append — a failed append nacks the
-        wave but its bits MAY still persist via a later snapshot; the
-        durability contract only promises that ACKED waves survive."""
+        as one group-committed wave. Called with mu held. Write-ahead
+        order: the wave's changed ops are computed against the current
+        bits, appended and fsynced FIRST, and only then applied in
+        memory — a failed append leaves the fragment untouched, so a
+        client retry of the nacked wave recomputes the identical ops
+        and re-appends them. (Without this, a retry after a failed
+        append would see every bit already set, log nothing, and get
+        acked with nothing in the fsynced log — losing the write on
+        the next crash.)"""
         ops: list[tuple[int, int]] = []
         deltas: list[tuple[int, bool]] = []
         touched: set[int] = set()
+        pending: dict[int, bool] = {}  # intra-wave state (clear-then-set pairs)
         for p, s, r in pairs:
-            changed = (
-                self.storage.add_no_oplog(p)
-                if s
-                else self.storage.remove_no_oplog(p)
-            )
-            if changed:
-                ops.append((bitmap_mod.OP_ADD if s else bitmap_mod.OP_REMOVE, p))
-                deltas.append((p, s))
-                touched.add(r)
+            cur = pending.get(p)
+            if cur is None:
+                cur = self.storage.contains(p)
+            if cur == s:
+                continue
+            pending[p] = s
+            ops.append((bitmap_mod.OP_ADD if s else bitmap_mod.OP_REMOVE, p))
+            deltas.append((p, s))
+            touched.add(r)
         if not ops:
             return 0
+        self._append_op_batch(ops)  # raises -> nothing mutated, clean nack
+        for op, p in ops:
+            if op == bitmap_mod.OP_ADD:
+                self.storage.add_no_oplog(p)
+            else:
+                self.storage.remove_no_oplog(p)
         self.generation += 1
         self._delta_extend(deltas)
-        try:
-            self._append_op_batch(ops)
-        finally:
-            # bits are already applied: caches must track the new state
-            # even when the append fails and the wave is nacked
-            for r in touched:
-                self._row_cache.pop(r, None)
-                self.checksums.pop(r // HASH_BLOCK_SIZE, None)
-            counts = self.row_counts_for(
-                np.fromiter(touched, dtype=np.uint64, count=len(touched))
-            )
-            for row_id, cnt in zip(touched, counts):
-                # drop first: bulk_add's threshold guard would keep a
-                # stale higher count for rows the wave cleared
-                self.cache.remove(row_id)
-                if cnt > 0:
-                    self.cache.bulk_add(row_id, int(cnt))
-            self.cache.invalidate()
-            top = max(touched)
-            if top > self.max_row_id:
-                self.max_row_id = top
-            self.op_n += len(ops)
-            self.storage.op_n += len(ops)
-            if self.op_n > self.max_op_n:
-                self.snapshot()
+        for r in touched:
+            self._row_cache.pop(r, None)
+            self.checksums.pop(r // HASH_BLOCK_SIZE, None)
+        counts = self.row_counts_for(
+            np.fromiter(touched, dtype=np.uint64, count=len(touched))
+        )
+        for row_id, cnt in zip(touched, counts):
+            # drop first: bulk_add's threshold guard would keep a
+            # stale higher count for rows the wave cleared
+            self.cache.remove(row_id)
+            if cnt > 0:
+                self.cache.bulk_add(row_id, int(cnt))
+        self.cache.invalidate()
+        top = max(touched)
+        if top > self.max_row_id:
+            self.max_row_id = top
+        self.op_n += len(ops)
+        self.storage.op_n += len(ops)
+        if self.op_n > self.max_op_n:
+            self.snapshot()
         return len(ops)
 
     def _append_op_batch(self, ops: list[tuple[int, int]]) -> None:
         """One OP_BATCH append + ONE fsync for the whole wave — the
         group commit. Storage faults (if installed) inject here.
 
-        A torn append leaves a partial record at the tail; LATER
-        appends must not land behind it (the recovery scan stops at
-        the first invalid record, which would strand every acked wave
-        after the tear). So on a write failure the log invariant is
+        A failed append leaves a partial or un-durable record at the
+        tail; LATER appends must not land behind it (the recovery
+        scan stops at the first invalid record, which would strand
+        every acked wave after it). So on ANY failure — write OR
+        fsync, since after a real fsync EIO the kernel may already
+        have discarded the dirty pages — the log invariant is
         restored in-place: truncate back to the pre-append offset
-        before re-raising the nack."""
+        before re-raising the nack. If the repair itself fails the
+        log is poisoned and the next wave rebuilds the whole file
+        via snapshot() before it may append."""
+        if self._op_log_dirty:
+            # fsyncgate aftermath: a failed repair left the tail in an
+            # unknown state. snapshot() rebuilds the file wholesale
+            # (atomic tmp + fsync + rename) and clears the flag; if it
+            # raises, this wave nacks and the log stays poisoned.
+            self.snapshot()
         f = self._op_file
         if f is None:
+            if self.path and self._open:
+                raise OSError(5, "fragment op log unavailable")
             return
         rec = bitmap_mod.marshal_op_batch(ops)
         spec = FAULTS
@@ -607,20 +631,45 @@ class Fragment:
                 spec.write(f, rec)
             else:
                 f.write(rec)
+            f.flush()
+            t0 = time.monotonic()
+            if spec is not None:
+                spec.fsync(f.fileno())
+            else:
+                os.fsync(f.fileno())
         except BaseException:
+            self._repair_op_log_tail(f, start)
+            raise
+        metrics.observe(metrics.INGEST_FSYNC_SECONDS, time.monotonic() - t0)
+
+    def _repair_op_log_tail(self, f, start: int) -> None:
+        """Drop whatever landed past the pre-append offset after a
+        failed wave append, then fsync the truncate so the repaired
+        tail is itself durable. Never raises: a repair failure (or a
+        flush that lost bytes BEFORE this wave's record, leaving an
+        unknowable tail) poisons the log instead, so no further
+        appends are admitted until snapshot() rebuilds the file."""
+        try:
             try:
                 f.flush()
             except OSError:
-                pass  # repair below drops whatever couldn't land anyway
-            os.truncate(self.path, start)
-            raise
-        f.flush()
-        t0 = time.monotonic()
-        if spec is not None:
-            spec.fsync(f.fileno())
-        else:
-            os.fsync(f.fileno())
-        metrics.observe(metrics.INGEST_FSYNC_SECONDS, time.monotonic() - t0)
+                pass  # the truncate below drops whatever couldn't land
+            size = os.path.getsize(self.path)
+            if size < start:
+                # bytes buffered before this wave never reached the
+                # file: the tail may end in a partial earlier record
+                # at an offset we cannot recover from f's buffer
+                self._op_log_dirty = True
+                return
+            if size > start:
+                os.truncate(self.path, start)
+                os.fsync(f.fileno())
+            # resync the buffered writer: tell() must report the real
+            # tail, or the NEXT failed wave would truncate to a stale
+            # larger offset and extend the file with a zero gap
+            f.seek(0, os.SEEK_END)
+        except BaseException:
+            self._op_log_dirty = True
 
     # -- device-delta log (snapshot + delta staging model) -------------------
 
@@ -1072,6 +1121,7 @@ class Fragment:
             if not self.path:
                 self.op_n = 0
                 self.storage.op_n = 0
+                self._op_log_dirty = False
                 return
             if self._op_file:
                 self._op_file.close()
@@ -1100,6 +1150,8 @@ class Fragment:
             self.storage.op_writer = self._op_file
             self.op_n = 0
             self.storage.op_n = 0
+            # the file was rebuilt wholesale: any poisoned tail is gone
+            self._op_log_dirty = False
 
     # -- block checksums for anti-entropy (reference Blocks:1078) ------------
 

@@ -221,13 +221,24 @@ class InternalClient:
         )
 
     def ingest(self, uri, index, field, row_ids, column_ids, sets=None):
-        """Owner-side ingest leg: the remote node's write-ahead queue
-        group-commits the batch and acks only after its fsync, so a
-        2xx here carries the same durability contract as a local ack."""
-        body = {"rowIDs": list(row_ids), "columnIDs": list(column_ids)}
+        """Owner-side ingest leg: the remote node group-commits the
+        batch (one fsynced op-log append per touched fragment) and
+        acks only after its fsync, so a 2xx here carries the same
+        durability contract as a local ack. The ``local`` marker keeps
+        the remote from re-routing the wave back through the cluster
+        (with replicas > 1 that ping-pong would deadlock the two
+        single-threaded committers against each other). A retry after
+        a failed commit is safe: a nacked wave leaves the remote
+        fragment unmodified, so the retry re-logs the identical ops.
+        Returns the remote's changed-bit count."""
+        body = {
+            "rowIDs": list(row_ids),
+            "columnIDs": list(column_ids),
+            "local": True,
+        }
         if sets is not None:
             body["sets"] = [bool(s) for s in sets]
-        self._with_retry(
+        resp = self._with_retry(
             "ingest",
             lambda: self._request(
                 "POST",
@@ -236,6 +247,7 @@ class InternalClient:
                 body=json.dumps(body).encode(),
             ),
         )
+        return int(resp.get("changed", len(body["rowIDs"])))
 
     def import_values_local(self, uri, index, field, column_ids, values):
         body = {"columnIDs": list(column_ids), "values": list(values), "local": True}

@@ -400,16 +400,26 @@ class API:
                 rows = [int(row_ids[i]) for i in idxs]
                 cols = [int(column_ids[i]) for i in idxs]
                 ss = [bool(flags[i]) for i in idxs]
+                # every replica applies the group, but it counts ONCE
+                # toward the wave total (replication factor > 1 must
+                # not inflate the acked/changed count); prefer the
+                # local replica's exact changed count when we hold one
+                local_changed = None
+                remote_changed = None
                 for node in self.cluster.shard_nodes(index, shard):
                     if node.id == self.cluster.node_id:
-                        total += self.apply_write_wave_local(
+                        local_changed = self.apply_write_wave_local(
                             index, field, rows, cols, ss
                         )
                     else:
-                        self.cluster.client.ingest(
+                        c = self.cluster.client.ingest(
                             node.uri, index, field, rows, cols, ss
                         )
-                        total += len(rows)
+                        remote_changed = max(remote_changed or 0, c)
+                if local_changed is not None:
+                    total += local_changed
+                elif remote_changed is not None:
+                    total += remote_changed
             return total
         return self.apply_write_wave_local(index, field, row_ids, column_ids, sets)
 

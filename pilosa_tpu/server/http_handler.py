@@ -430,18 +430,40 @@ class Handler:
         """Durable streaming ingest (server/ingest.py): sets AND clears
         in one batch; blocks until the batch's write wave is
         group-committed (fsynced) — a 200 means the writes survive
-        SIGKILL. Queue overflow answers 429 + Retry-After."""
+        SIGKILL. Queue overflow answers 429 + Retry-After; a wave that
+        cannot commit before the request deadline answers 504 (the
+        write's outcome is then indeterminate)."""
         body = json.loads(req.body or b"{}")
         rows = body.get("rowIDs", [])
         cols = body.get("columnIDs", [])
         sets = body.get("sets")
+        dl = deadline_mod.from_request(req.headers, req.query, self.default_timeout)
+        if body.get("local"):
+            # owner-side leg of a routed wave: apply directly (the
+            # leader already coalesced it; re-queueing would chain this
+            # node's committer behind the caller's) — the group commit
+            # below still fsyncs before the 200, so durability holds
+            changed = self._submit(
+                CLASS_INTERNAL,
+                lambda: self.api.apply_write_wave_local(
+                    req.params["index"], req.params["field"], rows, cols, sets
+                ),
+                dl,
+            )
+            return {"acked": len(rows), "changed": changed}
         if self.ingest is not None:
-            # the queue is its own admission class — no pipeline leg
+            # the queue is its own admission class — no pipeline leg,
+            # but the request deadline still bounds the commit wait (a
+            # stalled committer must not pin HTTP workers forever)
             acked = self.ingest.submit(
-                req.params["index"], req.params["field"], rows, cols, sets
+                req.params["index"],
+                req.params["field"],
+                rows,
+                cols,
+                sets,
+                deadline=dl,
             )
             return {"acked": acked}
-        dl = deadline_mod.from_request(req.headers, req.query, self.default_timeout)
         changed = self._submit(
             CLASS_BULK,
             lambda: self.api.apply_write_wave(

@@ -26,8 +26,10 @@ The ack contract: ``submit()`` returning means the mutation's wave was
 group-committed and fsynced — it survives SIGKILL (fragment ``open()``
 truncates any torn trailing record and replays the intact prefix, so
 every acknowledged write is recovered). A raised error means the wave
-was NOT acknowledged; its bits may still surface if a later snapshot
-persists the in-memory state, but only acked waves are guaranteed.
+was NOT acknowledged and left no in-memory mutation (the fragment logs
+before it applies), so retrying it is safe and re-logs the identical
+ops; a ``DeadlineExceeded`` is the one indeterminate outcome — the
+wave may still commit after the caller stopped waiting.
 
 Staleness is bounded by the coalesce window (``ingest-wave-interval``)
 plus one wave's commit latency — readers on this node see a wave the
@@ -41,6 +43,7 @@ import threading
 import time
 from typing import Optional
 
+from pilosa_tpu.server.deadline import DeadlineExceeded
 from pilosa_tpu.server.pipeline import Overloaded
 from pilosa_tpu.utils import events, metrics
 
@@ -98,12 +101,18 @@ class IngestQueue:
 
     # -- submitter side -----------------------------------------------------
 
-    def submit(self, index: str, field: str, row_ids, column_ids, sets=None) -> int:
+    def submit(
+        self, index: str, field: str, row_ids, column_ids, sets=None, deadline=None
+    ) -> int:
         """Enqueue mutations and block until their wave is durable
         (group commit fsynced + gang-dispatched). Returns the number of
         acknowledged mutations. Raises ``Overloaded`` (429) when the
         queue is full, (503) when draining; re-raises the wave's commit
-        error when the wave could not be made durable."""
+        error when the wave could not be made durable. ``deadline`` (a
+        ``server.deadline.Deadline``) bounds the wait: when the wave
+        has not committed in time, ``DeadlineExceeded`` (504) is raised
+        — the write's outcome is then INDETERMINATE (its wave may still
+        commit after the caller gave up), like any timed-out write."""
         rows = [int(r) for r in row_ids]
         cols = [int(c) for c in column_ids]
         if len(rows) != len(cols):
@@ -119,6 +128,8 @@ class IngestQueue:
         n = len(rows)
         b = _Batch(index, field, rows, cols, flags)
         with self._cv:
+            if deadline is not None and deadline.expired():
+                raise DeadlineExceeded("ingest-admission")
             if self._closed:
                 raise Overloaded("ingest queue draining", status=503)
             if self._depth + n > self.queue_limit:
@@ -135,7 +146,14 @@ class IngestQueue:
             self._depth += n
             metrics.gauge(metrics.INGEST_QUEUE_DEPTH, self._depth)
             self._cv.notify()
-        b.done.wait()
+        if deadline is None:
+            b.done.wait()
+        elif not b.done.wait(timeout=max(0.0, deadline.remaining())):
+            # the batch stays queued and its wave may still commit —
+            # the caller's 504 means "outcome unknown", not "nacked"
+            raise DeadlineExceeded(
+                "ingest-commit", "ingest wave did not commit before the deadline"
+            )
         if b.error is not None:
             raise b.error
         return n
@@ -161,55 +179,78 @@ class IngestQueue:
                     wave.append(b)
                     size += len(b.rows)
                 self._depth -= size
+            # NOTHING outside _commit_wave's own guards may kill this
+            # thread: a dead committer leaves every submitter blocked
+            # on done.wait() and wedges all future ingest. Unexpected
+            # errors nack the wave instead.
+            try:
                 metrics.gauge(metrics.INGEST_QUEUE_DEPTH, self._depth)
-            self._commit_wave(wave, size)
+                self._commit_wave(wave, size)
+            except BaseException as e:
+                for b in wave:
+                    if b.error is None:
+                        b.error = e
+                    b.done.set()
 
     def _commit_wave(self, wave: list[_Batch], size: int) -> None:
         t0 = time.monotonic()
-        # group by (index, field): one apply — one op-log group commit
-        # per touched fragment, one generation bump, one gang frame
-        groups: dict[tuple[str, str], list[_Batch]] = {}
-        for b in wave:
-            groups.setdefault((b.index, b.field), []).append(b)
-        acked = 0
-        failed = 0
-        for (index, field), batches in sorted(groups.items()):
-            rows: list[int] = []
-            cols: list[int] = []
-            flags: list[bool] = []
-            for b in batches:
-                rows += b.rows
-                cols += b.cols
-                flags += b.sets
-            try:
-                self.api.apply_write_wave(index, field, rows, cols, flags)
-            except BaseException as e:  # nack the group, keep committing
+        try:
+            # group by (index, field): one apply — one op-log group
+            # commit per touched fragment, one generation bump, one
+            # gang frame
+            groups: dict[tuple[str, str], list[_Batch]] = {}
+            for b in wave:
+                groups.setdefault((b.index, b.field), []).append(b)
+            acked = 0
+            failed = 0
+            for (index, field), batches in sorted(groups.items()):
+                rows: list[int] = []
+                cols: list[int] = []
+                flags: list[bool] = []
                 for b in batches:
+                    rows += b.rows
+                    cols += b.cols
+                    flags += b.sets
+                try:
+                    self.api.apply_write_wave(index, field, rows, cols, flags)
+                except BaseException as e:  # nack the group, keep committing
+                    for b in batches:
+                        b.error = e
+                    failed += len(rows)
+                else:
+                    acked += len(rows)
+            dt = time.monotonic() - t0
+            with self._mu:
+                self._waves += 1
+                self._acked += acked
+                self._nacked += failed
+                self._last_wave_size = size
+                self._last_commit_seconds = dt
+            metrics.observe(metrics.INGEST_WAVE_SIZE, size)
+            metrics.observe(metrics.INGEST_WAVE_COMMIT_SECONDS, dt)
+            if acked:
+                metrics.count(metrics.INGEST_ACKED, acked)
+            events.record(
+                events.INGEST_WAVE,
+                size=size,
+                groups=len(groups),
+                acked=acked,
+                nacked=failed,
+                seconds=round(dt, 6),
+            )
+        except BaseException as e:
+            # errors land BEFORE the finally wakes the waiters — a
+            # batch whose group never applied must not read as acked
+            for b in wave:
+                if b.error is None:
                     b.error = e
-                failed += len(rows)
-            else:
-                acked += len(rows)
-        dt = time.monotonic() - t0
-        with self._mu:
-            self._waves += 1
-            self._acked += acked
-            self._nacked += failed
-            self._last_wave_size = size
-            self._last_commit_seconds = dt
-        metrics.observe(metrics.INGEST_WAVE_SIZE, size)
-        metrics.observe(metrics.INGEST_WAVE_COMMIT_SECONDS, dt)
-        if acked:
-            metrics.count(metrics.INGEST_ACKED, acked)
-        events.record(
-            events.INGEST_WAVE,
-            size=size,
-            groups=len(groups),
-            acked=acked,
-            nacked=failed,
-            seconds=round(dt, 6),
-        )
-        for b in wave:
-            b.done.set()
+            raise
+        finally:
+            # submitters block on done.wait() with no other wake-up:
+            # every batch MUST resolve even when metrics/journal code
+            # above raises
+            for b in wave:
+                b.done.set()
 
     # -- lifecycle / introspection ------------------------------------------
 

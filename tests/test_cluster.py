@@ -180,6 +180,41 @@ class TestStaticCluster:
             for s in servers:
                 s.close()
 
+    def test_replicated_ingest_counts_once_and_terminates(self, tmp_path):
+        """Durable ingest with replicas=2: the wave applies on BOTH
+        replicas, the changed count counts each mutation once (not once
+        per replica), and the owner-side leg carries a ``local`` marker
+        so the replicas' single-threaded committers never route the
+        wave back at each other (a distributed deadlock)."""
+        servers = boot_static_cluster(tmp_path, n=2, replicas=2)
+        try:
+            s0 = servers[0]
+            req(s0.uri, "POST", "/index/i", {})
+            req(s0.uri, "POST", "/index/i/field/f", {})
+            cols = [s * SHARD_WIDTH + 7 for s in range(4)]
+            # HTTP queue path: must ack (not hang on a committer cycle)
+            st, body = req(
+                s0.uri,
+                "POST",
+                "/index/i/field/f/ingest",
+                {"rowIDs": [1] * 4, "columnIDs": cols},
+            )
+            assert st == 200 and body["acked"] == 4, body
+            # direct wave apply: 4 new bits change 4 bits, not 4×replicas
+            cols2 = [s * SHARD_WIDTH + 8 for s in range(4)]
+            assert s0.api.apply_write_wave("i", "f", [1] * 4, cols2) == 4
+            # and a fully-duplicate wave changes nothing on any replica
+            assert s0.api.apply_write_wave("i", "f", [1] * 4, cols2) == 0
+            # both replicas hold every bit
+            for s in servers:
+                v = s.holder.view("i", "f", "standard")
+                assert set(v.fragments) == set(range(4)), s.uri
+                st, body = req(s.uri, "POST", "/index/i/query", b"Row(f=1)")
+                assert body["results"][0]["columns"] == sorted(cols + cols2)
+        finally:
+            for s in servers:
+                s.close()
+
     def test_failover_to_replica(self, tmp_path):
         servers = boot_static_cluster(tmp_path, n=2, replicas=2)
         try:

@@ -10,6 +10,7 @@ replay of the intact prefix.
 
 import os
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -200,16 +201,39 @@ def test_torn_write_fault_nacks_wave_and_repairs_log(tmp_path):
     f2.close()
 
 
-def test_fsync_fault_nacks_but_bits_may_land(tmp_path):
+def test_fsync_fault_nacks_and_leaves_fragment_untouched(tmp_path):
     p = tmp_path / "frag"
     f = _frag(p)
+    size0 = os.path.getsize(p)
     fragment_mod.FAULTS = StorageFaultSpec(fsync_fail_every=1)
     with pytest.raises(OSError):
         f.apply_bit_batch([5], [50])
     fragment_mod.FAULTS = None
-    # the contract is one-way: a raised error means NOT acked (the
-    # record may still be in the file — durability is simply unproven)
+    # write-ahead order: the nacked wave mutated NOTHING in memory and
+    # the un-durable record was truncated back out of the tail
+    assert not f.bit(5, 50)
+    assert os.path.getsize(p) == size0
     f.close()
+
+
+def test_retry_after_failed_append_relogs_and_survives_crash(tmp_path):
+    """The lost-write regression: if a failed append left the bits set
+    in memory, the client's retry would see changed=False everywhere,
+    log nothing, and get ACKED with nothing in the fsynced log — gone
+    on the next crash. The retry must re-log the identical wave."""
+    p = tmp_path / "frag"
+    f = _frag(p)
+    fragment_mod.FAULTS = StorageFaultSpec(fsync_fail_every=1)
+    with pytest.raises(OSError):
+        f.apply_bit_batch([5, 6], [50, 60])
+    fragment_mod.FAULTS = None
+    # the retry of the nacked wave: must CHANGE (and therefore log) the
+    # same bits again, not no-op its way to a hollow ack
+    assert f.apply_bit_batch([5, 6], [50, 60]) == 2
+    f.close()
+    f2 = _frag(p)
+    assert f2.bit(5, 50) and f2.bit(6, 60)
+    f2.close()
 
 
 def test_enospc_fault(tmp_path):
@@ -345,6 +369,49 @@ def test_queue_commit_failure_nacks_submitter():
         with pytest.raises(OSError):
             q.submit("i", "f", [1], [1])
         assert q.stats()["nacked"] == 1 and q.stats()["acked"] == 0
+    finally:
+        q.close()
+
+
+def test_committer_survives_journal_failure(monkeypatch):
+    """An exception OUTSIDE the per-group apply (metrics/journal code)
+    must not kill the committer thread: the wave's submitters are
+    nacked and woken, and the queue keeps serving later waves."""
+    api = _StubAPI()
+    q = IngestQueue(api, wave_interval=0.0)
+    try:
+        from pilosa_tpu.server import ingest as ingest_mod
+
+        def boom(*a, **k):
+            raise RuntimeError("journal exploded")
+
+        monkeypatch.setattr(ingest_mod.events, "record", boom)
+        with pytest.raises(RuntimeError):
+            q.submit("i", "f", [1], [1])
+        monkeypatch.undo()
+        # the committer thread is still alive and commits the next wave
+        assert q.submit("i", "f", [2], [2]) == 1
+    finally:
+        q.close()
+
+
+def test_submit_deadline_times_out_504():
+    from pilosa_tpu.server import deadline as deadline_mod
+
+    class _SlowAPI:
+        def apply_write_wave(self, index, field, rows, cols, sets):
+            time.sleep(0.5)
+            return len(rows)
+
+    q = IngestQueue(_SlowAPI(), wave_interval=0.0)
+    try:
+        dl = deadline_mod.Deadline(time.monotonic() + 0.05)
+        with pytest.raises(deadline_mod.DeadlineExceeded):
+            q.submit("i", "f", [1], [1], deadline=dl)
+        # an already-expired deadline is refused at admission
+        dl2 = deadline_mod.Deadline(time.monotonic() - 1.0)
+        with pytest.raises(deadline_mod.DeadlineExceeded):
+            q.submit("i", "f", [2], [2], deadline=dl2)
     finally:
         q.close()
 
