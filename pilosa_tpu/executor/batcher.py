@@ -139,6 +139,7 @@ class BatchedScorer:
         saturated by small batches.
         """
         sp = trace.current()
+        attrib = trace.attrib_current()
         t0 = time.monotonic()
         slot = _Slot(src)
         with self._lock:
@@ -152,10 +153,30 @@ class BatchedScorer:
             else:
                 self._dispatching = lead = True
         if lead:
+            pre_dev = (
+                attrib.get(trace.WF_DEVICE_COMPUTE, 0.0)
+                if attrib is not None
+                else 0.0
+            )
             self._dispatch_loop(own=slot)
         out = slot.finish(self)
         wait = time.monotonic() - t0
         metrics.observe(metrics.BATCHER_SLOT_WAIT_SECONDS, wait)
+        if attrib is not None:
+            if lead:
+                # the leader's wait covers async launch + device fetch
+                # (and at most one extra round served for peers) —
+                # device time. Kernels that are _timed_kernel-wrapped
+                # (chain batch) already attributed their fenced leg
+                # inside the dispatch loop; count only the remainder.
+                already = attrib.get(trace.WF_DEVICE_COMPUTE, 0.0) - pre_dev
+                if wait > already:
+                    trace.attrib_add(trace.WF_DEVICE_COMPUTE, wait - already)
+            else:
+                # a non-lead waiter's slot wait IS device time: its work
+                # ran inside the leader's launch, which attributed only
+                # to the leader's request (waterfall device.compute leg)
+                trace.attrib_add(trace.WF_DEVICE_COMPUTE, wait)
         if sp is not None:
             # backfill a span covering enqueue -> result (the wait was
             # spent inside finish(), so enter/exit timing can't be used)

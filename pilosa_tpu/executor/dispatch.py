@@ -95,6 +95,8 @@ class _Item:
         "t_enq",
         "wait_s",
         "trace_ctx",
+        "attrib",
+        "wave_no",
     )
 
     def __init__(
@@ -115,6 +117,10 @@ class _Item:
         # distributed trace context (utils/trace.py tuple): a deduped
         # item span-links the executed item it shared results with
         self.trace_ctx = trace_ctx
+        # waterfall legs measured inside the wave, apportioned to this
+        # item; result() merges them into the waiter's attribution ctx
+        self.attrib: Optional[dict] = None
+        self.wave_no = 0
 
     def finish(self, result=None, error=None) -> None:
         self.value = result
@@ -135,6 +141,19 @@ class _Item:
                 if rem <= 0:
                     dl.check("dispatch")  # raises (and counts)
                 self.event.wait(timeout=min(rem, 0.5))
+        d = trace.attrib_current()
+        if d is not None:
+            # the waiter's waterfall: queue wait + this item's share of
+            # the wave's measured legs (+ the wave id for log joins)
+            if self.wait_s > 0.0:
+                d[trace.WF_DISPATCH_QUEUE] = (
+                    d.get(trace.WF_DISPATCH_QUEUE, 0.0) + self.wait_s
+                )
+            if self.attrib:
+                for k, v in self.attrib.items():
+                    d[k] = d.get(k, 0.0) + v
+            if self.wave_no:
+                d["_wave"] = self.wave_no
         if self.error is not None:
             raise self.error
         return self.value
@@ -295,12 +314,17 @@ class DispatchEngine:
 
     def _run_wave(self, wave: list[_Item], wave_no: int = 0) -> None:
         self._in_wave.active = True
+        # wave id rides the contextvar so the logger's correlation
+        # suffix (wave=N) joins this wave's log lines to its items'
+        # waterfalls
+        wtok = trace.set_wave(wave_no)
         try:
             now = time.monotonic()
             metrics.observe(metrics.DISPATCH_WAVE_SIZE, len(wave))
             live: list[_Item] = []
             for it in wave:
                 it.wait_s = now - it.t_enq
+                it.wave_no = wave_no
                 metrics.observe(metrics.DISPATCH_QUEUE_WAIT_SECONDS, it.wait_s)
                 if it.deadline is not None and it.deadline.expired():
                     # expired while queued: cancelled before any
@@ -328,6 +352,7 @@ class DispatchEngine:
             for members in groups.values():
                 self._run_group(members, wave_no)
         finally:
+            trace.reset_wave(wtok)
             self._in_wave.active = False
 
     def _run_group(self, members: list[_Item], wave_no: int = 0) -> None:
@@ -365,6 +390,7 @@ class DispatchEngine:
             self._run_single(leaders[0])
         for lead in leaders:
             for d in dups.get(id(lead), ()):
+                d.attrib = lead.attrib  # served by the leader's work
                 d.finish(result=lead.value, error=lead.error)
 
     def _try_combined(self, leaders: list[_Item]) -> bool:
@@ -379,8 +405,13 @@ class DispatchEngine:
         dls = [it.deadline for it in leaders if it.deadline is not None]
         gang_dl = min(dls, key=lambda d: d.at) if dls else None
         dm = _deadline()
+        # fresh attribution scope for the combined execution: the legs
+        # measured inside (fenced device compute, transfer, stager, ...)
+        # are apportioned to the members by call count — one wave, one
+        # measurement, each waiter sees its share
+        measured: dict = {}
         try:
-            with dm.activate(gang_dl):
+            with dm.activate(gang_dl), trace.attrib_activate(measured):
                 results = self.executor._execute(
                     head.index, combined, head.shards, head.opt
                 )
@@ -390,8 +421,12 @@ class DispatchEngine:
             return False
         with self._mu:
             self.combined_items += len(leaders)
+        total_calls = sum(it.n_calls for it in leaders) or 1
         off = 0
         for it in leaders:
+            if measured:
+                w = it.n_calls / total_calls
+                it.attrib = {k: v * w for k, v in measured.items()}
             it.finish(result=results[off : off + it.n_calls])
             off += it.n_calls
         return True
@@ -400,13 +435,14 @@ class DispatchEngine:
         if it.event.is_set():
             return
         dm = _deadline()
+        measured: dict = {}
         try:
-            with dm.activate(it.deadline):
-                it.finish(
-                    result=self.executor._execute(
-                        it.index, it.query, it.shards, it.opt
-                    )
+            with dm.activate(it.deadline), trace.attrib_activate(measured):
+                result = self.executor._execute(
+                    it.index, it.query, it.shards, it.opt
                 )
+            it.attrib = measured or None
+            it.finish(result=result)
         except BaseException as err:
             it.finish(error=err)
 

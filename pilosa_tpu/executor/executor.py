@@ -28,7 +28,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from pilosa_tpu.utils import metrics, trace
+from pilosa_tpu.utils import metrics, profiler, trace
 
 from pilosa_tpu import SHARD_WIDTH, ops
 from pilosa_tpu.core import Row, TopOptions, VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD
@@ -239,31 +239,56 @@ def _make_stacked_scorer() -> BatchedScorer:
     )
 
 
-def _timed_kernel(kind: str, fn):
+def _timed_kernel(kind: str, fn, signature=None):
     """Wrap a cached jitted kernel with the compile-vs-execute timing
     split: the FIRST invocation traces + compiles inside XLA (observed
     as spmd.compile_seconds), warm invocations are dispatch only
     (spmd.execute_seconds). When the caller is traced, each invocation
-    also lands as a spmd.kernel span."""
+    also lands as a spmd.kernel span.
+
+    This is also the device-leg fence (ISSUE 12): ``block_until_ready``
+    on the outputs pins the measurement to real device completion
+    instead of async-dispatch return, so the timing feeds the waterfall
+    as device.compute and the first call feeds the compile tracker
+    under ``signature`` (the canonical plan key of the cached jit)."""
 
     state = {"first": True}
 
     def run(*args, **kw):
         t0 = time.monotonic()
         out = fn(*args, **kw)
+        try:
+            import jax  # lazy, matching this module's other jax uses
+
+            jax.block_until_ready(out)
+        except Exception:
+            pass  # non-jax outputs (CPU fallbacks) have nothing to fence
         dt = time.monotonic() - t0
         first = state["first"]
         if first:
             state["first"] = False
             metrics.observe(metrics.SPMD_COMPILE_SECONDS, dt, kind=kind)
+            profiler.COMPILES.note(kind, signature, dt)
         else:
             metrics.observe(metrics.SPMD_EXECUTE_SECONDS, dt, kind=kind)
+        trace.attrib_add(trace.WF_DEVICE_COMPUTE, dt)
         sp = trace.current()
         if sp is not None:
             sp.record(metrics.STAGE_SPMD_KERNEL, t0, dt, kind=kind, first=first)
         return out
 
     return run
+
+
+def _fetch(arr) -> np.ndarray:
+    """Materialize a device result on host, crediting the D2H
+    transfer+decode waterfall leg when attribution is active."""
+    if trace.attrib_current() is None:
+        return np.asarray(arr)
+    t0 = time.monotonic()
+    out = np.asarray(arr)
+    trace.attrib_add(trace.WF_TRANSFER_DECODE, time.monotonic() - t0)
+    return out
 
 
 class Executor:
@@ -407,7 +432,7 @@ class Executor:
                     fn = spmd.topn_scores_sparse_spmd(self.mesh, *statics)
                 else:
                     raise ValueError(kind)
-                fn = _timed_kernel(kind, fn)
+                fn = _timed_kernel(kind, fn, signature=key)
                 self._spmd_kernels[key] = fn
             return fn
 
@@ -539,10 +564,12 @@ class Executor:
             # execution only: __cached placeholders never serialize.
             from pilosa_tpu.plan import planner
 
+            t0_cse = time.monotonic()
             with trace.child(metrics.STAGE_PLAN_CANON):
                 calls = planner.rewrite_for_cse(
                     self, index_name, query.calls, shards, opt
                 )
+            trace.attrib_add(trace.WF_PLAN_CANON, time.monotonic() - t0_cse)
         if len(calls) > 1 and query.write_call_n() == 0 and not opt.serial:
             # An all-read request has no cross-call ordering constraints
             # (the reference runs calls serially, executor.go:126-145,
@@ -553,9 +580,10 @@ class Executor:
             pool = self._read_pool_acquire()
             parent = trace.current()  # contextvars don't follow pool workers
             pdl = dl  # nor does the request deadline
+            attrib = trace.attrib_current()  # nor the waterfall accumulator
 
             def run_call(call):
-                with trace.activate(parent), _deadline().activate(pdl):
+                with trace.activate(parent), _deadline().activate(pdl), trace.attrib_activate(attrib):
                     return self._execute_call(index_name, call, shards, opt)
 
             if pool is None:
@@ -810,6 +838,7 @@ class Executor:
         # instead of finishing a result nobody will read
         parent = trace.current()
         dl = _deadline().current()
+        attrib = trace.attrib_current()  # same single-capture discipline
         for shard in shards:
             if dl is not None:
                 dl.check(metrics.STAGE_MAP_SHARD)
@@ -818,7 +847,16 @@ class Executor:
                     v = map_fn(shard)
             else:
                 v = map_fn(shard)
-            result = v if result is None else reduce_fn(result, v)
+            if result is None:
+                result = v
+            elif attrib is None:
+                result = reduce_fn(result, v)
+            else:
+                t0r = time.monotonic()
+                result = reduce_fn(result, v)
+                attrib[trace.WF_REDUCE] = attrib.get(trace.WF_REDUCE, 0.0) + (
+                    time.monotonic() - t0r
+                )
         return result
 
     # -- bitmap calls ---------------------------------------------------------
@@ -1297,6 +1335,7 @@ class Executor:
             fn = _timed_kernel(
                 "tree_count",
                 jax.jit(lambda *ls: ops.count_bits(_eval_tree(tree, ls))[None]),
+                signature=key,
             )
             self._tree_jits[key] = fn
         return fn
@@ -1326,7 +1365,7 @@ class Executor:
                 pc = jax.lax.population_count(acc).astype(jnp.int32)
                 return jnp.sum(pc, axis=tuple(range(1, pc.ndim)))
 
-            fn = _timed_kernel("tree_count_batch", jax.jit(run))
+            fn = _timed_kernel("tree_count_batch", jax.jit(run), signature=key)
             self._tree_batch_jits[key] = fn
         return fn
 
@@ -1535,7 +1574,7 @@ class Executor:
             res = self.chain_scorer.score(key, tree, tuple(leaves))
         else:
             res = self._tree_count_jit(tree)(*leaves)
-        return int(np.asarray(res).reshape(-1)[0])
+        return int(_fetch(res).reshape(-1)[0])
 
     # -- Sum / Min / Max -----------------------------------------------------
 
@@ -1608,7 +1647,7 @@ class Executor:
                 try:
                     filt, has_filter = self._device_filter(index, c, shard)
                     planes = self.stager.planes(frag, depth)
-                    counts = np.asarray(
+                    counts = _fetch(
                         ops.bsi_plane_counts(
                             planes, filt, bit_depth=depth, has_filter=has_filter
                         )
@@ -1639,11 +1678,11 @@ class Executor:
             has_filter = False
         planes = self.stager.planes_stack(frags, depth)
         if self.mesh is not None:
-            counts = np.asarray(
+            counts = _fetch(
                 self._spmd_kernel("plane_counts", depth, has_filter)(planes, filt)
             )
         else:
-            counts = np.asarray(
+            counts = _fetch(
                 ops.bsi_plane_counts_batched(
                     planes, filt, bit_depth=depth, has_filter=has_filter
                 )
@@ -1689,7 +1728,7 @@ class Executor:
                     count = int(count)
                     if count == 0:
                         return ValCount()
-                    val = sum(1 << i for i, b in enumerate(np.asarray(bits)) if b)
+                    val = sum(1 << i for i, b in enumerate(_fetch(bits)) if b)
                     return ValCount(val + bsig.min, count)
                 except _NotDeviceable:
                     pass
@@ -2352,7 +2391,7 @@ class _StackedLazyScores(_ChunkedLazyScores):
             (blocks, brow, bslot, bshard, num_rows),
             self._resolved_srcs(),
         )
-        return np.asarray(scores)[: len(self._frags) * size].reshape(
+        return _fetch(scores)[: len(self._frags) * size].reshape(
             len(self._frags), size
         )
 
@@ -2391,7 +2430,7 @@ class _SpmdLazyScores(_ChunkedLazyScores):
 
     def _score(self, staged, size: int):
         blocks, brow, bslot = staged
-        scores = np.asarray(
+        scores = _fetch(
             self._ex._spmd_kernel("topn_scores_sparse", size)(
                 self._resolved_srcs(), blocks, brow, bslot
             )
@@ -2443,7 +2482,7 @@ class _LazyScores:
         occupied = frag.sparse_block_count(list(ids))
         if occupied * 2 < len(ids) * (SHARD_WIDTH >> 16):
             blocks, brow, bslot, num_rows = self._ex.stager.sparse_rows(frag, ids)
-            scores = np.asarray(
+            scores = _fetch(
                 ops.sparse_intersection_counts(
                     self._src, blocks, brow, bslot, num_rows
                 )
@@ -2633,9 +2672,11 @@ def _ranked_walk(frag, opt_: TopOptions, pairs, score_by_id) -> list[tuple[int, 
 
 
 def _row_from_device(words, shard: int) -> Row:
+    t0 = time.monotonic()
     w32 = np.asarray(words)
     w64 = np.ascontiguousarray(w32).view("<u8")
     seg = Bitmap.from_words_range(w64, start=shard * SHARD_WIDTH)
+    trace.attrib_add(trace.WF_TRANSFER_DECODE, time.monotonic() - t0)
     return Row.from_segment(shard, seg)
 
 

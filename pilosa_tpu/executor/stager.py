@@ -219,6 +219,7 @@ class DeviceStager:
                             metrics.STAGER_DELTA_APPLY_SECONDS,
                             time.monotonic() - t0,
                         )
+                        trace.attrib_add(trace.WF_STAGER, time.monotonic() - t0)
                 if value is None:
                     t0 = time.monotonic()
                     sp = trace.current()
@@ -231,6 +232,7 @@ class DeviceStager:
                     metrics.observe(
                         metrics.STAGER_STAGE_SECONDS, time.monotonic() - t0
                     )
+                    trace.attrib_add(trace.WF_STAGER, time.monotonic() - t0)
                     metrics.count(metrics.STAGER_MISSES)
                     if stale is None:
                         metrics.count(metrics.STAGER_MISSES_COLD)

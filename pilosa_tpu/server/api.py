@@ -9,6 +9,7 @@ restricted set is callable.
 from __future__ import annotations
 
 import io
+import time
 from typing import Optional
 
 import numpy as np
@@ -19,7 +20,7 @@ from pilosa_tpu.core.view import VIEW_STANDARD
 from pilosa_tpu.executor import ExecOptions
 from pilosa_tpu.pql import parse
 from pilosa_tpu.server import deadline, pipeline
-from pilosa_tpu.utils import metrics, trace
+from pilosa_tpu.utils import metrics, profiler, trace
 
 # cluster states (reference cluster.go:42-45)
 STATE_STARTING = "STARTING"
@@ -98,6 +99,7 @@ class API:
         profile: bool = False,
         cache: bool = True,
         trace_ctx: Optional[tuple] = None,
+        waterfall: bool = False,
     ) -> dict:
         self._validate("query")
         # deadline boundary: cancel BEFORE the parse — an expired
@@ -111,8 +113,9 @@ class API:
             exclude_columns=exclude_columns,
             # cache=false bypasses the plan result cache; profile=true
             # does too — a profiled query must show real execution, not
-            # a cache hit's absence of spans
-            cache=cache and not profile,
+            # a cache hit's absence of spans. profile=waterfall likewise:
+            # a cache hit has no device leg to attribute
+            cache=cache and not profile and not waterfall,
         )
         # root span: forced by profile=true or a sampled upstream
         # traceparent (the ingress point ADOPTS the caller's trace id),
@@ -122,21 +125,32 @@ class API:
         root = trace.TRACER.trace(
             metrics.STAGE_QUERY, force=profile, ctx=trace_ctx, index=index
         )
+        # always-on attribution (ISSUE 12): every served query carries a
+        # waterfall accumulator — a plain dict in a contextvar, one get
+        # + float add per instrumented leg, no spans, no sampling gate.
+        # Created HERE (not the HTTP thread) because pipeline thunks run
+        # on worker threads where the handler's contextvars don't reach.
+        wf: dict = {}
+        t_q0 = time.monotonic()
         # an UNSAMPLED upstream context still propagates its ids to
         # dispatch items and outbound RPC headers, span-free
         with root, trace.push_ctx(
             trace_ctx if root is trace.NOP_SPAN else None
-        ):
+        ), trace.attrib_activate(wf):
             # when this query came through the serving pipeline, its
             # admission-queue wait predates the root span — backfill it
             # so profile=true shows where serving latency went
             wait = pipeline.current_queue_wait()
-            if wait > 0 and root is not trace.NOP_SPAN:
-                root.record(
-                    metrics.STAGE_PIPELINE_WAIT, root.t0 - wait, wait
-                )
+            if wait > 0:
+                wf[trace.WF_PIPELINE_QUEUE] = wait
+                if root is not trace.NOP_SPAN:
+                    root.record(
+                        metrics.STAGE_PIPELINE_WAIT, root.t0 - wait, wait
+                    )
             try:
+                t0p = time.monotonic()
                 q = parse(query)
+                wf[trace.WF_PLAN_CANON] = time.monotonic() - t0p
             except Exception as e:
                 raise APIError(f"parsing: {e}") from e
             idx = self.holder.index(index)
@@ -144,6 +158,12 @@ class API:
                 raise NotFoundError(f"index not found: {index}")
             results = self.executor.execute(index, q, shards, opt)
         resp: dict = {"results": results}
+        # total covers parse → results plus the pre-span pipeline wait;
+        # the handler pops _waterfall into the aggregator + SLO monitor
+        total_s = (time.monotonic() - t_q0) + wf.get(trace.WF_PIPELINE_QUEUE, 0.0)
+        resp["_waterfall"] = profiler.WATERFALL.summarize(wf, total_s)
+        if waterfall:
+            resp["profile"] = {"waterfall": resp["_waterfall"]}
         if profile:
             resp["profile"] = trace.TRACER.stitched(root.to_dict())
         if remote and root is not trace.NOP_SPAN:

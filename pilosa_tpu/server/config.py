@@ -228,6 +228,19 @@ class Config:
     # out sub-threshold queries whose recompute is cheaper than the
     # cache bookkeeping. 0 caches everything.
     plan_cache_min_cost: float = 0.0
+    # performance attribution (utils/profiler.py, utils/slo.py):
+    # continuous thread-stack sampler frequency in Hz (0 disables)
+    profiler_hz: float = 10.0
+    # HBM occupancy fraction above which the device-telemetry poller
+    # journals a profiler.hbm_watermark event (edge-triggered)
+    hbm_watermark_pct: float = 0.9
+    # per-class SLOs: "cls=latency_ms@availability_target,..." — a query
+    # is good when it succeeds within latency_ms; burn rate is measured
+    # against 1 - target over 5m/1h windows
+    slo_objectives: str = "interactive=250@0.999,bulk=2000@0.99,internal=500@0.999"
+    # burn-rate alert threshold (fires when BOTH windows exceed it);
+    # 14.4 = the SRE-workbook fast-burn page (budget gone in ~2 days)
+    slo_burn_threshold: float = 14.4
     # opt-in diagnostics phone-home endpoint (reference diagnostics.go);
     # empty = disabled
     diagnostics_host: str = ""
@@ -333,6 +346,10 @@ class Config:
             f"plan-cache-enabled = {'true' if self.plan_cache_enabled else 'false'}",
             f"plan-cache-max-bytes = {self.plan_cache_max_bytes}",
             f"plan-cache-min-cost = {self.plan_cache_min_cost}",
+            f"profiler-hz = {self.profiler_hz}",
+            f"hbm-watermark-pct = {self.hbm_watermark_pct}",
+            f'slo-objectives = "{self.slo_objectives}"',
+            f"slo-burn-threshold = {self.slo_burn_threshold}",
             "",
             "[cluster]",
             f"disabled = {'true' if self.cluster.disabled else 'false'}",

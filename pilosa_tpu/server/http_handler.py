@@ -29,7 +29,7 @@ from pilosa_tpu.server.pipeline import (
 )
 from pilosa_tpu.parallel.multihost import GangUnavailable
 from pilosa_tpu.utils.errors import NotFoundError as ExecNotFound
-from pilosa_tpu.utils import events, metrics, privateproto, publicproto, trace
+from pilosa_tpu.utils import events, metrics, privateproto, profiler, publicproto, slo, trace
 from pilosa_tpu.utils.stats import NOP_STATS
 
 # conservative write detector for coalescing/batching eligibility: any
@@ -210,6 +210,11 @@ class Handler:
             Route("GET", r"/debug/traces", self.get_debug_traces),
             Route("GET", r"/debug/events", self.get_debug_events),
             Route("GET", r"/debug/fleet", self.get_debug_fleet),
+            # performance attribution (ISSUE 12): latency waterfalls,
+            # continuous profiler + compile/HBM telemetry, SLO burn
+            Route("GET", r"/debug/latency", self.get_debug_latency),
+            Route("GET", r"/debug/profile", self.get_debug_profile),
+            Route("GET", r"/debug/slo", self.get_debug_slo),
             # index (with and without trailing slash, as net/http/pprof
             # serves it) plus the thread-dump profile; unknown names 404
             Route("GET", r"/debug/pprof/?", self.get_debug_pprof),
@@ -256,7 +261,11 @@ class Handler:
             exclude_row_attrs = q.get("excludeRowAttrs", ["false"])[0] == "true"
             exclude_columns = q.get("excludeColumns", ["false"])[0] == "true"
             column_attrs = q.get("columnAttrs", ["false"])[0] == "true"
-        profile = q.get("profile", ["false"])[0] == "true"
+        # profile=true returns the span tree; profile=waterfall returns
+        # the per-stage latency split from the attribution layer
+        profile_raw = q.get("profile", ["false"])[0]
+        profile = profile_raw == "true"
+        waterfall = profile_raw == "waterfall"
         cache = q.get("cache", ["true"])[0] != "false"
         # W3C trace context ingress: a sampled traceparent makes this
         # request a leg of a distributed trace (api.query adopts the
@@ -276,7 +285,10 @@ class Handler:
         cls = CLASS_INTERNAL if remote else CLASS_INTERACTIVE
         signature = None
         batch = None
-        if not remote and not profile and not _WRITE_CALL_RE.search(body):
+        # waterfall requests skip cross-request coalescing/batching like
+        # profile: a follower served by a leader's execution would report
+        # the LEADER's split, not its own
+        if not remote and not profile and not waterfall and not _WRITE_CALL_RE.search(body):
             from pilosa_tpu.plan.canon import query_signature
 
             canon_sig = query_signature(body)
@@ -321,13 +333,29 @@ class Handler:
                 profile=profile,
                 cache=cache,
                 trace_ctx=trace_ctx,
+                waterfall=waterfall,
             )
 
         t0 = time.monotonic()
-        resp = self._submit(
-            cls, thunk, dl, signature=signature, batch=batch, trace_ctx=trace_ctx
-        )
+        try:
+            resp = self._submit(
+                cls, thunk, dl, signature=signature, batch=batch, trace_ctx=trace_ctx
+            )
+        except APIError as e:
+            # client errors (4xx) don't burn error budget; 5xx does
+            slo.MONITOR.record(cls, time.monotonic() - t0, ok=e.status < 500)
+            raise
+        except BaseException:
+            # timeouts, sheds, internal failures all consume budget
+            slo.MONITOR.record(cls, time.monotonic() - t0, ok=False)
+            raise
         dur = time.monotonic() - t0
+        slo.MONITOR.record(cls, dur, ok=True)
+        # always-on waterfall: api.query attaches the summary; pop it
+        # (shared dicts from coalesced responses aggregate only once)
+        wf_summary = resp.pop("_waterfall", None)
+        if wf_summary is not None:
+            profiler.WATERFALL.record_summary(cls, wf_summary)
         # slow-query logging (reference handler.go:257-261)
         if self.long_query_time and dur > self.long_query_time and self.logger:
             self.logger.printf("%.3fs SLOW QUERY %s %s", dur, index, body[:500])
@@ -744,6 +772,14 @@ class Handler:
         stager = getattr(self.api.executor, "stager", None)
         if stager is not None:
             metrics.gauge(metrics.STAGER_BYTES, stager._bytes)
+        # scrape-time freshness: uptime companion to build_info, and the
+        # SLO gauges re-derived from the sample windows so the scrape
+        # never reads a stale burn rate between server ticks
+        srv = getattr(self.api, "server", None)
+        started = getattr(srv, "started_at", None)
+        if started:
+            metrics.gauge(metrics.UPTIME_SECONDS, round(time.time() - started, 3))
+        slo.MONITOR.tick()
         text = metrics.render_prometheus(
             extra_snapshots=[self._expvar_snapshot()]
         )
@@ -811,14 +847,75 @@ class Handler:
 
     def get_debug_events(self, req) -> dict:
         """The lifecycle event journal (utils/events.py): gang state
-        transitions, degrades, re-forms, retry exhaustion — bounded,
-        ordered by seq. Filters: ``?kind=``, ``?since=<seq>``."""
+        transitions, degrades, re-forms, retry exhaustion, profiler and
+        SLO alerts — bounded, ordered by seq. Filters: ``?kind=``,
+        ``?since=<seq>``, ``?limit=<n>`` (newest n after filtering)."""
         q = req.query
         try:
             since = int(q.get("since", ["0"])[0])
+            limit = int(q.get("limit", ["0"])[0])
         except ValueError:
-            raise APIError("invalid since: must be an integer seq", status=400)
-        return {"events": events.snapshot(kind=q.get("kind", [None])[0], since_seq=since)}
+            raise APIError("invalid since/limit: must be an integer", status=400)
+        return {
+            "events": events.snapshot(
+                kind=q.get("kind", [None])[0], since_seq=since, limit=limit
+            )
+        }
+
+    def get_debug_latency(self, req) -> dict:
+        """Latency waterfalls (ISSUE 12): the stage taxonomy, the live
+        rtt_fraction EMA, recent per-query waterfalls, and the
+        per-class/per-stage summaries from the metric registry.
+        ``?limit=<n>`` bounds the recent ring."""
+        q = req.query
+        try:
+            limit = int(q.get("limit", ["0"])[0])
+        except ValueError:
+            raise APIError("invalid limit: must be an integer", status=400)
+        out = profiler.WATERFALL.snapshot(limit=limit)
+        snap = metrics.snapshot()
+        prefix = metrics.LATENCY_STAGE_SECONDS
+        out["summary"] = {
+            k: v
+            for k, v in snap.items()
+            # flat keys carry aggregation suffixes (.hist etc.)
+            if k.split(";", 1)[0].startswith(prefix)
+        }
+        return out
+
+    def get_debug_profile(self, req) -> dict:
+        """Continuous-profiler surface: stack-sampler top frames,
+        per-signature compile table, HBM telemetry, and on-demand
+        ``jax.profiler`` capture control (``?capture=start&dir=<path>``
+        / ``?capture=stop``). ``?top=<n>`` sizes the tables."""
+        q = req.query
+        try:
+            top = int(q.get("top", ["25"])[0])
+        except ValueError:
+            raise APIError("invalid top: must be an integer", status=400)
+        capture = q.get("capture", [None])[0]
+        out: dict = {
+            "sampler": profiler.SAMPLER.snapshot(top=top),
+            "compiles": profiler.COMPILES.snapshot(top=top),
+            "hbm": profiler.TELEMETRY.snapshot(),
+            "capture": profiler.capture_status(),
+        }
+        if capture == "start":
+            out["capture"] = profiler.start_capture(
+                q.get("dir", ["/tmp/pilosa-profile"])[0]
+            )
+        elif capture == "stop":
+            out["capture"] = profiler.stop_capture()
+        elif capture is not None:
+            raise APIError("capture must be start or stop", status=400)
+        return out
+
+    def get_debug_slo(self, req) -> dict:
+        """SLO burn-rate snapshot: per-class objectives, 5m/1h burn
+        rates, budget remaining, and firing state. Gauges refresh as a
+        side effect, same as the scrape path."""
+        slo.MONITOR.tick()
+        return slo.MONITOR.snapshot()
 
     def get_debug_fleet(self, req) -> dict:
         """Fleet collector membership + scrape health (JSON twin of

@@ -23,7 +23,14 @@ from pilosa_tpu import __version__
 from pilosa_tpu.utils.attrstore import new_attr_store
 from pilosa_tpu.utils.diagnostics import DiagnosticsCollector
 from pilosa_tpu.utils.logger import NOP_LOGGER, StandardLogger
-from pilosa_tpu.utils import events, logger as logger_mod, metrics, trace
+from pilosa_tpu.utils import (
+    events,
+    logger as logger_mod,
+    metrics,
+    profiler,
+    slo,
+    trace,
+)
 from pilosa_tpu.utils.gcnotify import GCNotifier
 from pilosa_tpu.utils.stats import (
     ExpvarStatsClient,
@@ -517,6 +524,29 @@ class Server:
             rank=str(self._mh_rank),
             leader=str(self._mh_rank == 0).lower(),
         )
+        # performance attribution plane (ISSUE 12): uptime/start-time
+        # gauges for fleet restart detection, SLO objectives from
+        # config, and the always-on samplers. All of it degrades to
+        # no-ops when the knobs disable it — serving never depends on
+        # the observers.
+        self.started_at = time.time()
+        metrics.gauge(metrics.PROCESS_START_TIME_SECONDS, round(self.started_at, 3))
+        metrics.gauge(metrics.UPTIME_SECONDS, 0.0)
+        slo.MONITOR.configure(
+            objectives=slo.parse_objectives(self.config.slo_objectives),
+            burn_threshold=self.config.slo_burn_threshold,
+        )
+        profiler.TELEMETRY.watermark_pct = self.config.hbm_watermark_pct
+        stager = self.stager
+
+        def _stager_probe() -> tuple[int, int]:
+            return stager._bytes, stager.budget_bytes
+
+        profiler.TELEMETRY.stager_probe = _stager_probe
+        profiler.TELEMETRY.start()
+        if self.config.profiler_hz > 0:
+            profiler.SAMPLER.hz = self.config.profiler_hz
+            profiler.SAMPLER.start()
         if self.cluster is None and not self.config.cluster.disabled:
             if self.config.distributed_enabled and self._mh_rank != 0:
                 # federation: the cluster plane runs on gang LEADERS
@@ -817,6 +847,21 @@ class Server:
                 except Exception as e:
                     self.logger.printf("liveness probe error: %s", e)
 
+        def slo_tick_loop():
+            # evaluate burn-rate windows even when nobody scrapes: the
+            # journal event (events.SLO_BURN) must fire on wall-clock,
+            # not on observer traffic. Also refreshes the uptime gauge
+            # so a scrape between ticks is at most 5s stale.
+            while not self._closed.wait(5.0):
+                try:
+                    metrics.gauge(
+                        metrics.UPTIME_SECONDS,
+                        round(time.time() - self.started_at, 3),
+                    )
+                    slo.MONITOR.tick()
+                except Exception as e:
+                    self.logger.printf("slo tick error: %s", e)
+
         def node_status_loop():
             # reference periodic NodeStatus push/pull (server.go:565-630)
             interval = self.config.cluster.status_interval
@@ -839,6 +884,7 @@ class Server:
             diagnostics_loop,
             translate_replication_loop,
             liveness_loop,
+            slo_tick_loop,
             node_status_loop,
         ):
             threading.Thread(target=fn, daemon=True).start()
@@ -950,6 +996,9 @@ class Server:
             self.multihost.close()
         if self.gc_notifier is not None:
             self.gc_notifier.close()
+        # observer planes stop after the workers they observe
+        profiler.SAMPLER.stop()
+        profiler.TELEMETRY.stop()
         self.stats.close()
         if self.httpd is not None:
             self.httpd.shutdown()

@@ -37,6 +37,28 @@ INGEST_WAVE = "ingest.wave"
 INGEST_SHED = "ingest.shed"
 INGEST_RECOVERY = "ingest.recovery"
 INGEST_FAULT = "ingest.fault"
+# performance attribution (ISSUE 12): device-telemetry watermarks,
+# recompile-storm detections, SLO error-budget burns
+PROFILER_HBM_WATERMARK = "profiler.hbm_watermark"
+PROFILER_RECOMPILE_STORM = "profiler.recompile_storm"
+SLO_BURN = "slo.burn"
+
+# kind → one-line description; the docs/administration.md event-kind
+# catalog is sync-tested against this registry both directions, so a
+# new producer can't ship an undocumented kind
+EVENT_KINDS: dict = {
+    GANG_TRANSITION: "gang lifecycle state-machine edge (from → to)",
+    GANG_DEGRADE: "gang lost a member and degraded below full strength",
+    GANG_REFORM: "gang re-formed at a new epoch after a degrade",
+    CLIENT_RETRY_EXHAUSTED: "cross-gang RPC gave up after all retries",
+    INGEST_WAVE: "durable-ingest write wave group-committed",
+    INGEST_SHED: "durable-ingest queue overflow shed a write",
+    INGEST_RECOVERY: "crash recovery truncated the op log at fragment open",
+    INGEST_FAULT: "injected storage fault (fault-injection harness)",
+    PROFILER_HBM_WATERMARK: "device memory crossed hbm-watermark-pct of its limit",
+    PROFILER_RECOMPILE_STORM: "XLA compile burst exceeded the storm window",
+    SLO_BURN: "error-budget burn rate over threshold on both SLO windows",
+}
 
 
 class EventJournal:
@@ -66,14 +88,18 @@ class EventJournal:
         return d
 
     def snapshot(
-        self, kind: Optional[str] = None, since_seq: int = 0
+        self, kind: Optional[str] = None, since_seq: int = 0, limit: int = 0
     ) -> list[dict]:
+        """Matching entries oldest-first; a positive ``limit`` keeps only
+        the newest that many after filtering."""
         with self._mu:
             entries = list(self._ring)
         if kind:
             entries = [e for e in entries if e["kind"] == kind]
         if since_seq:
             entries = [e for e in entries if e["seq"] > since_seq]
+        if limit > 0:
+            entries = entries[-limit:]
         return entries
 
     def clear(self) -> None:

@@ -35,6 +35,9 @@ def _correlation_suffix() -> str:
         ctx = trace.current_ctx()
         if ctx is not None:
             parts.append(f"trace={ctx[0]}")
+        wave = trace.current_wave()
+        if wave:
+            parts.append(f"wave={wave}")
         if _context_provider is not None:
             for k, v in (_context_provider() or {}).items():
                 parts.append(f"{k}={v}")

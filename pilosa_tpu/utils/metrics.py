@@ -168,6 +168,23 @@ BUILD_INFO = "build_info"
 EVENTS_RECORDED = "events.recorded"
 FLEET_SCRAPES = "fleet.scrapes"
 TRACE_REMOTE_SPANS = "trace.remote_spans"
+# performance attribution (ISSUE 12): always-on latency waterfalls,
+# device telemetry, continuous profiler, SLO burn-rate monitoring
+LATENCY_STAGE_SECONDS = "latency.stage_seconds"
+EXECUTOR_RTT_FRACTION = "executor.rtt_fraction"
+HBM_BYTES_IN_USE = "hbm.bytes_in_use"
+HBM_PEAK_BYTES = "hbm.peak_bytes"
+HBM_BYTES_LIMIT = "hbm.bytes_limit"
+HBM_STAGER_FRACTION = "hbm.stager_fraction"
+PROFILER_COMPILES = "profiler.compiles"
+PROFILER_RECOMPILE_STORMS = "profiler.recompile_storms"
+PROFILER_SAMPLES = "profiler.samples"
+PROFILER_STACK_KEYS = "profiler.stack_keys"
+SLO_BURN_RATE = "slo.burn_rate"
+SLO_BUDGET_REMAINING = "slo.budget_remaining"
+SLO_BURNS = "slo.burns"
+UPTIME_SECONDS = "uptime_seconds"
+PROCESS_START_TIME_SECONDS = "process_start_time_seconds"
 # server-level (emitted through the server's expvar/statsd stats client;
 # merged into /metrics from the expvar snapshot)
 QUERY_TIME = "query_time"
@@ -452,6 +469,76 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter",
         "remote span subtrees stitched into local traces (label: "
         "source = push | envelope)",
+    ),
+    LATENCY_STAGE_SECONDS: (
+        "summary",
+        "per-query latency waterfall leg, per request class and "
+        "waterfall stage (labels: cls, stage — see §Waterfall stages)",
+    ),
+    EXECUTOR_RTT_FRACTION: (
+        "gauge",
+        "EMA of the device+transfer share of served-query latency — "
+        "the live is-it-still-RTT-bound signal",
+    ),
+    HBM_BYTES_IN_USE: (
+        "gauge",
+        "device memory in use, from device.memory_stats() (label: device)",
+    ),
+    HBM_PEAK_BYTES: (
+        "gauge",
+        "peak device memory in use since process start (label: device)",
+    ),
+    HBM_BYTES_LIMIT: (
+        "gauge",
+        "device memory capacity, from device.memory_stats() (label: device)",
+    ),
+    HBM_STAGER_FRACTION: (
+        "gauge",
+        "fraction of device memory held by the HBM staging cache "
+        "(stager bytes / device limit)",
+    ),
+    PROFILER_COMPILES: (
+        "counter",
+        "XLA compiles observed at the jit entry points (label: kind); "
+        "per-plan-signature detail at /debug/profile",
+    ),
+    PROFILER_RECOMPILE_STORMS: (
+        "counter",
+        "recompile-storm detections (compile burst over the storm "
+        "window) — each also journals a profiler.recompile_storm event",
+    ),
+    PROFILER_SAMPLES: (
+        "counter",
+        "thread-stack samples taken by the continuous profiler",
+    ),
+    PROFILER_STACK_KEYS: (
+        "gauge",
+        "distinct aggregated stack keys held by the continuous profiler "
+        "(bounded; overflow folds into an 'other' bucket)",
+    ),
+    SLO_BURN_RATE: (
+        "gauge",
+        "error-budget burn rate over a trailing window (labels: cls, "
+        "window = 5m | 1h); 1.0 burns the budget exactly at period end",
+    ),
+    SLO_BUDGET_REMAINING: (
+        "gauge",
+        "fraction of the error budget left over the long (1h) window, "
+        "per request class (label: cls)",
+    ),
+    SLO_BURNS: (
+        "counter",
+        "SLO burn alerts fired (both windows over slo-burn-threshold; "
+        "label: cls) — each also journals an slo.burn event",
+    ),
+    UPTIME_SECONDS: (
+        "gauge",
+        "seconds since this process's server opened (companion to "
+        "build_info; refreshed at scrape time)",
+    ),
+    PROCESS_START_TIME_SECONDS: (
+        "gauge",
+        "unix timestamp at which this process's server opened",
     ),
     QUERY_TIME: ("summary", "whole-query wall time, server-level (label: index)"),
     SLOW_QUERY: ("counter", "queries slower than cluster.long-query-time"),

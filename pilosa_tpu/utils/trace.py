@@ -498,3 +498,148 @@ def record_link(name: str, ctx: tuple, target: tuple, tracer: Optional[Tracer] =
 # process-global default tracer; the server applies its config knobs
 # (trace-sample-rate, slow-query-time) here at startup
 TRACER = Tracer()
+
+
+# -- latency waterfall taxonomy (ISSUE 12) ------------------------------------
+#
+# Spans answer "which code ran"; the waterfall answers "where did the
+# milliseconds go" — a fixed, small set of buckets every served query's
+# latency decomposes into, stable across refactors so dashboards and the
+# SLO layer don't chase span renames. Each bucket is a *leg* of the
+# request, not a function: host-side work that doesn't fit a named leg
+# lands in the synthetic ``other`` bucket (total − sum of measured legs),
+# computed at aggregation time rather than instrumented.
+
+WF_ADMISSION = "admission"
+WF_PIPELINE_QUEUE = "pipeline.queue"
+WF_PLAN_CANON = "plan.canon"
+WF_STAGER = "stager"
+WF_DISPATCH_QUEUE = "dispatch.queue"
+WF_DEVICE_COMPUTE = "device.compute"
+WF_TRANSFER_DECODE = "transfer.decode"
+WF_REDUCE = "reduce"
+WF_OTHER = "other"
+
+# display / aggregation order of the waterfall
+WATERFALL_STAGES: tuple = (
+    WF_ADMISSION,
+    WF_PIPELINE_QUEUE,
+    WF_PLAN_CANON,
+    WF_STAGER,
+    WF_DISPATCH_QUEUE,
+    WF_DEVICE_COMPUTE,
+    WF_TRANSFER_DECODE,
+    WF_REDUCE,
+    WF_OTHER,
+)
+
+WATERFALL: dict = {
+    WF_ADMISSION: "HTTP parse, auth, validation before the pipeline",
+    WF_PIPELINE_QUEUE: "admission-pipeline queue wait (+ coalescing)",
+    WF_PLAN_CANON: "query parse, canonicalization, CSE planning",
+    WF_STAGER: "HBM stage miss: building + uploading shard planes",
+    WF_DISPATCH_QUEUE: "dispatch-engine queue wait before a wave",
+    WF_DEVICE_COMPUTE: "fenced device execution (jit dispatch → ready)",
+    WF_TRANSFER_DECODE: "device→host transfer and result decode",
+    WF_REDUCE: "host-side shard-result reduction",
+    WF_OTHER: "unattributed host time (total − measured legs)",
+}
+
+# span-stage → waterfall-bucket mapping. Every key of metrics.STAGES
+# must appear here (tests/test_profiling.py enforces completeness both
+# ways), so a new span stage can't silently fall outside the taxonomy.
+WATERFALL_OF: dict = {
+    "query": WF_OTHER,
+    "pipeline.wait": WF_PIPELINE_QUEUE,
+    "pipeline.coalesce": WF_PIPELINE_QUEUE,
+    "plan.canon": WF_PLAN_CANON,
+    "executor": WF_OTHER,
+    "executor.call": WF_OTHER,
+    "executor.map_shard": WF_OTHER,
+    "executor.route": WF_OTHER,
+    "executor.device_batch": WF_DEVICE_COMPUTE,
+    "spmd.kernel": WF_DEVICE_COMPUTE,
+    "batcher.score": WF_DEVICE_COMPUTE,
+    "stager.stage": WF_STAGER,
+    "stager.delta_apply": WF_STAGER,
+    "dispatch.dedup": WF_DISPATCH_QUEUE,
+    "cluster.map_remote": WF_OTHER,
+    "cluster.map_local": WF_OTHER,
+    "multihost.gang": WF_DEVICE_COMPUTE,
+    "multihost.replay": WF_OTHER,
+}
+
+
+# Per-request attribution accumulator: a plain ``{bucket: seconds}``
+# dict in a contextvar. Always-on for served queries (api.query installs
+# one), absent for bare executor calls — every instrumentation site is
+# one contextvar get + None check, and dict float adds under the GIL at
+# worst lose an increment, which telemetry tolerates. Like spans, pool
+# submitters capture the dict once and re-enter it in the worker.
+_attrib: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
+    "pilosa_tpu_attrib", default=None
+)
+
+
+def attrib_current() -> Optional[dict]:
+    """The active attribution dict, or None when attribution is off."""
+    return _attrib.get()
+
+
+def attrib_add(stage: str, seconds: float) -> None:
+    """Credit ``seconds`` to a waterfall bucket of the active request;
+    no-op (one contextvar get) when attribution is off."""
+    d = _attrib.get()
+    if d is not None:
+        d[stage] = d.get(stage, 0.0) + seconds
+
+
+class _AttribActivation:
+    """Install (or re-enter) an attribution dict for a scope — the
+    request root passes a fresh dict, pool/wave workers pass the
+    submitter's captured dict, and ``None`` explicitly disables
+    attribution inside the scope."""
+
+    __slots__ = ("_d", "_token")
+
+    def __init__(self, d: Optional[dict]) -> None:
+        self._d = d
+        self._token = None
+
+    def __enter__(self) -> Optional[dict]:
+        self._token = _attrib.set(self._d)
+        return self._d
+
+    def __exit__(self, *exc) -> bool:
+        if self._token is not None:
+            _attrib.reset(self._token)
+            self._token = None
+        return False
+
+
+def attrib_activate(d: Optional[dict]) -> _AttribActivation:
+    return _AttribActivation(d)
+
+
+# -- dispatch wave id ---------------------------------------------------------
+#
+# The wave number of the dispatch-engine wave currently executing on
+# this thread; the logger's correlation suffix appends it (``wave=N``)
+# so log lines join against waterfall/trace output.
+
+_wave_var: contextvars.ContextVar[int] = contextvars.ContextVar(
+    "pilosa_tpu_wave", default=0
+)
+
+
+def current_wave() -> int:
+    return _wave_var.get()
+
+
+def set_wave(wave_no: int):
+    """Set the active dispatch wave id; returns the reset token."""
+    return _wave_var.set(wave_no)
+
+
+def reset_wave(token) -> None:
+    _wave_var.reset(token)
