@@ -220,6 +220,17 @@ def window_quality(tall: dict):
             chain_headline_mode=chain_mode,
             chain_pipelining_depth=round(chain_qps * rtt_ms / 1000.0, 2),
         )
+    # fused-execution window (ISSUE 13): how many device RTTs a warm
+    # fused multi-call query costs end to end, and that it really ran
+    # as ONE launch. Carried so window_degraded can reject a run where
+    # fusion regressed to per-call round trips.
+    fr = prof.get("fused_rtt") or {}
+    fm = fr.get("rtt_multiple")
+    if isinstance(fm, (int, float)) and fm > 0:
+        out["fused_rtt_multiple"] = fm
+        fl = fr.get("fused_launches_per_query")
+        if isinstance(fl, (int, float)):
+            out["fused_launches_per_query"] = fl
     return out
 
 
@@ -257,6 +268,22 @@ def window_degraded(new_wq, old_wq):
             return True, (
                 f"chain pipelining depth {new_cd:.2f} < "
                 f"{DEGRADED_DEPTH_FACTOR}x last-good {old_cd:.2f}"
+            )
+    # symmetric fused-window check (ISSUE 13): once a last-good run has
+    # proven one-launch multi-call execution, a run whose fused query
+    # costs many more RTTs (fusion off / regressed to per-call round
+    # trips) — or that didn't measure it — must not displace it
+    old_fm = old_wq.get("fused_rtt_multiple")
+    if old_fm:
+        new_fm = new_wq.get("fused_rtt_multiple")
+        if not new_fm:
+            return True, (
+                "no fused-query window measured this run (last-good has one)"
+            )
+        if new_fm > old_fm * DEGRADED_RTT_FACTOR:
+            return True, (
+                f"fused query costs {new_fm:.2f} RTTs > "
+                f"{DEGRADED_RTT_FACTOR}x last-good {old_fm:.2f}"
             )
     return False, None
 

@@ -450,6 +450,35 @@ def run(deadline_s: float = 1e9) -> dict:
                         chain_wf, one_chain_ms / 1000.0
                     ),
                 }
+                # fused_rtt (ISSUE 13): a warm multi-call read query must
+                # execute as ONE fused launch, so its sequential p50
+                # target is ~1 device round-trip including result
+                # delivery.  Measure a 3-chain query end to end and
+                # record how many RTTs it costs; window_quality carries
+                # the multiple forward and window_degraded rejects a run
+                # where fusion regressed to per-call round trips.
+                if remaining() > 10:
+                    fused_q = "".join(chains[:3])
+                    fuser = getattr(dev, "fuser", None)
+                    dev.execute("tall", fused_q)  # warm the fused program
+                    l0 = fuser.fused_launches if fuser is not None else 0
+                    times = []
+                    for _ in range(7):
+                        t0 = time.perf_counter()
+                        dev.execute("tall", fused_q)
+                        times.append((time.perf_counter() - t0) * 1000)
+                    times.sort()
+                    one_query_ms = times[len(times) // 2]
+                    l1 = fuser.fused_launches if fuser is not None else 0
+                    out["profile"]["fused_rtt"] = {
+                        "calls": 3,
+                        "one_query_ms": round(one_query_ms, 2),
+                        "fused_launches_per_query": round((l1 - l0) / 7.0, 2),
+                        "rtt_multiple": round(one_query_ms / max(rtt_ms, 1e-9), 2),
+                        "chain_rtt_multiple": round(
+                            one_chain_ms / max(rtt_ms, 1e-9), 2
+                        ),
+                    }
             except Exception as e:  # profile is best-effort telemetry
                 out["profile"] = {"error": f"{type(e).__name__}: {e}"}
         # CPU full-path baseline on a small sample (labelled: this is

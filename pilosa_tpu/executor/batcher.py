@@ -45,14 +45,42 @@ def _next_pow2(n: int) -> int:
     return p
 
 
-class _Slot:
-    __slots__ = ("src", "event", "result", "error")
+def _trim_device(dev, rows: Optional[int] = None, cols: Optional[int] = None):
+    """Slice a still-on-device score array down to what callers will
+    read, so the subsequent fetch only moves live lanes/columns.
 
-    def __init__(self, src) -> None:
+    Lazy-slicing a jax array is a cheap device op; anything without an
+    ``ndim`` (or an unexpected rank — the chain scorer's batch output
+    is 1-D) passes through untouched.
+    """
+    try:
+        nd = dev.ndim
+    except AttributeError:
+        return dev
+    if nd == 1:
+        if rows is not None:
+            dev = dev[:rows]
+        return dev
+    if nd == 2:
+        if rows is not None:
+            dev = dev[:rows]
+        if cols is not None:
+            dev = dev[:, :cols]
+    return dev
+
+
+class _Slot:
+    __slots__ = ("src", "event", "result", "error", "trim")
+
+    def __init__(self, src, trim: Optional[int] = None) -> None:
         self.src = src
         self.event = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+        # rows of the score vector the caller will actually read (the
+        # staged matrix is pow2-padded); set ⇒ _launch trims on device
+        # before the fetch so pad lanes never cross the host boundary
+        self.trim = trim
 
     def finish(self, scorer: "BatchedScorer" = None) -> np.ndarray:
         if scorer is None:
@@ -116,7 +144,7 @@ class BatchedScorer:
         self.dispatches = 0
         self.batched_queries = 0
 
-    def score(self, key: tuple, mat, src) -> np.ndarray:
+    def score(self, key: tuple, mat, src, trim: Optional[int] = None) -> np.ndarray:
         """popcount(src & row) per matrix row → i32[R].
 
         key MUST be derived from the live staged array's identity
@@ -141,7 +169,7 @@ class BatchedScorer:
         sp = trace.current()
         attrib = trace.attrib_current()
         t0 = time.monotonic()
-        slot = _Slot(src)
+        slot = _Slot(src, trim=trim)
         with self._lock:
             ent = self._pending.get(key)
             if ent is None:
@@ -298,7 +326,7 @@ class BatchedScorer:
             metrics.observe(metrics.BATCHER_BATCH_SIZE, len(batch))
             if len(batch) == 1:
                 launched.append(
-                    (batch, self._single_fn(batch[0].src, mat))
+                    (batch, _trim_device(self._single_fn(batch[0].src, mat), rows=batch[0].trim))
                 )
                 return launched
             for start in range(0, len(batch), self.max_batch):
@@ -318,7 +346,14 @@ class BatchedScorer:
                         if zero is None:
                             zero = self._pad_zeros[zkey] = jnp.zeros_like(proto)
                         srcs = srcs + [zero] * (q - len(chunk))
-                launched.append((chunk, self._batch_fn(srcs, mat)))
+                dev = self._batch_fn(srcs, mat)
+                # transfer hygiene: pad query lanes never reach the
+                # host, and when every slot declared its read width the
+                # score columns trim device-side too (the fetch then
+                # moves exactly what the callers will consume)
+                trims = [s.trim for s in chunk]
+                keep = max(trims) if all(t is not None for t in trims) else None
+                launched.append((chunk, _trim_device(dev, rows=len(chunk), cols=keep)))
             return launched
         except BaseException as e:
             for s in batch:

@@ -516,4 +516,9 @@ class DispatchEngine:
                 "fallbacks": self.fallbacks,
                 "deadline_expired": self.expired,
                 "device_idle_fraction": self._idle_fraction_locked(),
+                "fusion": (
+                    self.executor.fuser.stats()
+                    if getattr(self.executor, "fuser", None) is not None
+                    else {"enabled": False}
+                ),
             }

@@ -309,6 +309,9 @@ class Executor:
         dispatch_max_wave: int = 16,
         dispatch_max_inflight: int = 2,
         dispatch_stage_ahead: int = 1,
+        fusion_enabled: Optional[bool] = None,
+        fusion_max_calls: int = 64,
+        plan_cache_device_bytes: Optional[int] = None,
     ) -> None:
         self.holder = holder
         self.cluster = cluster  # None = single-node
@@ -411,6 +414,35 @@ class Executor:
             )
         else:
             self.dispatch_engine = None
+        # whole-query device fusion (fusion.py): multi-call read queries
+        # — and the multi-call Queries the dispatch engine combines a
+        # wave into — lower to ONE jitted program, intermediates stay in
+        # HBM, only final scalars/score heads transfer. PILOSA_FUSION=0
+        # turns it off for bare executors (benches A/B it); the server
+        # passes its fusion-* knobs explicitly.
+        if fusion_enabled is None:
+            fusion_enabled = os.environ.get("PILOSA_FUSION", "1") != "0"
+        if fusion_enabled:
+            from pilosa_tpu.executor.fusion import QueryFuser
+
+            self.fuser = QueryFuser(self, max_calls=fusion_max_calls)
+        else:
+            self.fuser = None
+        # device-resident plan cache (plan/cache.py DevicePlanCache):
+        # __cached subtree stacks stay in HBM instead of round-tripping
+        # through host Row decode + re-pack + re-upload. 0 disables;
+        # single-device only (mesh placement differs — gated at the
+        # probe site in _device_bitmap_stack).
+        if plan_cache_device_bytes is None:
+            plan_cache_device_bytes = int(
+                os.environ.get("PILOSA_PLAN_CACHE_DEVICE_BYTES", 256 << 20)
+            )
+        if plan_cache_device_bytes > 0 and self.plan_cache is not None:
+            from pilosa_tpu.plan.cache import DevicePlanCache
+
+            self.device_cache = DevicePlanCache(plan_cache_device_bytes)
+        else:
+            self.device_cache = None
         # compiled shard_map kernels keyed by (kind, static args) — the
         # closures in spmd.py are rebuilt per call, so cache here to keep
         # XLA's jit cache effective across queries
@@ -570,7 +602,24 @@ class Executor:
                     self, index_name, query.calls, shards, opt
                 )
             trace.attrib_add(trace.WF_PLAN_CANON, time.monotonic() - t0_cse)
-        if len(calls) > 1 and query.write_call_n() == 0 and not opt.serial:
+        # whole-query fusion (fusion.py): lower the fusable calls of a
+        # multi-call read into ONE jitted launch; residual calls fall
+        # through to the per-call paths below and results merge
+        # positionally. Gang/serial/remote/cluster legs bypass inside
+        # try_execute, mirroring the dispatch-engine contract.
+        fused: dict[int, Any] = {}
+        if (
+            self.fuser is not None
+            and len(calls) > 1
+            and query.write_call_n() == 0
+            and not opt.serial
+            and shards
+        ):
+            fused = self.fuser.try_execute(index_name, calls, shards, opt) or {}
+        run_calls = (
+            [c for i, c in enumerate(calls) if i not in fused] if fused else calls
+        )
+        if len(run_calls) > 1 and query.write_call_n() == 0 and not opt.serial:
             # An all-read request has no cross-call ordering constraints
             # (the reference runs calls serially, executor.go:126-145,
             # but read results are order-independent); running them
@@ -589,16 +638,21 @@ class Executor:
             if pool is None:
                 # close() in progress: run serially inline instead of
                 # racing a shutting-down pool
-                results = [run_call(c) for c in calls]
+                results = [run_call(c) for c in run_calls]
             else:
                 try:
-                    results = list(pool.map(run_call, calls))
+                    results = list(pool.map(run_call, run_calls))
                 finally:
                     self._read_pool_release()
         else:
             results = []
-            for call in calls:
+            for call in run_calls:
                 results.append(self._execute_call(index_name, call, shards, opt))
+        if fused:
+            it = iter(results)
+            results = [
+                fused[i] if i in fused else next(it) for i in range(len(calls))
+            ]
         if self.translate_store is not None and not opt.remote:
             results = [
                 self._translate_result(index_name, idx, call, r)
@@ -720,6 +774,10 @@ class Executor:
         if self.plan_cache is not None:
             # results computed by the wedged device must not outlive it
             self.plan_cache.epoch_reset()
+        if self.device_cache is not None:
+            # ditto for HBM-resident arrays: handles created by the dead
+            # runtime may be invalid
+            self.device_cache.epoch_reset()
 
     def _execute_call(self, index, c: Call, shards, opt) -> Any:
         metrics.count(metrics.EXECUTOR_CALLS, call=c.name)
@@ -1381,6 +1439,30 @@ class Executor:
         """Lower a bitmap call subtree to u32[S, W] across shards."""
         name = c.name
         if name == "__cached":
+            # device-resident plan cache: serve the packed stack from
+            # HBM instead of re-packing + re-uploading the Row the
+            # device just produced. Keyed by the subtree's canonical
+            # hash; validated against the CURRENT generation vector
+            # (the planner froze the insert stamp BEFORE resolving the
+            # row, so a racing write can only over-invalidate). Mesh
+            # runs skip it — stacks there are mesh-sharded and a plain
+            # device_put array would be wrongly placed.
+            dc = self.device_cache
+            g0 = c.args.get("_genvec")
+            gvfn = c.args.get("_gv")
+            if dc is not None and g0 is not None and gvfn is not None and self.mesh is None:
+                dkey = (index, c.args["_h"], tuple(shards))
+                hit = dc.get(dkey, gvfn)
+                if hit is not None:
+                    return hit
+                stack = np.stack([self._cached_words(c, s) for s in shards])
+                epoch0 = dc.epoch
+                try:
+                    dev = self.stager.upload(stack)
+                except Exception:
+                    return stack  # upload failed: host stack still works
+                dc.put(dkey, g0, dev, int(stack.nbytes), epoch0=epoch0)
+                return dev
             return np.stack([self._cached_words(c, s) for s in shards])
         if name == "Row":
             field_name = c.field_arg()
@@ -1748,7 +1830,9 @@ class Executor:
 
     # -- TopN (reference executeTopN two-pass, executor.go:521-585) ----------
 
-    def _execute_topn(self, index, c: Call, shards, opt) -> list[dict]:
+    def _execute_topn(
+        self, index, c: Call, shards, opt, prescored=None
+    ) -> list[dict]:
         ids_arg, _ = c.uint_slice_arg("ids")
         n, _ = c.uint_arg("n")
         # (shard, row_id) -> exact intersection count, filled by pass 1's
@@ -1757,7 +1841,9 @@ class Executor:
         # needs no device round-trip at all — on a tunneled chip that is
         # half the query's wall clock
         carry = _ScoreCarry()
-        pairs = self._execute_topn_shards(index, c, shards, opt, carry)
+        pairs = self._execute_topn_shards(
+            index, c, shards, opt, carry, prescored=prescored
+        )
         if not pairs or ids_arg or opt.remote:
             return _pairs_result(pairs)
         # Pass 2: re-query the union of candidate ids for exact counts.
@@ -1769,13 +1855,19 @@ class Executor:
         return _pairs_result(trimmed)
 
     def _execute_topn_shards(
-        self, index, c: Call, shards, opt, carry=None
+        self, index, c: Call, shards, opt, carry=None, prescored=None
     ) -> list[tuple[int, int]]:
         if (
             self._local_batchable(opt)
             and shards
             and len(c.children) == 1
-            and self._use_device_batched(index, c, shards)
+            # a fused launch already scored the head chunk on device —
+            # honor it regardless of the (re-evaluated) auto crossover,
+            # so the prescore is never discarded by a borderline flip
+            and (
+                prescored is not None
+                or self._use_device_batched(index, c, shards)
+            )
         ):
             try:
                 with trace.child(metrics.STAGE_DEVICE_BATCH, call="TopN"):
@@ -1784,7 +1876,9 @@ class Executor:
                             self._topn_shards_spmd(index, c, shards, carry)
                         )
                     return sort_pairs(
-                        self._topn_shards_batched(index, c, shards, carry)
+                        self._topn_shards_batched(
+                            index, c, shards, carry, prescored=prescored
+                        )
                     )
             except _NotDeviceable:
                 pass
@@ -1796,7 +1890,7 @@ class Executor:
         return sort_pairs(result or [])
 
     def _topn_shards_batched(
-        self, index, c: Call, shards, carry=None
+        self, index, c: Call, shards, carry=None, prescored=None
     ) -> list[tuple[int, int]]:
         """Single-device cross-shard TopN: every shard's candidate
         scoring lands in ONE chunked kernel dispatch over the merged
@@ -1820,12 +1914,21 @@ class Executor:
         if min_threshold <= 0:
             min_threshold = DEFAULT_MIN_THRESHOLD
 
-        frags = tuple(
-            self.holder.fragment(index, field, VIEW_STANDARD, s) for s in shards
-        )
-        pairs_by_shard = [
-            f._top_bitmap_pairs(row_ids) if f is not None else [] for f in frags
-        ]
+        if prescored is not None:
+            # fused whole-query launch already staged + scored the head
+            # chunk: reuse ITS fragment/pairs snapshot (the injected
+            # matrix and the walk must agree on candidate order) and
+            # its resolved source stack
+            frags, pairs_by_shard, ids0, mat0, srcs0 = prescored
+        else:
+            frags = tuple(
+                self.holder.fragment(index, field, VIEW_STANDARD, s)
+                for s in shards
+            )
+            pairs_by_shard = [
+                f._top_bitmap_pairs(row_ids) if f is not None else []
+                for f in frags
+            ]
         if not any(pairs_by_shard):
             return []
         # lazy: a pass 2 fully covered by the carry never resolves the
@@ -1834,10 +1937,24 @@ class Executor:
             self,
             frags,
             pairs_by_shard,
-            lambda: self._device_bitmap_stack(index, c.children[0], shards),
+            (
+                srcs0
+                if prescored is not None
+                else lambda: self._device_bitmap_stack(
+                    index, c.children[0], shards
+                )
+            ),
             shards=shards,
             carry=carry,
         )
+        if prescored is not None:
+            # inject the fused head as chunk 0; the walk continues from
+            # _chunk_size(FIRST_CHUNK) exactly as the unfused schedule
+            # would, so chunk boundaries (and staging keys) match
+            provider._mats.append(mat0)
+            provider._chunk_meta.append((0, mat0.shape[1], ids0))
+            provider._pos = mat0.shape[1]
+            provider._publish(ids0, mat0)
         opt_ = TopOptions(
             n=int(n),
             src=None,
@@ -2430,12 +2547,13 @@ class _SpmdLazyScores(_ChunkedLazyScores):
 
     def _score(self, staged, size: int):
         blocks, brow, bslot = staged
-        scores = _fetch(
-            self._ex._spmd_kernel("topn_scores_sparse", size)(
-                self._resolved_srcs(), blocks, brow, bslot
-            )
+        dev = self._ex._spmd_kernel("topn_scores_sparse", size)(
+            self._resolved_srcs(), blocks, brow, bslot
         )
-        return scores[: len(self._frags), :size]
+        # trim BEFORE the fetch: the shard axis is mesh-padded, so
+        # slicing on device transfers only the real shards' scores
+        # instead of fetching the padded plan and slicing on host
+        return _fetch(dev[: len(self._frags), :size])
 
 
 class _LazyScores:
@@ -2482,11 +2600,13 @@ class _LazyScores:
         occupied = frag.sparse_block_count(list(ids))
         if occupied * 2 < len(ids) * (SHARD_WIDTH >> 16):
             blocks, brow, bslot, num_rows = self._ex.stager.sparse_rows(frag, ids)
-            scores = _fetch(
-                ops.sparse_intersection_counts(
-                    self._src, blocks, brow, bslot, num_rows
-                )
-            )[: len(ids)]
+            dev = ops.sparse_intersection_counts(
+                self._src, blocks, brow, bslot, num_rows
+            )
+            # trim on device: num_rows is pow2-padded, so fetching the
+            # full vector and slicing on host transfers up to 2x the
+            # real candidate scores
+            scores = _fetch(dev[: len(ids)])
         else:
             # pow2-padded rows bound recompiles; trailing zero rows fall
             # off the zip below. Key on the staged array identity (not
@@ -2494,7 +2614,9 @@ class _LazyScores:
             # between staging and here): same live array object ⇔ same
             # snapshot, so coalesced peers can never mix matrices.
             mat = self._ex.stager.rows(frag, ids, pad_pow2=True)
-            scores = self._ex.scorer.score((id(frag), id(mat)), mat, self._src)
+            scores = self._ex.scorer.score(
+                (id(frag), id(mat)), mat, self._src, trim=len(ids)
+            )
         self._scores.update(zip(ids, (int(s) for s in scores)))
         if self._carry is not None:
             self._carry.add(self._shard, ids, scores)

@@ -1,0 +1,449 @@
+"""Whole-query device fusion — one lowered program per multi-call read.
+
+The serving path is transport-bound, not compute-bound: a warm 3-op
+chain spends ~71 ms of its ~77 ms p50 crossing the host↔device boundary
+while the device computes in single-digit milliseconds
+(BENCH_last_good.json, chain_rtt_fraction 1.0). The per-call executor
+pays that boundary once per call: each Count/Sum/TopN in a multi-call
+query — and every query a dispatch wave coalesces into one combined
+Query — launches its own kernel and fetches its own result.
+
+This module collapses that to ONE jitted program per query: every
+fusable call lowers to a unit (Count → popcount-of-tree, Sum → BSI
+plane counts, TopN → head-chunk candidate scoring), the units trace
+into a single XLA program keyed by the tuple of unit descriptors (the
+canonical plan/canon signatures of the lowered trees), and one fenced
+launch returns only the final scalars / count vectors / score heads.
+Intermediates — folded bitmaps, BSI planes, candidate blocks — never
+leave HBM. Because the dispatch engine's wave combiner already routes a
+wave's items through ``Executor._execute`` as one multi-call Query,
+wave fusion falls out of the same hook: a wave of N coalesced queries
+costs one launch, with per-item results split positionally on host
+from the per-call outputs.
+
+Determinism contract (PR 5/6): gang, cluster, remote, and serial
+execution bypass fusion exactly as they bypass the dispatch engine —
+the per-call paths those legs rely on are untouched. Bit-identity:
+every unit reuses the SAME kernels and host finishers as the per-call
+device path (the TopN head matrix is injected as the walk's first
+chunk, then the existing ranked walk runs unchanged), so fused results
+are bit-identical to both the unfused device path and the CPU oracle.
+
+Calls that cannot lower (Min/Max, bitmap-valued top-level calls,
+tanimoto TopN, non-deviceable subtrees) stay on the classic per-call
+path; the fuser serves the rest and ``_execute`` merges positionally.
+Any failure inside the fuser degrades to the classic path — reads are
+pure, so re-execution is always safe.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from pilosa_tpu.utils import metrics, trace
+
+# Deliberately a module-load import (executor.py only imports this
+# module lazily, inside Executor.__init__, so there is no cycle): the
+# fuser reuses the executor's lowering helpers and kernels verbatim —
+# that shared code is the bit-identity argument.
+from pilosa_tpu.executor import executor as _ex
+from pilosa_tpu.executor.executor import (
+    FIRST_CHUNK,
+    ValCount,
+    _chunk_ids,
+    _fetch,
+    _timed_kernel,
+)
+from pilosa_tpu import ops
+from pilosa_tpu.core import VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD
+
+# call names the fuser can lower; everything else is residual
+_FUSABLE = ("Count", "Sum", "TopN")
+
+
+class _Unit:
+    """One lowered call: a static descriptor (part of the program key),
+    the device input arrays consumed at the descriptor's flat offset,
+    and a host finisher mapping the fetched output to the call result."""
+
+    __slots__ = ("call_index", "desc", "inputs", "finish")
+
+    def __init__(self, call_index: int, desc, inputs, finish) -> None:
+        self.call_index = call_index
+        self.desc = desc
+        self.inputs = inputs
+        self.finish = finish
+
+
+class QueryFuser:
+    """Lowers the fusable calls of one read query into a single jitted
+    program. Owned by an Executor; invoked from ``_execute`` after the
+    CSE rewrite, before the per-call fan-out."""
+
+    def __init__(self, ex, max_calls: int = 64) -> None:
+        self.ex = ex
+        self.max_calls = int(max_calls)
+        # program cache: (unit descriptors, input shapes) -> timed jit.
+        # Bounded by distinct fused query shapes, like _tree_jits.
+        self._programs: dict = {}
+        self._mu = threading.Lock()
+        # telemetry (monotonic counters, read by stats()/bench)
+        self.fused_launches = 0
+        self.fused_calls = 0
+        self.cache_served = 0
+        self.bytes_returned = 0
+        self.bypasses: dict[str, int] = {}
+
+    # -- eligibility ---------------------------------------------------------
+
+    def _bypass(self, reason: str) -> None:
+        self.bypasses[reason] = self.bypasses.get(reason, 0) + 1
+        metrics.count(metrics.FUSION_BYPASSES, reason=reason)
+
+    def try_execute(
+        self, index: str, calls, shards, opt
+    ) -> Optional[dict[int, Any]]:
+        """Results for the call positions this fuser served (fused
+        launch or plan-cache hit), or None/{} when everything should
+        take the classic path. Never raises: reads are pure, so any
+        internal failure degrades to per-call re-execution."""
+        ex = self.ex
+        if ex.gang is not None or ex.cluster is not None:
+            self._bypass("topology")
+            return None
+        if ex.mesh is not None:
+            # the SPMD path fuses per call via shard_map; whole-query
+            # fusion across a mesh is future work
+            self._bypass("mesh")
+            return None
+        if opt.remote or opt.serial:
+            self._bypass("opt")
+            return None
+        if ex.device_policy == "never" or ex._cpu_forced():
+            self._bypass("cpu")
+            return None
+        if not shards:
+            self._bypass("no_shards")
+            return None
+        if len(calls) > self.max_calls:
+            self._bypass("too_many_calls")
+            return None
+        candidates = [
+            (i, c) for i, c in enumerate(calls) if c.name in _FUSABLE
+        ]
+        if len(candidates) < 2:
+            self._bypass("too_few_calls")
+            return None
+        if ex.device_policy != "always":
+            # auto crossover on the AGGREGATE: the whole point of fusion
+            # is that N calls share one dispatch, so the per-call
+            # container estimate sums across the query before comparing
+            # against the device crossover
+            try:
+                total = sum(
+                    ex._touched_containers(index, c, s)
+                    for _, c in candidates
+                    for s in shards
+                )
+            except Exception:
+                total = 0
+            if total < ex.auto_min_containers:
+                self._bypass("auto_policy")
+                return None
+        try:
+            return self._run(index, calls, candidates, shards, opt)
+        except Exception:
+            # includes DeviceDown from the health guard: the gate is now
+            # tripped, so the classic path re-runs these reads on CPU
+            self._bypass("error")
+            return {}
+
+    # -- probe + lower + launch ---------------------------------------------
+
+    def _run(self, index, calls, candidates, shards, opt) -> dict[int, Any]:
+        ex = self.ex
+        pc = ex.plan_cache if opt.cache else None
+        out: dict[int, Any] = {}
+        # plan-cache probe per candidate; capture (key, genvec, epoch)
+        # BEFORE any build so fused inserts keep the over-invalidation
+        # race direction (plan/cache.py module docstring)
+        cacheinfo: dict[int, tuple] = {}
+        lower = []
+        for i, c in candidates:
+            if pc is not None and ex._local_batchable(opt):
+                from pilosa_tpu.plan import planner
+
+                keyinfo = planner.call_cache_key(ex, index, c, shards, opt)
+                if keyinfo is not None:
+                    key, gvfn = keyinfo
+                    genvec = gvfn()
+                    hit = pc.get(key, gvfn)
+                    if hit is not None:
+                        out[i] = hit
+                        self.cache_served += 1
+                        continue
+                    cacheinfo[i] = (key, genvec, pc.epoch)
+            lower.append((i, c))
+        if not lower:
+            return out
+        parent = trace.current()
+        attrib = trace.attrib_current()
+
+        def fused():
+            # guard-pool thread: hand over span + waterfall accumulator
+            with trace.activate(parent), trace.attrib_activate(attrib):
+                return self._lower_and_launch(index, lower, shards, opt)
+
+        if ex.health is not None:
+            served = ex.health.guard(fused)
+        else:
+            served = fused()
+        for i, result, cost in served:
+            out[i] = result
+            info = cacheinfo.get(i)
+            if info is not None and pc is not None:
+                key, genvec, epoch0 = info
+                pc.put(key, genvec, result, cost=cost, epoch0=epoch0)
+        return out
+
+    def _lower_and_launch(self, index, lower, shards, opt) -> list[tuple]:
+        ex = self.ex
+        units: list[_Unit] = []
+        for i, c in lower:
+            try:
+                if c.name == "Count":
+                    u = self._lower_count(index, i, c, shards)
+                elif c.name == "Sum":
+                    u = self._lower_sum(index, i, c, shards)
+                else:
+                    u = self._lower_topn(index, i, c, shards, opt)
+            except Exception:
+                # malformed args / missing fields / _NotDeviceable: the
+                # classic path owns producing the (identical) error
+                u = None
+            if u is not None:
+                units.append(u)
+        launch = [u for u in units if u.desc is not None]
+        if len(launch) < 2:
+            # a single device call gains nothing over the per-call
+            # batched path; keep classic routing (and its telemetry)
+            self._bypass("too_few_fusable")
+            zero_only = [u for u in units if u.desc is None]
+            return [(u.call_index, u.finish(None), 0.0) for u in zero_only]
+        flat: list = []
+        descs: list = []
+        for u in launch:
+            descs.append(u.desc)
+            flat.extend(u.inputs)
+        shapes = tuple(
+            (tuple(getattr(a, "shape", ())), str(getattr(a, "dtype", "")))
+            for a in flat
+        )
+        fn = self._program(tuple(descs), shapes)
+        t0 = time.monotonic()
+        with trace.child(metrics.STAGE_DEVICE_BATCH, call="Fused"):
+            outs = fn(*flat)
+            fetched = [_fetch(o) for o in outs]
+        dt = time.monotonic() - t0
+        nbytes = sum(int(o.nbytes) for o in fetched)
+        self.fused_launches += 1
+        self.fused_calls += len(units)
+        self.bytes_returned += nbytes
+        metrics.count(metrics.FUSION_FUSED_LAUNCHES)
+        metrics.observe(metrics.FUSION_FUSED_CALLS_PER_LAUNCH, len(units))
+        metrics.count(metrics.FUSION_BYTES_RETURNED, nbytes)
+        cost = dt / max(len(units), 1)
+        served: list[tuple] = []
+        k = 0
+        for u in units:
+            if u.desc is None:
+                served.append((u.call_index, u.finish(None), cost))
+            else:
+                served.append((u.call_index, u.finish(fetched[k]), cost))
+                k += 1
+        return served
+
+    # -- per-call lowering ---------------------------------------------------
+
+    def _lower_count(self, index, i, c, shards) -> Optional[_Unit]:
+        if len(c.children) != 1:
+            return None
+        leaves, tree = self.ex._tree_leaves(index, c.children[0], shards)
+        return _Unit(
+            i,
+            ("count", tree, len(leaves)),
+            tuple(leaves),
+            lambda res: int(np.asarray(res).reshape(-1)[0]),
+        )
+
+    def _lower_sum(self, index, i, c, shards) -> Optional[_Unit]:
+        ex = self.ex
+        field_name, ok = c.string_arg("field")
+        if not ok or not field_name or len(c.children) > 1:
+            return None
+        f = ex.holder.field(index, field_name)
+        bsig = f.bsi_group(field_name) if f is not None else None
+        if bsig is None:
+            return None
+        depth = bsig.bit_depth()
+        frags = tuple(
+            ex.holder.fragment(
+                index, field_name, VIEW_BSI_GROUP_PREFIX + field_name, s
+            )
+            for s in shards
+        )
+        if not any(frags):
+            return None
+        if len(c.children) == 1:
+            filt = ex._device_bitmap_stack(index, c.children[0], shards)
+            has_filter = True
+        else:
+            filt = np.zeros((len(shards), _ex._W32), dtype=np.uint32)
+            has_filter = False
+        planes = ex.stager.planes_stack(frags, depth)
+
+        def finish(counts):
+            vsum = sum(int(counts[j]) << j for j in range(depth))
+            vcount = int(counts[depth])
+            if vcount == 0:
+                return ValCount()
+            return ValCount(vsum + vcount * bsig.min, vcount)
+
+        return _Unit(i, ("sum", depth, has_filter), (planes, filt), finish)
+
+    def _lower_topn(self, index, i, c, shards, opt) -> Optional[_Unit]:
+        ex = self.ex
+        if len(c.children) != 1:
+            return None
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+        if tanimoto > 0:
+            return None  # tanimoto pruning needs per-shard CPU counts
+        field, ok = c.string_arg("_field")
+        if not ok:
+            return None
+        row_ids, _ = c.uint_slice_arg("ids")
+        frags = tuple(
+            ex.holder.fragment(index, field, VIEW_STANDARD, s) for s in shards
+        )
+        pairs_by_shard = [
+            f._top_bitmap_pairs(row_ids) if f is not None else [] for f in frags
+        ]
+        if not any(pairs_by_shard):
+            return None  # classic path answers [] with no device work
+        size = FIRST_CHUNK
+        ids_by_shard = tuple(_chunk_ids(ps, 0, size) for ps in pairs_by_shard)
+        srcs = ex._device_bitmap_stack(index, c.children[0], shards)
+        staged = ex.stager.sparse_rows_stacked(frags, ids_by_shard, size)
+        n_shards = len(shards)
+
+        def finish(mat):
+            if mat is None:  # no shard contributed blocks: all score 0
+                mat = np.zeros((n_shards, size), dtype=np.int32)
+            # inject the fused head as the walk's first chunk; the
+            # existing two-pass ranked walk then runs unchanged — the
+            # bit-identity argument for fused TopN
+            return ex._execute_topn(
+                index,
+                c,
+                shards,
+                opt,
+                prescored=(frags, pairs_by_shard, ids_by_shard, mat, srcs),
+            )
+
+        if staged is None:
+            return _Unit(i, None, (), finish)
+        blocks, brow, bslot, bshard, num_rows = staged
+        return _Unit(
+            i,
+            ("topn", num_rows, n_shards, size),
+            (srcs, blocks, brow, bslot, bshard),
+            finish,
+        )
+
+    # -- the fused program ---------------------------------------------------
+
+    def _program(self, descs: tuple, shapes: tuple):
+        key = (descs, shapes)
+        with self._mu:
+            fn = self._programs.get(key)
+        if fn is None:
+            import jax
+
+            fn = _timed_kernel(
+                "fused_query", jax.jit(_build_program(descs)), signature=key
+            )
+            with self._mu:
+                self._programs.setdefault(key, fn)
+                fn = self._programs[key]
+        return fn
+
+    def stats(self) -> dict:
+        ex = self.ex
+        launches = self.fused_launches
+        return {
+            "enabled": True,
+            "max_calls": self.max_calls,
+            "fused_launches": launches,
+            "fused_calls": self.fused_calls,
+            "avg_calls_per_launch": (
+                round(self.fused_calls / launches, 2) if launches else None
+            ),
+            "bytes_returned": self.bytes_returned,
+            "cache_served": self.cache_served,
+            "programs": len(self._programs),
+            "bypasses": dict(self.bypasses),
+            "device_cache": (
+                ex.device_cache.stats()
+                if ex.device_cache is not None
+                else {"enabled": False}
+            ),
+        }
+
+
+def _build_program(descs: tuple):
+    """The traced body of one fused query: consumes the flat input list
+    by per-unit offset and returns one output per unit. Pure — traced
+    under jax.jit, so no host effects (lint: jit-purity)."""
+
+    def run(*flat):
+        outs = []
+        off = 0
+        for d in descs:
+            kind = d[0]
+            if kind == "count":
+                tree, nleaves = d[1], d[2]
+                leaves = flat[off : off + nleaves]
+                off += nleaves
+                outs.append(ops.count_bits(_ex._eval_tree(tree, leaves))[None])
+            elif kind == "sum":
+                depth, has_filter = d[1], d[2]
+                planes, filt = flat[off], flat[off + 1]
+                off += 2
+                outs.append(
+                    ops.bsi_plane_counts_batched(
+                        planes, filt, bit_depth=depth, has_filter=has_filter
+                    )
+                )
+            else:  # topn head-chunk scoring
+                num_rows, n_shards, chunk = d[1], d[2], d[3]
+                srcs, blocks, brow, bslot, bshard = flat[off : off + 5]
+                off += 5
+                outs.append(
+                    ops.sparse_intersection_counts_stacked_mat(
+                        srcs,
+                        blocks,
+                        brow,
+                        bslot,
+                        bshard,
+                        num_rows=num_rows,
+                        n_shards=n_shards,
+                        chunk=chunk,
+                    )
+                )
+        return tuple(outs)
+
+    return run

@@ -279,6 +279,15 @@ class DeviceStager:
         w32 = np.ascontiguousarray(words64).view("<u4")
         return jax.device_put(w32, self.device)
 
+    def upload(self, w32: np.ndarray):
+        """Place an already-u32 host array on the stager's device.
+
+        Used by the executor's device-resident plan cache to pin
+        ``__cached`` bitmap stacks in HBM with the same placement the
+        kernels expect; bypasses the staging cache (the plan cache does
+        its own byte accounting and invalidation)."""
+        return jax.device_put(np.ascontiguousarray(w32), self.device)
+
     def _to_device_sharded(self, words64: np.ndarray):
         """Place a shard-major [S, ...] stack split over the mesh's
         shard axis; falls back to single-device placement when no mesh

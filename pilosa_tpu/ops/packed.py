@@ -149,6 +149,32 @@ def sparse_intersection_counts_stacked(
     return jax.ops.segment_sum(per_block, block_row, num_segments=num_rows)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("num_rows", "n_shards", "chunk")
+)
+def sparse_intersection_counts_stacked_mat(
+    srcs,
+    blocks,
+    block_row,
+    block_slot,
+    block_shard,
+    num_rows: int,
+    n_shards: int,
+    chunk: int,
+):
+    """Matrix form of the stacked cross-shard scorer: i32[n_shards,
+    chunk] trimmed and reshaped ON DEVICE, so a caller (the fused
+    whole-query program) transfers exactly the per-shard score head —
+    never the flat padded vector the host would otherwise slice after
+    fetching. num_rows/n_shards/chunk are static; the stacked staging
+    keeps num_rows == n_shards * chunk exact, so the slice is a
+    shape-level guarantee, not a copy."""
+    flat = sparse_intersection_counts_stacked(
+        srcs, blocks, block_row, block_slot, block_shard, num_rows
+    )
+    return flat[: n_shards * chunk].reshape(n_shards, chunk)
+
+
 _BATCH_GROUP = 8  # queries scored per block-stream pass (footprint knob)
 
 

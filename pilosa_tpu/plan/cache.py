@@ -220,6 +220,19 @@ class PlanCache:
 
     # -- inserts / eviction --------------------------------------------------
 
+    def put(self, key, genvec, result, cost: float = 0.0, epoch0=None) -> None:
+        """Insert a result computed OUTSIDE the singleflight (the fused
+        whole-query path executes many calls in one launch, so there is
+        no per-call build closure to route through ``get_or_build``).
+        ``genvec`` must be the vector captured BEFORE the fused build —
+        preserving the over-invalidation-only race direction documented
+        in the module docstring — and ``epoch0`` the epoch observed then
+        (defaults to the current epoch), so a device wedge mid-build
+        fences the insert exactly as it fences ``get_or_build``'s."""
+        self._maybe_insert(
+            key, result, genvec, cost, self.epoch if epoch0 is None else epoch0
+        )
+
     def _maybe_insert(self, key, result, genvec, cost: float, epoch0: int) -> None:
         if cost < self.min_cost:
             return
@@ -284,5 +297,114 @@ class PlanCache:
                 "evictions": self.evictions,
                 "inserts": self.inserts,
                 "building": len(self._building),
+                "epoch": self.epoch,
+            }
+
+
+class DevicePlanCache:
+    """HBM-resident companion to PlanCache for bitmap-valued subtrees:
+    entries hold the packed u32[S, W] device stack a ``__cached``
+    placeholder lowers to, so a plan-cache hit on the device path stops
+    round-tripping through host Row decode + re-pack + re-upload
+    (``executor._cached_words`` per shard) — the device re-ingesting
+    what it just produced.
+
+    Same validity model as PlanCache — generation-vector stamped at
+    insert, exact-match validated at lookup, so every write path
+    invalidates for free — but byte-accounted against a dedicated HBM
+    budget (``plan-cache-device-bytes``) with LRU eviction: device
+    memory is the scarcer resource and is shared with the staging
+    cache. ``epoch_reset`` is wired to the device-health restore next
+    to ``DeviceStager.reset_after_wedge``: arrays produced by a wedged
+    runtime must not outlive it. Values are immutable by contract
+    (device arrays are never written in place), so hits return the
+    resident array without a copy."""
+
+    def __init__(self, max_bytes: int) -> None:
+        self.max_bytes = int(max_bytes)
+        self._mu = OrderedLock("plancache.device_mu")
+        self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self.bytes = 0
+        self.epoch = 0
+        self.hits = 0
+        self.misses = 0
+        self.invalidations = 0
+        self.evictions = 0
+        self.inserts = 0
+
+    def get(self, key, genvec_fn: Callable[[], tuple]):
+        """The resident device array for ``key`` valid at the CURRENT
+        generation vector, or None (miss / invalidated). Probe-and-pack
+        is the caller's job — uploads are too heavyweight to
+        singleflight here, and concurrent misses for one key just
+        upload the same immutable content twice."""
+        genvec = genvec_fn()
+        with self._mu:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                return None
+            if e.genvec != genvec:
+                del self._entries[key]
+                self.bytes -= e.nbytes
+                self.invalidations += 1
+                self.misses += 1
+                metrics.count(metrics.PLANCACHE_INVALIDATIONS)
+                metrics.gauge(metrics.PLANCACHE_DEVICE_BYTES, self.bytes)
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            metrics.count(metrics.PLANCACHE_DEVICE_HITS)
+            return e.value
+
+    def put(self, key, genvec, value, nbytes: int, epoch0=None) -> None:
+        """Insert a device array stamped with the generation vector
+        captured BEFORE its content was materialized (same race
+        direction as PlanCache: a write racing the pack can only
+        over-invalidate). ``epoch0`` fences inserts built before a
+        device wedge."""
+        nbytes = int(nbytes)
+        if nbytes > self.max_bytes:
+            return
+        with self._mu:
+            if epoch0 is not None and self.epoch != epoch0:
+                return
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.bytes -= old.nbytes
+            self._entries[key] = _Entry(value, nbytes, genvec)
+            self.bytes += nbytes
+            self.inserts += 1
+            while self.bytes > self.max_bytes and self._entries:
+                _, e = self._entries.popitem(last=False)
+                self.bytes -= e.nbytes
+                self.evictions += 1
+                metrics.count(metrics.PLANCACHE_DEVICE_EVICTIONS)
+            metrics.gauge(metrics.PLANCACHE_DEVICE_BYTES, self.bytes)
+
+    def epoch_reset(self) -> None:
+        """Drop every resident array and fence out packs that started
+        before the wedge (their epoch0 no longer matches)."""
+        with self._mu:
+            self._entries.clear()
+            self.bytes = 0
+            self.epoch += 1
+            metrics.gauge(metrics.PLANCACHE_DEVICE_BYTES, 0)
+
+    def stats(self) -> dict:
+        """Merged into the /debug/fusion snapshot."""
+        with self._mu:
+            total = self.hits + self.misses
+            return {
+                "enabled": True,
+                "entries": len(self._entries),
+                "bytes": self.bytes,
+                "max_bytes": self.max_bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_ratio": round(self.hits / total, 4) if total else None,
+                "invalidations": self.invalidations,
+                "evictions": self.evictions,
+                "inserts": self.inserts,
                 "epoch": self.epoch,
             }

@@ -207,14 +207,19 @@ def rewrite_for_cse(executor, index: str, calls: list, shards, opt) -> list:
         exclude_row_attrs=True,
         exclude_columns=opt.exclude_columns,
     )
-    resolved: dict[str, Row] = {}
+    resolved: dict[str, tuple] = {}  # h -> (Row, frozen genvec)
 
-    def resolve(node: Call, h: str, fields) -> Optional[Row]:
-        row = resolved.get(h)
-        if row is not None:
-            return row
+    def resolve(node: Call, h: str, fields) -> Optional[tuple]:
+        hit = resolved.get(h)
+        if hit is not None:
+            return hit
         key = subtree_cache_key(index, h, shards_t, opt)
-        gv = lambda: generation_vector(holder, index, fields, shards)
+        # Freeze the vector BEFORE resolving: the device plan cache
+        # stamps the packed u32 stack of this Row with g0, and a stamp
+        # taken after a concurrent write could certify stale content as
+        # fresh. Frozen, a racing write can only over-invalidate.
+        g0 = generation_vector(holder, index, fields, shards)
+        gv = lambda: g0
         if counts.get(h, 0) >= 2:
             # repeated within this query/gang: build once, share
             row = pc.get_or_build(
@@ -225,8 +230,9 @@ def rewrite_for_cse(executor, index: str, calls: list, shards, opt) -> list:
         else:
             row = pc.get(key, gv)  # probe-only: feed hot legs back in
         if isinstance(row, Row):
-            resolved[h] = row
-            return row
+            hit = (row, g0)
+            resolved[h] = hit
+            return hit
         return None
 
     def substitute(node: Call, top: bool) -> Call:
@@ -234,10 +240,23 @@ def rewrite_for_cse(executor, index: str, calls: list, shards, opt) -> list:
             i = info(node)
             if i is not None:
                 h, fields = i
-                row = resolve(node, h, fields)
-                if row is not None:
+                hit = resolve(node, h, fields)
+                if hit is not None:
+                    row, g0 = hit
                     return Call(
-                        CACHED_CALL, args={"_h": h, "_row": row, "_fields": fields}
+                        CACHED_CALL,
+                        args={
+                            "_h": h,
+                            "_row": row,
+                            "_fields": fields,
+                            # for the device-resident plan cache:
+                            # the frozen stamp and a fresh-vector thunk
+                            # (canon.call_hash ignores extra args here)
+                            "_genvec": g0,
+                            "_gv": lambda: generation_vector(
+                                holder, index, fields, shards
+                            ),
+                        },
                     )
         if node.children:
             new = [substitute(ch, False) for ch in node.children]

@@ -228,6 +228,19 @@ class Config:
     # out sub-threshold queries whose recompute is cheaper than the
     # cache bookkeeping. 0 caches everything.
     plan_cache_min_cost: float = 0.0
+    # whole-query / wave fusion (executor/fusion.py): multi-call read
+    # queries lower to ONE jitted device program per plan signature so
+    # intermediates never leave HBM — one host↔device round trip per
+    # query (or per combined dispatch wave) instead of one per call
+    fusion_enabled: bool = True
+    # calls above this per query fall back to per-call execution (each
+    # distinct call mix compiles its own fused program; bounding the
+    # mix bounds compile-cache growth)
+    fusion_max_calls: int = 64
+    # HBM byte budget for the device-resident plan cache: __cached
+    # subtree bitmap stacks pinned on device so repeated subtrees stop
+    # re-uploading. 0 disables (host plan cache still works)
+    plan_cache_device_bytes: int = 64 << 20
     # performance attribution (utils/profiler.py, utils/slo.py):
     # continuous thread-stack sampler frequency in Hz (0 disables)
     profiler_hz: float = 10.0
@@ -346,6 +359,9 @@ class Config:
             f"plan-cache-enabled = {'true' if self.plan_cache_enabled else 'false'}",
             f"plan-cache-max-bytes = {self.plan_cache_max_bytes}",
             f"plan-cache-min-cost = {self.plan_cache_min_cost}",
+            f"fusion-enabled = {'true' if self.fusion_enabled else 'false'}",
+            f"fusion-max-calls = {self.fusion_max_calls}",
+            f"plan-cache-device-bytes = {self.plan_cache_device_bytes}",
             f"profiler-hz = {self.profiler_hz}",
             f"hbm-watermark-pct = {self.hbm_watermark_pct}",
             f'slo-objectives = "{self.slo_objectives}"',

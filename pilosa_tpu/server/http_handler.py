@@ -204,6 +204,7 @@ class Handler:
             Route("GET", r"/debug/pipeline", self.get_debug_pipeline),
             Route("GET", r"/debug/ingest", self.get_debug_ingest),
             Route("GET", r"/debug/dispatch", self.get_debug_dispatch),
+            Route("GET", r"/debug/fusion", self.get_debug_fusion),
             Route("GET", r"/debug/multihost", self.get_debug_multihost),
             Route("GET", r"/debug/plancache", self.get_debug_plancache),
             Route("GET", r"/debug/vars", self.get_debug_vars),
@@ -825,6 +826,15 @@ class Handler:
         if engine is None:
             return {"enabled": False}
         return engine.stats()
+
+    def get_debug_fusion(self, req) -> dict:
+        """Whole-query/wave fusion snapshot: fused launches, calls per
+        launch, bytes returned, bypass reasons, compiled program count,
+        and the device-resident plan cache (entries/bytes/hit ratio)."""
+        fuser = getattr(self.api.executor, "fuser", None)
+        if fuser is None:
+            return {"enabled": False}
+        return fuser.stats()
 
     def get_debug_traces(self, req) -> dict:
         """Recent completed query traces (the tracer's ring buffer) as

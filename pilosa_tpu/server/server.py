@@ -216,6 +216,9 @@ class Server:
             dispatch_max_wave=self.config.dispatch_max_wave,
             dispatch_max_inflight=self.config.dispatch_max_inflight,
             dispatch_stage_ahead=self.config.dispatch_stage_ahead,
+            fusion_enabled=self.config.fusion_enabled,
+            fusion_max_calls=self.config.fusion_max_calls,
+            plan_cache_device_bytes=self.config.plan_cache_device_bytes,
         )
         self.api = API(self.holder, self.executor, cluster=cluster, server=self)
         # federation (parallel/federation.py): epoch adopted from the

@@ -153,6 +153,15 @@ DISPATCH_WAVE_SIZE = "dispatch.wave_size"
 DISPATCH_INFLIGHT_DEPTH = "dispatch.inflight_depth"
 DISPATCH_DEVICE_IDLE_FRACTION = "dispatch.device_idle_fraction"
 DISPATCH_QUEUE_WAIT_SECONDS = "dispatch.queue_wait_seconds"
+# device-resident query fusion (executor/fusion.py)
+FUSION_FUSED_LAUNCHES = "fusion.fused_launches"
+FUSION_FUSED_CALLS_PER_LAUNCH = "fusion.fused_calls_per_launch"
+FUSION_BYTES_RETURNED = "fusion.bytes_returned"
+FUSION_BYPASSES = "fusion.bypasses"
+# device-resident plan cache (plan/cache.py DevicePlanCache)
+PLANCACHE_DEVICE_HITS = "plancache.device_hits"
+PLANCACHE_DEVICE_EVICTIONS = "plancache.device_evictions"
+PLANCACHE_DEVICE_BYTES = "plancache.device_bytes"
 # invariant checker — dynamic lock-order detection (analysis/locks.py)
 ANALYSIS_LOCK_CYCLES = "analysis.lock_cycles"
 ANALYSIS_LOCK_GRAPH_EDGES = "analysis.lock_graph_edges"
@@ -432,6 +441,40 @@ METRICS: dict[str, tuple[str, str]] = {
     DISPATCH_QUEUE_WAIT_SECONDS: (
         "summary",
         "time a submitted query waited in the dispatch queue before its wave launched",
+    ),
+    FUSION_FUSED_LAUNCHES: (
+        "counter",
+        "fused device launches: one jitted program serving a whole "
+        "multi-call query (or coalesced dispatch-wave group)",
+    ),
+    FUSION_FUSED_CALLS_PER_LAUNCH: (
+        "summary",
+        "PQL calls served per fused launch — the round-trips one "
+        "program replaced",
+    ),
+    FUSION_BYTES_RETURNED: (
+        "counter",
+        "bytes transferred device→host by fused launches (final "
+        "scalars/score heads only; intermediates stay in HBM)",
+    ),
+    FUSION_BYPASSES: (
+        "counter",
+        "queries that skipped fusion and took the per-call path "
+        "(label: reason)",
+    ),
+    PLANCACHE_DEVICE_HITS: (
+        "counter",
+        "__cached subtree stacks served from the device-resident plan "
+        "cache (no host re-pack + re-upload)",
+    ),
+    PLANCACHE_DEVICE_EVICTIONS: (
+        "counter",
+        "device-resident plan-cache entries evicted LRU to stay under "
+        "plan-cache-device-bytes",
+    ),
+    PLANCACHE_DEVICE_BYTES: (
+        "gauge",
+        "HBM bytes held by device-resident plan-cache entries",
     ),
     ANALYSIS_LOCK_CYCLES: (
         "gauge",

@@ -118,6 +118,47 @@ def test_window_gating_bootstrap_and_unprovable_runs(bench):
     assert degraded and "window_quality" in why
 
 
+def test_window_quality_carries_fused_rtt_fields(bench):
+    t = {
+        "topn_qps": 12.5,
+        "profile": {
+            "device_rtt_ms": 20.0,
+            "fused_rtt": {
+                "rtt_multiple": 1.3,
+                "fused_launches_per_query": 1.0,
+            },
+        },
+    }
+    wq = bench.window_quality(t)
+    assert wq["fused_rtt_multiple"] == 1.3
+    assert wq["fused_launches_per_query"] == 1.0
+    # no fused probe (or a bad value) -> fields simply absent
+    wq = bench.window_quality({"topn_qps": 12.5, "profile": {"device_rtt_ms": 20.0}})
+    assert "fused_rtt_multiple" not in wq
+    t["profile"]["fused_rtt"] = {"rtt_multiple": 0}
+    assert "fused_rtt_multiple" not in bench.window_quality(t)
+
+
+def test_fused_window_regression_refuses_overwrite(bench):
+    good = {"sustained_rtt_ms": 20.0, "pipelining_depth": 2.0,
+            "fused_rtt_multiple": 1.3}
+    # comparable fused window: fine
+    ok = dict(good, fused_rtt_multiple=1.5)
+    assert bench.window_degraded(ok, good) == (False, None)
+    # fusion regressed to per-call round trips: refused, with the reason
+    bad = dict(good, fused_rtt_multiple=1.3 * bench.DEGRADED_RTT_FACTOR + 0.1)
+    degraded, why = bench.window_degraded(bad, good)
+    assert degraded and "fused" in why
+    # fused window not measured while last-good has one: refused
+    degraded, why = bench.window_degraded(
+        {"sustained_rtt_ms": 20.0, "pipelining_depth": 2.0}, good
+    )
+    assert degraded and "fused" in why
+    # last-good PRE-fusion (no fused fields): new fused fields accepted
+    old = {"sustained_rtt_ms": 20.0, "pipelining_depth": 2.0}
+    assert bench.window_degraded(good, old) == (False, None)
+
+
 def test_vs_baseline_seq_ratio_rides_alongside(bench):
     out = bench.vs_baseline_fields(
         "64 closed-loop clients", 132.9, 0.4, seq_qps=12.5

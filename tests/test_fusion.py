@@ -1,0 +1,610 @@
+"""Whole-query / wave device fusion (ISSUE 13, executor/fusion.py +
+plan/cache.py DevicePlanCache): multi-call reads lowering to ONE jitted
+launch bit-identical to both the unfused device path and the CPU
+oracle (TopN, Count, BSI Sum, 3-op chains, __cached substitution),
+wave fusion through the dispatch engine with read-after-write
+freshness, the device-resident plan cache (LRU under a byte budget,
+generation invalidation, epoch reset), the bypass matrix
+(gang/cluster/mesh/serial/remote/write/cpu — the PR 5/6 determinism
+contract), and the fusion.* metrics + /debug/fusion surface."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SHARD_WIDTH
+from pilosa_tpu.core import FieldOptions, Holder
+from pilosa_tpu.core.field import FIELD_TYPE_INT
+from pilosa_tpu.executor import ExecOptions, Executor
+from pilosa_tpu.plan.cache import DevicePlanCache, PlanCache
+from pilosa_tpu.pql import parse
+from pilosa_tpu.utils import metrics
+
+
+@pytest.fixture
+def holder():
+    h = Holder()  # in-memory
+    h.open()
+    return h
+
+
+def seed_mixed(h, n_shards=3):
+    """Multi-shard index with a set field and a BSI field — enough
+    surface for TopN / Count / Sum / chain plans in one fused launch."""
+    rng = np.random.default_rng(9)
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    v = idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT, min=-50, max=5000))
+    rows = rng.integers(0, 12, size=3000)
+    cols = rng.integers(0, n_shards * SHARD_WIDTH, size=3000)
+    f.import_bits(rows.tolist(), cols.tolist())
+    vcols = rng.choice(n_shards * SHARD_WIDTH, size=800, replace=False)
+    vvals = rng.integers(-50, 5000, size=800)
+    v.import_values(vcols.tolist(), vvals.tolist())
+
+
+# the fusion gauntlet: every fusable unit kind plus 3-op chains, in one
+# multi-call query so a single launch covers them all
+GAUNTLET = (
+    "Count(Row(f=1))"
+    "TopN(f, Row(f=3), n=4)"
+    'Sum(Row(f=1), field="v")'
+    'Sum(field="v")'
+    "Count(Intersect(Row(f=1), Row(f=2)))"
+    "Count(Union(Row(f=3), Xor(Row(f=4), Row(f=5)), Difference(Row(f=6), Row(f=7))))"
+    "Count(Range(v > 100))"
+    "TopN(f, Union(Row(f=1), Row(f=2)), n=6)"
+)
+
+
+def oracle_of(h):
+    return Executor(h, device_policy="never", dispatch_enabled=False)
+
+
+# -- whole-query fusion bit-identity ----------------------------------------
+
+
+class TestBitIdentity:
+    def test_gauntlet_fused_vs_unfused_vs_oracle(self, holder):
+        """The full gauntlet in ONE query: fused results match both the
+        per-call device path (fusion off) and the CPU oracle exactly."""
+        seed_mixed(holder)
+        oracle = oracle_of(holder)
+        want = oracle.execute("i", GAUNTLET)
+        unfused = Executor(
+            holder, device_policy="always", dispatch_enabled=False,
+            fusion_enabled=False,
+        )
+        assert unfused.fuser is None
+        assert unfused.execute("i", GAUNTLET) == want
+        ex = Executor(holder, device_policy="always", dispatch_enabled=False)
+        try:
+            got = ex.execute("i", GAUNTLET)
+            assert got == want
+            st = ex.fuser.stats()
+            # one launch covered the fusable mix (childless Sum/TopN
+            # variants may stay residual; chains and filtered TopN fuse)
+            assert st["fused_launches"] == 1
+            assert st["fused_calls"] >= 5
+            assert st["bytes_returned"] > 0
+            # repeat reuses the compiled program — no recompile per query
+            assert ex.execute("i", GAUNTLET) == want
+            st2 = ex.fuser.stats()
+            assert st2["fused_launches"] >= 2
+            assert st2["programs"] == st["programs"]
+        finally:
+            ex.close()
+            unfused.close()
+            oracle.close()
+
+    def test_three_op_chains_fuse_into_one_launch(self, holder):
+        """Three 3-op chain Counts — the bench's chain shape — cost one
+        fused launch instead of three round trips."""
+        seed_mixed(holder)
+        q = (
+            "Count(Union(Row(f=1), Intersect(Row(f=2), Row(f=3))))"
+            "Count(Difference(Union(Row(f=4), Row(f=5)), Row(f=6)))"
+            "Count(Xor(Row(f=7), Union(Row(f=8), Row(f=9))))"
+        )
+        oracle = oracle_of(holder)
+        want = oracle.execute("i", q)
+        ex = Executor(holder, device_policy="always", dispatch_enabled=False)
+        try:
+            assert ex.execute("i", q) == want
+            st = ex.fuser.stats()
+            assert st["fused_launches"] == 1 and st["fused_calls"] == 3
+        finally:
+            ex.close()
+            oracle.close()
+
+    def test_cached_subtree_substitution_stays_fresh_and_identical(self, holder):
+        """__cached substitution under fusion: a repeated subtree CSEs
+        into a __cached node whose bitmap stack the device cache pins;
+        repeats serve from both caches and writes invalidate exactly."""
+        seed_mixed(holder)
+        q = (
+            "Count(Intersect(Row(f=1), Row(f=2)))"
+            "TopN(f, Intersect(Row(f=1), Row(f=2)), n=5)"
+        )
+        oracle = oracle_of(holder)
+        ex = Executor(
+            holder, device_policy="always", dispatch_enabled=False,
+            plan_cache=PlanCache(),
+        )
+        try:
+            assert ex.device_cache is not None
+            want = oracle.execute("i", q)
+            for rep in range(4):
+                assert ex.execute("i", q) == want, rep
+            dst = ex.device_cache.stats()
+            assert dst["inserts"] >= 1 and dst["hits"] >= 1
+            assert ex.fuser.stats()["cache_served"] >= 1
+            # write → generation bump → nothing stale anywhere
+            assert ex.execute("i", f"Set({SHARD_WIDTH + 55}, f=1)") == [True]
+            assert ex.execute("i", f"Set({SHARD_WIDTH + 55}, f=2)") == [True]
+            want2 = oracle.execute("i", q)
+            assert want2 != want
+            assert ex.execute("i", q) == want2
+        finally:
+            ex.close()
+            oracle.close()
+
+    def test_plan_cache_serves_whole_calls_on_fused_path(self, holder):
+        """Whole-call plan-cache hits short-circuit lowering: repeats of
+        a cacheable multi-call read stop launching entirely."""
+        seed_mixed(holder)
+        q = "Count(Row(f=1))Count(Row(f=2))"
+        oracle = oracle_of(holder)
+        want = oracle.execute("i", q)
+        ex = Executor(
+            holder, device_policy="always", dispatch_enabled=False,
+            plan_cache=PlanCache(),
+        )
+        try:
+            for rep in range(4):
+                assert ex.execute("i", q) == want, rep
+            st = ex.fuser.stats()
+            assert st["fused_launches"] == 1  # first execution only
+            assert st["cache_served"] >= 4
+        finally:
+            ex.close()
+            oracle.close()
+
+
+# -- wave fusion through the dispatch engine --------------------------------
+
+
+def _gated_executor(h, **kw):
+    """Device executor whose FIRST _execute blocks on a gate so
+    everything submitted meanwhile piles into one provably-wide wave."""
+    ex = Executor(
+        h, device_policy="always", dispatch_enabled=True,
+        dispatch_max_inflight=1, dispatch_max_wave=32, **kw
+    )
+    orig = ex._execute
+    gate = threading.Event()
+    first = threading.Event()
+
+    def gated(index, query, shards=None, opt=None):
+        if not first.is_set():
+            first.set()
+            assert gate.wait(10), "test gate never released"
+        return orig(index, query, shards, opt)
+
+    ex._execute = gated
+    return ex, gate, first
+
+
+def _wait_queued(engine, n, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if engine.stats()["queued"] >= n:
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"queue never reached {n}: {engine.stats()}")
+
+
+WAVE_QUERIES = [
+    "Count(Row(f=2))",
+    "TopN(f, Row(f=3), n=4)",
+    'Sum(Row(f=1), field="v")',
+    "Count(Intersect(Row(f=1), Row(f=2)))",
+    "Count(Range(v > 100))",
+]
+
+
+class TestWaveFusion:
+    def test_combined_wave_is_one_fused_launch(self, holder):
+        """A heterogeneous wave of 5 queries combines into one Query and
+        executes as ONE fused launch, per-item results split exactly."""
+        seed_mixed(holder)
+        oracle = oracle_of(holder)
+        want = {i: oracle.execute("i", q) for i, q in enumerate(WAVE_QUERIES)}
+        ex, gate, first = _gated_executor(holder)
+        try:
+            blocker = threading.Thread(
+                target=lambda: ex.execute("i", "Count(Row(f=0))")
+            )
+            blocker.start()
+            assert first.wait(10)
+            res = {}
+            ts = []
+
+            def client(i, q):
+                res[i] = ex.execute("i", q)
+
+            for i, q in enumerate(WAVE_QUERIES):
+                t = threading.Thread(target=client, args=(i, q))
+                t.start()
+                ts.append(t)
+            _wait_queued(ex.dispatch_engine, len(WAVE_QUERIES))
+            gate.set()
+            for t in ts:
+                t.join()
+            blocker.join()
+            for i, q in enumerate(WAVE_QUERIES):
+                assert res[i] == want[i], q
+            st = ex.fuser.stats()
+            assert st["fused_launches"] >= 1
+            assert st["fused_calls"] >= len(WAVE_QUERIES) - 1
+            assert ex.dispatch_engine.stats()["combined_items"] >= len(
+                WAVE_QUERIES
+            ) - 1
+        finally:
+            gate.set()
+            ex.close()
+            oracle.close()
+
+    def test_read_after_write_fresh_through_fused_wave(self, holder):
+        """A read submitted after a write observes that write even when
+        the wave it joins executes fused — generation bumps mid-stream
+        never serve stale fused results."""
+        seed_mixed(holder)
+        oracle = oracle_of(holder)
+        ex, gate, first = _gated_executor(holder)
+        try:
+            blocker = threading.Thread(
+                target=lambda: ex.execute("i", "Count(Row(f=0))")
+            )
+            blocker.start()
+            assert first.wait(10)
+            new_cols = [SHARD_WIDTH * 2 + 777 + k for k in range(5)]
+            for c in new_cols:
+                assert ex.execute("i", f"Set({c}, f=0)") == [True]
+            (after,) = oracle.execute("i", "Count(Row(f=0))")
+            # two reads queue into one post-write wave → fused together
+            res = {}
+            ts = [
+                threading.Thread(
+                    target=lambda k=k: res.update(
+                        {k: ex.execute("i", "Count(Row(f=0))")}
+                    )
+                )
+                for k in range(2)
+            ]
+            for t in ts:
+                t.start()
+            _wait_queued(ex.dispatch_engine, 2)
+            gate.set()
+            for t in ts:
+                t.join()
+            blocker.join()
+            assert res[0] == [after] and res[1] == [after]
+        finally:
+            gate.set()
+            ex.close()
+            oracle.close()
+
+
+# -- device-resident plan cache ---------------------------------------------
+
+
+class TestDevicePlanCache:
+    def test_lru_eviction_under_byte_budget(self):
+        gen = ("g", 1)
+        dc = DevicePlanCache(max_bytes=1000)
+        a = np.zeros(100, dtype=np.uint32)  # 400 bytes
+        dc.put("a", gen, a, a.nbytes)
+        dc.put("b", gen, a, a.nbytes)
+        assert dc.stats()["entries"] == 2 and dc.stats()["bytes"] == 800
+        dc.get("a", lambda: gen)  # a is now MRU
+        dc.put("c", gen, a, a.nbytes)  # over budget → evict LRU = b
+        st = dc.stats()
+        assert st["entries"] == 2 and st["bytes"] == 800
+        assert st["evictions"] == 1
+        assert dc.get("a", lambda: gen) is not None
+        assert dc.get("b", lambda: gen) is None
+        assert dc.get("c", lambda: gen) is not None
+
+    def test_oversized_value_never_stored(self):
+        dc = DevicePlanCache(max_bytes=100)
+        dc.put("big", ("g",), np.zeros(1000, dtype=np.uint32), 4000)
+        assert dc.stats()["entries"] == 0
+
+    def test_generation_mismatch_invalidates(self):
+        dc = DevicePlanCache(max_bytes=1000)
+        dc.put("k", ("gen", 1), np.zeros(4, dtype=np.uint32), 16)
+        assert dc.get("k", lambda: ("gen", 1)) is not None
+        # the stamped generation no longer matches → drop, miss
+        assert dc.get("k", lambda: ("gen", 2)) is None
+        st = dc.stats()
+        assert st["invalidations"] == 1 and st["entries"] == 0
+
+    def test_epoch_fence_rejects_pre_reset_builds(self):
+        dc = DevicePlanCache(max_bytes=1000)
+        epoch0 = dc.epoch
+        dc.epoch_reset()  # device restore while a build was in flight
+        dc.put("k", ("g",), np.zeros(4, dtype=np.uint32), 16, epoch0=epoch0)
+        assert dc.stats()["entries"] == 0
+
+    def test_executor_epoch_reset_clears_device_cache(self, holder):
+        seed_mixed(holder, n_shards=1)
+        ex = Executor(
+            holder, device_policy="always", dispatch_enabled=False,
+            plan_cache=PlanCache(),
+        )
+        try:
+            ex.device_cache.put(
+                "k", ("g",), np.zeros(4, dtype=np.uint32), 16
+            )
+            assert ex.device_cache.stats()["entries"] == 1
+            ex._on_device_restore()
+            st = ex.device_cache.stats()
+            assert st["entries"] == 0 and st["epoch"] >= 1
+        finally:
+            ex.close()
+
+    def test_disabled_without_plan_cache_or_budget(self, holder):
+        assert (
+            Executor(holder, device_policy="always").device_cache is None
+        )  # no plan cache → no device cache
+        assert (
+            Executor(
+                holder, device_policy="always", plan_cache=PlanCache(),
+                plan_cache_device_bytes=0,
+            ).device_cache
+            is None
+        )
+        assert (
+            Executor(
+                holder, device_policy="always", plan_cache=PlanCache()
+            ).device_cache
+            is not None
+        )
+
+
+# -- bypass matrix (PR 5/6 determinism contract) ----------------------------
+
+
+class TestBypassMatrix:
+    def _calls(self, q="Count(Row(f=1))Count(Row(f=2))"):
+        return parse(q).calls
+
+    def test_gang_cluster_mesh_and_opt_bypass(self, holder):
+        seed_mixed(holder, n_shards=1)
+        ex = Executor(holder, device_policy="always", dispatch_enabled=False)
+        try:
+            fuser, calls = ex.fuser, self._calls()
+            ex.gang = object()
+            assert fuser.try_execute("i", calls, [0], ExecOptions()) is None
+            ex.gang = None
+            ex.cluster = object()
+            assert fuser.try_execute("i", calls, [0], ExecOptions()) is None
+            ex.cluster = None
+            ex.mesh = object()
+            assert fuser.try_execute("i", calls, [0], ExecOptions()) is None
+            ex.mesh = None
+            assert (
+                fuser.try_execute("i", calls, [0], ExecOptions(remote=True))
+                is None
+            )
+            assert (
+                fuser.try_execute("i", calls, [0], ExecOptions(serial=True))
+                is None
+            )
+            assert fuser.try_execute("i", calls, [], ExecOptions()) is None
+            for reason in ("topology", "mesh", "opt", "no_shards"):
+                assert fuser.bypasses.get(reason, 0) >= 1, (
+                    reason,
+                    fuser.bypasses,
+                )
+            # and after every probe the real path still fuses
+            assert fuser.try_execute("i", calls, [0], ExecOptions()) is not None
+        finally:
+            ex.gang = None
+            ex.cluster = None
+            ex.mesh = None
+            ex.close()
+
+    def test_serial_and_single_call_never_reach_fuser(self, holder):
+        seed_mixed(holder)
+        oracle = oracle_of(holder)
+        ex = Executor(holder, device_policy="always", dispatch_enabled=False)
+        try:
+            q = "Count(Row(f=1))Count(Row(f=2))"
+            assert ex.execute("i", q, opt=ExecOptions(serial=True)) == (
+                oracle.execute("i", q)
+            )
+            assert ex.execute("i", "Count(Row(f=1))") == oracle.execute(
+                "i", "Count(Row(f=1))"
+            )
+            assert ex.fuser.stats()["fused_launches"] == 0
+        finally:
+            ex.close()
+            oracle.close()
+
+    def test_writes_bypass_fusion(self, holder):
+        """A query containing any write runs the classic serial path —
+        the fuser never sees it (cross-call ordering must hold)."""
+        seed_mixed(holder)
+        ex = Executor(holder, device_policy="always", dispatch_enabled=False)
+        try:
+            col = SHARD_WIDTH + 424242
+            got = ex.execute("i", f"Set({col}, f=1)Count(Row(f=1))")
+            assert got[0] is True
+            assert ex.fuser.stats()["fused_launches"] == 0
+            # the read in the same query already observes the write
+            oracle = oracle_of(holder)
+            assert got[1] == oracle.execute("i", "Count(Row(f=1))")[0]
+        finally:
+            ex.close()
+
+    def test_cpu_policy_and_max_calls_bypass(self, holder):
+        seed_mixed(holder, n_shards=1)
+        ex = Executor(holder, device_policy="never", dispatch_enabled=False)
+        try:
+            assert (
+                ex.fuser.try_execute("i", self._calls(), [0], ExecOptions())
+                is None
+            )
+            assert ex.fuser.bypasses.get("cpu", 0) >= 1
+        finally:
+            ex.close()
+        ex2 = Executor(
+            holder, device_policy="always", dispatch_enabled=False,
+            fusion_max_calls=1,
+        )
+        try:
+            q = "Count(Row(f=1))Count(Row(f=2))"
+            oracle = oracle_of(holder)
+            assert ex2.execute("i", q) == oracle.execute("i", q)
+            assert ex2.fuser.bypasses.get("too_many_calls", 0) >= 1
+            assert ex2.fuser.stats()["fused_launches"] == 0
+        finally:
+            ex2.close()
+
+    def test_lowering_failure_degrades_to_classic_path(self, holder):
+        """A fuser that blows up mid-flight must not surface: reads are
+        pure, so the classic path re-runs and answers correctly."""
+        seed_mixed(holder)
+        oracle = oracle_of(holder)
+        ex = Executor(holder, device_policy="always", dispatch_enabled=False)
+        try:
+            ex.fuser._lower_and_launch = lambda *a, **k: 1 / 0
+            q = "Count(Row(f=1))Count(Row(f=2))"
+            assert ex.execute("i", q) == oracle.execute("i", q)
+            assert ex.fuser.bypasses.get("error", 0) >= 1
+        finally:
+            ex.close()
+            oracle.close()
+
+
+# -- observability ----------------------------------------------------------
+
+
+class TestObservability:
+    def test_fusion_metrics_emitted(self, holder):
+        seed_mixed(holder)
+        base = metrics.snapshot().get(metrics.FUSION_FUSED_LAUNCHES, 0)
+        ex = Executor(holder, device_policy="always", dispatch_enabled=False)
+        try:
+            ex.execute("i", "Count(Row(f=1))Count(Row(f=2))")
+        finally:
+            ex.close()
+        snap = metrics.snapshot()
+        assert snap.get(metrics.FUSION_FUSED_LAUNCHES, 0) > base
+        assert any(
+            k.startswith(metrics.FUSION_BYTES_RETURNED) for k in snap
+        )
+
+    def test_stats_shape(self, holder):
+        seed_mixed(holder, n_shards=1)
+        ex = Executor(
+            holder, device_policy="always", dispatch_enabled=False,
+            plan_cache=PlanCache(),
+        )
+        try:
+            ex.execute("i", "Count(Row(f=1))Count(Row(f=2))")
+            st = ex.fuser.stats()
+            for key in (
+                "enabled", "max_calls", "fused_launches", "fused_calls",
+                "avg_calls_per_launch", "bytes_returned", "cache_served",
+                "programs", "bypasses", "device_cache",
+            ):
+                assert key in st, key
+            assert st["device_cache"]["enabled"] is True
+            assert st["device_cache"]["max_bytes"] > 0
+            # dispatch snapshot carries the fusion block too
+            ds = ex.dispatch_engine.stats() if ex.dispatch_engine else None
+            assert ds is None or "fusion" in ds
+        finally:
+            ex.close()
+
+
+class TestServerSurface:
+    def _mkserver(self, tmp_path, **cfg_kwargs):
+        from pilosa_tpu.server import Config, Server
+
+        cfg = Config(
+            data_dir=str(tmp_path / "data"),
+            bind="127.0.0.1:0",
+            metric="expvar",
+            device_policy="never",
+            device_timeout=0,
+            **cfg_kwargs,
+        )
+        s = Server(cfg)
+        s.open()
+        return s
+
+    def _get(self, s, path):
+        with urllib.request.urlopen(s.uri + path) as resp:
+            return resp.read()
+
+    def test_debug_fusion_endpoint_and_config_knobs(self, tmp_path):
+        s = self._mkserver(tmp_path, fusion_max_calls=32)
+        try:
+            assert s.executor.fuser is not None
+            assert s.executor.fuser.max_calls == 32
+            snap = json.loads(self._get(s, "/debug/fusion"))
+            assert snap["enabled"] is True
+            for key in ("fused_launches", "bypasses", "device_cache"):
+                assert key in snap
+            # dispatch snapshot embeds the fusion block
+            dsnap = json.loads(self._get(s, "/debug/dispatch"))
+            assert "fusion" in dsnap
+            # knobs round-trip through TOML
+            toml = s.config.to_toml()
+            assert "fusion-enabled = true" in toml
+            assert "fusion-max-calls = 32" in toml
+            assert "plan-cache-device-bytes" in toml
+        finally:
+            s.close()
+
+    def test_fusion_disabled_config(self, tmp_path):
+        s = self._mkserver(tmp_path, fusion_enabled=False)
+        try:
+            assert s.executor.fuser is None
+            assert json.loads(self._get(s, "/debug/fusion")) == {
+                "enabled": False
+            }
+        finally:
+            s.close()
+
+
+def test_docs_document_fusion_knobs_with_current_defaults():
+    """docs/configuration.md names every fusion knob with the default
+    the code actually uses, and docs/administration.md keeps the
+    Device-resident execution section — both directions of drift."""
+    import os
+
+    from pilosa_tpu.server import Config
+
+    cfg = Config(data_dir="x")
+    root = os.path.join(os.path.dirname(__file__), "..", "docs")
+    with open(os.path.join(root, "configuration.md")) as f:
+        conf = f.read()
+    for knob, default in (
+        ("fusion-enabled", "true" if cfg.fusion_enabled else "false"),
+        ("fusion-max-calls", str(cfg.fusion_max_calls)),
+        ("plan-cache-device-bytes", str(cfg.plan_cache_device_bytes)),
+    ):
+        assert f"| `{knob}` | {default} |" in conf, knob
+    with open(os.path.join(root, "administration.md")) as f:
+        admin = f.read()
+    assert "## Device-resident execution" in admin
+    assert "/debug/fusion" in admin
