@@ -38,6 +38,12 @@ Rules (ids are what ``# check: disable=<rule>`` names):
   ``metrics.count/gauge/observe`` (literal or ``metrics.CONSTANT``)
   exists in the ``utils/metrics.py`` registry — the docs-sync test
   extended to code sites.
+* ``fault-spec`` — string-literal fault schedules handed to the three
+  injector families (``install_storage_faults`` /
+  ``install_device_faults`` / multihost ``FaultSpec.parse`` /
+  ``maybe_faulty``) parse under that family's knob grammar. A typo'd
+  knob in a chaos schedule otherwise surfaces as a ValueError at the
+  worst time: inside the fault window it was supposed to open.
 
 Suppressions: ``# check: disable=<rule>[,<rule>…] (<reason>)`` on the
 flagged line or alone on the line above. ``--strict`` additionally
@@ -60,6 +66,7 @@ RULES = (
     "jit-purity",
     "donation-safety",
     "metrics-sync",
+    "fault-spec",
 )
 
 # modules migrated to OrderedLock — the five lock-heaviest (ISSUE 9);
@@ -647,6 +654,97 @@ def rule_metrics_sync(tree: ast.Module, ctx: "FileContext") -> list[Finding]:
     return findings
 
 
+# -- rule: fault-spec --------------------------------------------------------
+
+# knob grammar per injector family: knob -> value kind. Kept LOCAL (no
+# core/fragment, utils/chaos, or multihost import — lint also runs in
+# the no-jax check job); tests parse these same grammars with the real
+# spec classes to keep both directions honest.
+_FAULT_KNOBS: dict[str, dict[str, str]] = {
+    "storage": {
+        "fsync_fail_every": "int",
+        "torn_at": "int",
+        "enospc_after": "int",
+    },
+    "device": {
+        "oom_every": "int",
+        "stall_every": "int",
+        "stall_s": "float",
+        "poison_every": "int",
+        "after": "int",
+    },
+    "distributed": {
+        "drop_every": "int",
+        "dup_every": "int",
+        "delay": "float",
+        "after": "int",
+    },
+}
+
+# call-site shape -> (family, positional index of the spec argument)
+_FAULT_CALLS: dict[str, tuple[str, int]] = {
+    "install_storage_faults": ("storage", 0),
+    "install_device_faults": ("device", 0),
+    "StorageFaultSpec.parse": ("storage", 0),
+    "DeviceFaultSpec.parse": ("device", 0),
+    "FaultSpec.parse": ("distributed", 0),
+    "maybe_faulty": ("distributed", 1),
+}
+
+
+def _fault_spec_errors(family: str, text: str) -> list[str]:
+    knobs = _FAULT_KNOBS[family]
+    errors: list[str] = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, value = part.partition("=")
+        key = key.strip()
+        if key not in knobs:
+            errors.append(
+                f"unknown {family} fault knob {key!r} "
+                f"(known: {', '.join(sorted(knobs))})"
+            )
+            continue
+        if not sep:
+            errors.append(f"{family} fault knob {key!r} missing '=value'")
+            continue
+        try:
+            (int if knobs[key] == "int" else float)(value.strip())
+        except ValueError:
+            errors.append(
+                f"{family} fault knob {key!r} needs "
+                f"{'an integer' if knobs[key] == 'int' else 'a number'}, "
+                f"got {value.strip()!r}"
+            )
+    return errors
+
+
+def rule_fault_spec(tree: ast.Module, ctx: "FileContext") -> list[Finding]:
+    findings: list[Finding] = []
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Call):
+            continue
+        d = _dotted(n.func)
+        name = _last_seg(d)
+        hit = _FAULT_CALLS.get(name)
+        if hit is None and "." in d:
+            # Klass.parse form — match on the last two segments
+            hit = _FAULT_CALLS.get(".".join(d.split(".")[-2:]))
+        if hit is None:
+            continue
+        family, argidx = hit
+        if len(n.args) <= argidx:
+            continue
+        arg = n.args[argidx]
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            continue  # dynamic specs are the parser's problem at runtime
+        for msg in _fault_spec_errors(family, arg.value):
+            findings.append(ctx.finding(arg.lineno, "fault-spec", msg))
+    return findings
+
+
 _RULE_FNS: dict[str, Callable] = {
     "lock-discipline": rule_lock_discipline,
     "lock-wrapper": rule_lock_wrapper,
@@ -655,6 +753,7 @@ _RULE_FNS: dict[str, Callable] = {
     "jit-purity": rule_jit_purity,
     "donation-safety": rule_donation_safety,
     "metrics-sync": rule_metrics_sync,
+    "fault-spec": rule_fault_spec,
 }
 
 

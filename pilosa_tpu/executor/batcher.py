@@ -134,6 +134,10 @@ class BatchedScorer:
         # pow2 padding zeros, cached per (shape, dtype): a fresh
         # jnp.zeros_like per launch is an extra dispatch RPC
         self._pad_zeros: dict = {}
+        # process-wide HBM governor (executor/hbm.py): the pad scratch
+        # is device-resident, so its bytes are accounted against the
+        # "batcher" tenant — one ledger sees every resident byte
+        self.governor = None
         self._lock = threading.Lock()  # protects _pending/_dispatching
         # key -> (staged operand, waiting slots); the operand rides with
         # the queue because the dispatching leader may not be the thread
@@ -291,6 +295,19 @@ class BatchedScorer:
                 fetch(launched_all)
             raise
 
+    def set_governor(self, governor) -> None:
+        self.governor = governor
+        if governor is None:
+            return
+        # accounting-only tenant: the scratch is a handful of pow2
+        # zero arrays, never worth an eviction tier of its own
+        governor.register("batcher", share_bytes=0, evict_fn=None, tier=99)
+        held = sum(
+            int(getattr(z, "nbytes", 0)) for z in self._pad_zeros.values()
+        )
+        if held:
+            governor.reserve("batcher", held)
+
     def _recycle_pads(self) -> None:
         """Recycle the cached pow2 pad zeros through a donated re-zero
         (ops.zeros_like_donated). Called only after the leader's final
@@ -301,10 +318,13 @@ class BatchedScorer:
             zero = self._pad_zeros.get(zkey)
             if zero is None:
                 continue
+            nbytes = int(getattr(zero, "nbytes", 0))
             try:
                 self._pad_zeros[zkey] = ops.zeros_like_donated(zero)
             except BaseException:
                 self._pad_zeros.pop(zkey, None)
+                if self.governor is not None:
+                    self.governor.release("batcher", nbytes)
 
     def _fill(self, batch: list[_Slot], mat) -> None:
         # compatibility seam (tests/instrumentation wrap this): launch +
@@ -345,6 +365,10 @@ class BatchedScorer:
                         zero = self._pad_zeros.get(zkey)
                         if zero is None:
                             zero = self._pad_zeros[zkey] = jnp.zeros_like(proto)
+                            if self.governor is not None:
+                                self.governor.reserve(
+                                    "batcher", int(getattr(zero, "nbytes", 0))
+                                )
                         srcs = srcs + [zero] * (q - len(chunk))
                 dev = self._batch_fn(srcs, mat)
                 # transfer hygiene: pad query lanes never reach the

@@ -167,6 +167,13 @@ class DeviceHealth:
                 self._trip("device probe failed after call deadline")
                 raise DeviceDown("device call timed out and probe failed")
 
+    def trip(self, reason: str) -> None:
+        """Gate the device off from outside the guard path. Used by the
+        OOM-recovery layer (executor/hbm.py) when allocation failures
+        REPEAT after eviction + retry — a single recovered OOM never
+        closes the gate, a pattern of them does."""
+        self._trip(reason)
+
     def _log(self, fmt: str, *args) -> None:
         if self._logger is not None:
             try:

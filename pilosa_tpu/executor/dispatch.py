@@ -435,6 +435,17 @@ class DispatchEngine:
         if it.event.is_set():
             return
         dm = _deadline()
+        if it.deadline is not None and it.deadline.expired():
+            # the deadline lapsed during a FAILED combined attempt: the
+            # waiter already raised 504 on its own clock — re-executing
+            # here would burn a full solo run on an abandoned future
+            # (the fault's blast radius leaking into device time). Give
+            # the item its honest outcome instead.
+            with self._mu:
+                self.expired += 1
+            metrics.count(metrics.PIPELINE_DEADLINE_EXPIRED, stage="dispatch")
+            it.finish(error=dm.DeadlineExceeded("dispatch"))
+            return
         measured: dict = {}
         try:
             with dm.activate(it.deadline), trace.attrib_activate(measured):

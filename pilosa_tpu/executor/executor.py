@@ -28,7 +28,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from pilosa_tpu.utils import metrics, profiler, trace
+from pilosa_tpu.utils import chaos, metrics, profiler, trace
 
 from pilosa_tpu import SHARD_WIDTH, ops
 from pilosa_tpu.core import Row, TopOptions, VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD
@@ -38,6 +38,12 @@ from pilosa_tpu.core.fragment import DEFAULT_MIN_THRESHOLD
 from pilosa_tpu.core.timequantum import TIME_FORMAT, views_by_time_range
 from pilosa_tpu.executor.batcher import BatchedScorer
 from pilosa_tpu.executor.devicehealth import DeviceDown
+from pilosa_tpu.executor.hbm import (
+    DeviceOom,
+    HbmGovernor,
+    OomRecovery,
+    classify_device_error,
+)
 from pilosa_tpu.executor.stager import DeviceStager
 from pilosa_tpu.pql import BETWEEN, Call, Condition, NEQ, Query, parse
 from pilosa_tpu.roaring import Bitmap
@@ -239,7 +245,7 @@ def _make_stacked_scorer() -> BatchedScorer:
     )
 
 
-def _timed_kernel(kind: str, fn, signature=None):
+def _timed_kernel(kind: str, fn, signature=None, recovery=None):
     """Wrap a cached jitted kernel with the compile-vs-execute timing
     split: the FIRST invocation traces + compiles inside XLA (observed
     as spmd.compile_seconds), warm invocations are dispatch only
@@ -250,19 +256,39 @@ def _timed_kernel(kind: str, fn, signature=None):
     on the outputs pins the measurement to real device completion
     instead of async-dispatch return, so the timing feeds the waterfall
     as device.compute and the first call feeds the compile tracker
-    under ``signature`` (the canonical plan key of the cached jit)."""
+    under ``signature`` (the canonical plan key of the cached jit).
+
+    And it is the OOM-recovery boundary (ISSUE 14): with ``recovery``
+    (an executor's OomRecovery) an allocation failure at dispatch or at
+    the fence evicts through the HBM governor and retries ONCE before
+    degrading the call to the CPU leg. The chaos hook fires INSIDE the
+    attempt, so a retry re-consults the injection counter and passes."""
 
     state = {"first": True}
 
-    def run(*args, **kw):
-        t0 = time.monotonic()
+    def attempt(*args, **kw):
+        cf = chaos.FAULTS
+        if cf is not None:
+            cf.on_kernel(kind)
         out = fn(*args, **kw)
         try:
             import jax  # lazy, matching this module's other jax uses
 
             jax.block_until_ready(out)
-        except Exception:
-            pass  # non-jax outputs (CPU fallbacks) have nothing to fence
+        except Exception as e:
+            # a device fault surfacing at the fence IS the kernel
+            # failing — the recovery policy must see it; anything else
+            # is a non-jax output with nothing to fence
+            if classify_device_error(e) is not None:
+                raise
+        return out
+
+    def run(*args, **kw):
+        t0 = time.monotonic()
+        if recovery is not None:
+            out = recovery.run(lambda: attempt(*args, **kw), kind=kind)
+        else:
+            out = attempt(*args, **kw)
         dt = time.monotonic() - t0
         first = state["first"]
         if first:
@@ -278,6 +304,12 @@ def _timed_kernel(kind: str, fn, signature=None):
         return out
 
     return run
+
+
+# post-OOM-degrade cooldown: after a device call degrades to CPU, the
+# device predicates stay CPU-forced this long so the immediate re-run
+# (and the next waves) don't launch straight back into the same OOM
+OOM_CPU_COOLDOWN_S = 30.0
 
 
 def _fetch(arr) -> np.ndarray:
@@ -312,6 +344,7 @@ class Executor:
         fusion_enabled: Optional[bool] = None,
         fusion_max_calls: int = 64,
         plan_cache_device_bytes: Optional[int] = None,
+        governor: Optional[HbmGovernor] = None,
     ) -> None:
         self.holder = holder
         self.cluster = cluster  # None = single-node
@@ -448,6 +481,28 @@ class Executor:
         # XLA's jit cache effective across queries
         self._spmd_kernels: dict[tuple, Any] = {}
         self._spmd_mu = threading.Lock()
+        # one HBM byte ledger for every device-resident tenant
+        # (executor/hbm.py): the stager, the device plan cache, and the
+        # batcher pad scratch stop overcommitting the chip through
+        # disjoint budgets — their old knobs become per-tenant shares
+        self.governor = governor if governor is not None else HbmGovernor()
+        self.stager.set_governor(self.governor)
+        if self.device_cache is not None:
+            self.device_cache.set_governor(self.governor)
+        for sc in (self.scorer, self.stacked_scorer, self.chain_scorer):
+            sc.set_governor(self.governor)
+        # OOM recovery policy shared by every device-call boundary:
+        # evict → retry once → degrade this call to the CPU leg; the
+        # health gate trips only on repeat unrecovered failures
+        self._oom_cpu_until = 0.0
+        self.oom_cpu_cooldown_s = float(
+            os.environ.get("PILOSA_OOM_CPU_COOLDOWN_S", OOM_CPU_COOLDOWN_S)
+        )
+        self._oom = OomRecovery(
+            governor=self.governor,
+            health=self.health,
+            on_degrade=self._on_oom_degrade,
+        )
 
     def _spmd_kernel(self, kind: str, *statics):
         key = (kind,) + statics
@@ -464,7 +519,7 @@ class Executor:
                     fn = spmd.topn_scores_sparse_spmd(self.mesh, *statics)
                 else:
                     raise ValueError(kind)
-                fn = _timed_kernel(kind, fn, signature=key)
+                fn = _timed_kernel(kind, fn, signature=key, recovery=self._oom)
                 self._spmd_kernels[key] = fn
             return fn
 
@@ -756,10 +811,19 @@ class Executor:
     # -- dispatch (reference executeCall, executor.go:165) -------------------
 
     def _cpu_forced(self) -> bool:
-        """True while the device gate is tripped. Checked by the device
-        predicates, so it applies on EVERY thread — including cluster
-        map-reduce pool workers — without per-thread state."""
-        return self.health is not None and not self.health.healthy
+        """True while the device gate is tripped OR the post-OOM-degrade
+        cooldown is running. Checked by the device predicates, so it
+        applies on EVERY thread — including cluster map-reduce pool
+        workers — without per-thread state."""
+        if self.health is not None and not self.health.healthy:
+            return True
+        return time.monotonic() < self._oom_cpu_until
+
+    def _on_oom_degrade(self) -> None:
+        """A device call degraded to CPU after failed OOM recovery:
+        force the CPU predicates for a cooldown so the immediate re-run
+        (and the next waves) don't launch straight back into the OOM."""
+        self._oom_cpu_until = time.monotonic() + self.oom_cpu_cooldown_s
 
     def _on_device_restore(self) -> None:
         """Replace machinery whose locks abandoned guard workers may
@@ -770,6 +834,12 @@ class Executor:
         self.scorer = BatchedScorer()
         self.stacked_scorer = _make_stacked_scorer()
         self.chain_scorer = _make_chain_scorer(self)
+        # the ledger must forget the dead runtime's pad scratch with
+        # the scorers; fresh instances re-register at zero
+        self.governor.reset("batcher")
+        for sc in (self.scorer, self.stacked_scorer, self.chain_scorer):
+            sc.set_governor(self.governor)
+        self._oom_cpu_until = 0.0
         self.stager.reset_after_wedge()
         if self.plan_cache is not None:
             # results computed by the wedged device must not outlive it
@@ -826,21 +896,43 @@ class Executor:
         device and skip the guard."""
         from pilosa_tpu.pql.ast import WRITE_CALLS
 
-        if (
+        if c.name in WRITE_CALLS:
+            # writes never touch the device: no guard, no OOM fallback
+            return self._execute_call_inner(index, c, shards, opt)
+        guarded = (
             self.health is not None
             and self.device_policy != "never"
-            and c.name not in WRITE_CALLS
             and not self._cpu_forced()
-        ):
-            # the guard pool is another thread: hand the active span over
-            parent = trace.current()
-            try:
+        )
+        try:
+            if guarded:
+                # the guard pool is another thread: hand the span over
+                parent = trace.current()
                 return self.health.guard(
                     lambda: self._execute_call_inner_on(parent, index, c, shards, opt)
                 )
-            except DeviceDown:
-                # gate now closed; fall through to the CPU path
-                metrics.count(metrics.EXECUTOR_DEVICE_DOWN_FALLBACK)
+            return self._execute_call_inner(index, c, shards, opt)
+        except DeviceDown:
+            # gate closed, or an unrecovered OOM degraded this call
+            # (DeviceOom): the CPU predicates are already forced (gate
+            # state / OOM cooldown), so the re-run is device-free
+            metrics.count(metrics.EXECUTOR_DEVICE_DOWN_FALLBACK)
+        except Exception as e:
+            # a raw device fault that escaped the kernel boundaries
+            # (e.g. surfaced at a batcher fetch): apply the same
+            # recovery policy here — classify, journal, evict, set the
+            # CPU cooldown — then serve from the CPU leg
+            if classify_device_error(e) is None:
+                raise
+
+            def _reraise():
+                raise e
+
+            try:
+                self._oom.run(_reraise, kind="call")
+            except DeviceOom:
+                pass
+            metrics.count(metrics.EXECUTOR_DEVICE_DOWN_FALLBACK)
         return self._execute_call_inner(index, c, shards, opt)
 
     def _execute_call_inner_on(self, parent, index, c, shards, opt) -> Any:
@@ -1394,6 +1486,7 @@ class Executor:
                 "tree_count",
                 jax.jit(lambda *ls: ops.count_bits(_eval_tree(tree, ls))[None]),
                 signature=key,
+                recovery=self._oom,
             )
             self._tree_jits[key] = fn
         return fn
@@ -1423,7 +1516,9 @@ class Executor:
                 pc = jax.lax.population_count(acc).astype(jnp.int32)
                 return jnp.sum(pc, axis=tuple(range(1, pc.ndim)))
 
-            fn = _timed_kernel("tree_count_batch", jax.jit(run), signature=key)
+            fn = _timed_kernel(
+                "tree_count_batch", jax.jit(run), signature=key, recovery=self._oom
+            )
             self._tree_batch_jits[key] = fn
         return fn
 

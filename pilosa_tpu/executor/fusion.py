@@ -44,7 +44,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from pilosa_tpu.utils import metrics, trace
+from pilosa_tpu.utils import chaos, metrics, trace
 
 # Deliberately a module-load import (executor.py only imports this
 # module lazily, inside Executor.__init__, so there is no cycle): the
@@ -96,6 +96,7 @@ class QueryFuser:
         self.fused_calls = 0
         self.cache_served = 0
         self.bytes_returned = 0
+        self.admission_splits = 0
         self.bypasses: dict[str, int] = {}
 
     # -- eligibility ---------------------------------------------------------
@@ -228,17 +229,48 @@ class QueryFuser:
             if u is not None:
                 units.append(u)
         launch = [u for u in units if u.desc is not None]
+        zero_only = [u for u in units if u.desc is None]
         if len(launch) < 2:
             # a single device call gains nothing over the per-call
             # batched path; keep classic routing (and its telemetry)
             self._bypass("too_few_fusable")
-            zero_only = [u for u in units if u.desc is None]
             return [(u.call_index, u.finish(None), 0.0) for u in zero_only]
+        served = self._launch_units(launch)
+        for u in zero_only:
+            served.append((u.call_index, u.finish(None), 0.0))
+        return served
+
+    def _launch_units(self, launch: list, depth: int = 0) -> list[tuple]:
+        """Launch lowered units as one fused program, under HBM
+        admission (ISSUE 14): the governor is asked whether the wave's
+        estimated transient peak fits current headroom BEFORE the
+        launch. A wave that does not fit splits in half (each half
+        re-admits — the estimate shrinks with the input set) instead of
+        launching into an OOM; a unit that cannot fit even alone is NOT
+        served, which routes it to the classic per-call path (bypass
+        reason "admission")."""
+        ex = self.ex
         flat: list = []
         descs: list = []
         for u in launch:
             descs.append(u.desc)
             flat.extend(u.inputs)
+        # transient-peak estimate: inputs live in HBM for the whole
+        # program and XLA holds roughly another copy in intermediates
+        # (the fold chain rewrites in place but fetch buffers, padding
+        # and fusion temporaries are real) — 2× summed input bytes
+        est = 2 * sum(int(getattr(a, "nbytes", 0)) for a in flat)
+        gov = getattr(ex, "governor", None)
+        if gov is not None and est > 0 and not gov.admit(est):
+            if len(launch) >= 2 and depth < 4:
+                self.admission_splits += 1
+                metrics.count(metrics.FUSION_ADMISSION_SPLITS)
+                mid = len(launch) // 2
+                return self._launch_units(
+                    launch[:mid], depth + 1
+                ) + self._launch_units(launch[mid:], depth + 1)
+            self._bypass("admission")
+            return []
         shapes = tuple(
             (tuple(getattr(a, "shape", ())), str(getattr(a, "dtype", "")))
             for a in flat
@@ -251,21 +283,16 @@ class QueryFuser:
         dt = time.monotonic() - t0
         nbytes = sum(int(o.nbytes) for o in fetched)
         self.fused_launches += 1
-        self.fused_calls += len(units)
+        self.fused_calls += len(launch)
         self.bytes_returned += nbytes
         metrics.count(metrics.FUSION_FUSED_LAUNCHES)
-        metrics.observe(metrics.FUSION_FUSED_CALLS_PER_LAUNCH, len(units))
+        metrics.observe(metrics.FUSION_FUSED_CALLS_PER_LAUNCH, len(launch))
         metrics.count(metrics.FUSION_BYTES_RETURNED, nbytes)
-        cost = dt / max(len(units), 1)
-        served: list[tuple] = []
-        k = 0
-        for u in units:
-            if u.desc is None:
-                served.append((u.call_index, u.finish(None), cost))
-            else:
-                served.append((u.call_index, u.finish(fetched[k]), cost))
-                k += 1
-        return served
+        cost = dt / max(len(launch), 1)
+        return [
+            (u.call_index, u.finish(fetched[k]), cost)
+            for k, u in enumerate(launch)
+        ]
 
     # -- per-call lowering ---------------------------------------------------
 
@@ -373,8 +400,17 @@ class QueryFuser:
         if fn is None:
             import jax
 
+            cf = chaos.FAULTS
+            if cf is not None:
+                # injected poisoned-jit fault: raising here lands in
+                # try_execute's error bypass → the whole query re-runs
+                # on the classic path, bit-identically
+                cf.on_lowering()
             fn = _timed_kernel(
-                "fused_query", jax.jit(_build_program(descs)), signature=key
+                "fused_query",
+                jax.jit(_build_program(descs)),
+                signature=key,
+                recovery=self.ex._oom,
             )
             with self._mu:
                 self._programs.setdefault(key, fn)
@@ -394,6 +430,7 @@ class QueryFuser:
             ),
             "bytes_returned": self.bytes_returned,
             "cache_served": self.cache_served,
+            "admission_splits": self.admission_splits,
             "programs": len(self._programs),
             "bypasses": dict(self.bypasses),
             "device_cache": (

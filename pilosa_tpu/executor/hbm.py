@@ -1,0 +1,421 @@
+"""HBM-pressure governance and OOM recovery (ISSUE 14).
+
+PR 12 made HBM load-bearing: staged fragment blocks, device-resident
+plan-cache entries, batcher pad scratch, and fused whole-query launches
+all compete for the same accelerator memory — previously under three
+*independent* byte budgets that could jointly overcommit the chip, and
+with no handling at all for an allocation failure (``RESOURCE_EXHAUSTED``
+surfaced as an unhandled 500). Two pieces fix that:
+
+* ``HbmGovernor`` — one process-wide byte ledger every HBM tenant
+  reserves against. The old per-subsystem knobs survive as per-tenant
+  *shares* of the global budget; the global budget is the sum of shares
+  unless pinned smaller by ``hbm-budget-bytes``. When the ledger runs
+  over (or live ``DeviceTelemetry`` gauges show real HBM pressure), the
+  governor relieves in tiers: the device plan cache first (pure derived
+  state, cheapest to rebuild), then cold stager blocks. Fused launches
+  consult ``admit()`` with their estimated transient peak BEFORE
+  launching, so a wave that cannot fit is split or routed to the classic
+  per-call path instead of launched into an OOM.
+
+* ``OomRecovery`` — the policy applied at the device-call boundaries
+  (``_timed_kernel``, the fused launch, the batched scorers): classify
+  the failure (allocation vs. wedge), journal ``device.oom``, then for
+  an allocation failure evict through the governor tiers and retry the
+  call ONCE; if the retry also fails (or the error is a wedge-class
+  runtime fault) the call degrades to the CPU roaring leg by raising
+  ``DeviceOom`` — a ``DeviceDown`` subclass, so the executor's existing
+  fallback path serves the query from host bitmaps. ``DeviceHealth``
+  trips only on REPEAT unrecovered failures inside a short window —
+  never a wedged process, never a wrong answer, and a single transient
+  OOM never gates a healthy device off.
+
+Lock discipline: the governor's ledger lock is never held across a
+tenant eviction callback (those take the stager/plan-cache locks), and
+tenants never call back into the governor while holding their own locks
+in a way that re-enters ``relieve`` on themselves — ``reserve`` excludes
+the requesting tenant from the relief sweep; the tenant's own LRU loop
+handles its share.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from pilosa_tpu.analysis.locks import OrderedLock
+from pilosa_tpu.executor.devicehealth import DeviceDown
+from pilosa_tpu.utils import events, metrics
+
+
+class DeviceOom(DeviceDown):
+    """An unrecovered device allocation failure. Subclasses DeviceDown
+    so the executor's existing guarded-call fallback serves the query
+    from the CPU roaring path; the health gate is NOT tripped (that is
+    OomRecovery's call, and only on repeat failure)."""
+
+
+# -- error classification -----------------------------------------------------
+
+# substrings that mark an allocation failure (XLA RESOURCE_EXHAUSTED,
+# PJRT "out of memory", injected faults from utils/chaos.py)
+_ALLOC_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory", "OOM")
+# exception type names raised by jax/XLA runtime failures; anything
+# else textual that marks a device-side runtime fault
+_RUNTIME_TYPES = ("XlaRuntimeError", "JaxRuntimeError")
+_WEDGE_MARKERS = ("INTERNAL:", "DATA_LOSS", "FAILED_PRECONDITION", "ABORTED")
+
+
+def classify_device_error(exc: BaseException) -> Optional[str]:
+    """``"alloc"`` for an allocation failure (eviction + retry can
+    help), ``"wedge"`` for a non-allocation device runtime fault
+    (retry is pointless; degrade and let repeat failures trip the
+    health gate), ``None`` for anything that is not a device error —
+    those propagate untouched (a shape bug must stay a loud bug)."""
+    text = f"{type(exc).__name__}: {exc}"
+    if any(m in text for m in _ALLOC_MARKERS):
+        return "alloc"
+    if type(exc).__name__ in _RUNTIME_TYPES:
+        return "wedge"
+    if any(m in text for m in _WEDGE_MARKERS):
+        return "wedge"
+    return None
+
+
+# -- the byte ledger ----------------------------------------------------------
+
+
+class _Tenant:
+    __slots__ = ("name", "share", "evict_fn", "tier", "used")
+
+    def __init__(self, name: str, share: int, evict_fn, tier: int) -> None:
+        self.name = name
+        self.share = share
+        self.evict_fn = evict_fn
+        self.tier = tier
+        self.used = 0
+
+
+class HbmGovernor:
+    """One process-wide HBM byte ledger with tiered pressure relief.
+
+    Tenants register with a *share* (their old standalone budget — the
+    per-tenant cap) and optionally an ``evict_fn(need_bytes) -> freed``
+    callback plus a *tier* (lower tiers evict first). ``reserve`` /
+    ``release`` keep the ledger; a reserve that pushes the TOTAL over
+    the global budget triggers a relief sweep over the OTHER tenants'
+    tiers (the requester's own LRU loop handles its share), and reports
+    whether the ledger is back under budget. ``admit`` answers the
+    fused-launch admission question: does an estimated transient peak
+    fit in current headroom (relieving first if not)?
+    """
+
+    # fraction of the live telemetry limit above which a reserve/admit
+    # opportunistically relieves pressure even when the ledger itself
+    # is under budget (mirrors the profiler's hbm-watermark default)
+    TELEMETRY_WATERMARK = 0.9
+
+    def __init__(self, budget_bytes: int = 0) -> None:
+        # 0 = derive from the sum of registered shares (the compatible
+        # default: each tenant capped at its old knob, total capped at
+        # their sum); > 0 pins the global budget below that sum — the
+        # double-budget overcommit fix
+        self.budget_bytes = int(budget_bytes)
+        self._mu = OrderedLock("hbm.governor_mu")
+        self._tenants: dict[str, _Tenant] = {}
+
+    # -- registration ---------------------------------------------------------
+
+    def register(
+        self,
+        name: str,
+        share_bytes: int = 0,
+        evict_fn: Optional[Callable[[int], int]] = None,
+        tier: int = 99,
+    ) -> None:
+        with self._mu:
+            t = self._tenants.get(name)
+            if t is None:
+                t = _Tenant(name, int(share_bytes), evict_fn, tier)
+                self._tenants[name] = t
+            else:
+                t.share = int(share_bytes)
+                t.evict_fn = evict_fn
+                t.tier = tier
+
+    # -- accounting -----------------------------------------------------------
+
+    def budget(self) -> int:
+        with self._mu:
+            return self._budget_locked()
+
+    def _budget_locked(self) -> int:
+        if self.budget_bytes > 0:
+            return self.budget_bytes
+        return sum(t.share for t in self._tenants.values()) or (8 << 30)
+
+    def used(self, name: Optional[str] = None) -> int:
+        with self._mu:
+            if name is not None:
+                t = self._tenants.get(name)
+                return t.used if t is not None else 0
+            return sum(t.used for t in self._tenants.values())
+
+    def headroom(self) -> int:
+        with self._mu:
+            return self._budget_locked() - sum(
+                t.used for t in self._tenants.values()
+            )
+
+    def over_budget(self) -> int:
+        """Bytes the ledger currently exceeds the global budget by
+        (0 when under). Tenants consult this in their own LRU-evict
+        loops so evicting their entries converges the global ledger,
+        not just their share."""
+        return max(0, -self.headroom())
+
+    def reserve(self, name: str, nbytes: int) -> bool:
+        """Record ``nbytes`` against ``name``'s account. Always records
+        (the bytes are already being uploaded — the ledger must reflect
+        reality); returns False when the ledger remains over budget
+        after relieving the OTHER tenants, in which case the caller
+        evicts its own LRU entries (its loop also checks
+        ``over_budget``)."""
+        nbytes = int(nbytes)
+        with self._mu:
+            t = self._tenants.get(name)
+            if t is None:
+                t = _Tenant(name, 0, None, 99)
+                self._tenants[name] = t
+            t.used += nbytes
+            used = t.used
+        metrics.gauge(metrics.HBM_GOVERNOR_BYTES, used, tenant=name)
+        if self.over_budget() > 0:
+            self.relieve(exclude=name)
+        self._telemetry_relief(exclude=name)
+        return self.over_budget() <= 0
+
+    def release(self, name: str, nbytes: int) -> None:
+        with self._mu:
+            t = self._tenants.get(name)
+            if t is None:
+                return
+            t.used = max(0, t.used - int(nbytes))
+            used = t.used
+        metrics.gauge(metrics.HBM_GOVERNOR_BYTES, used, tenant=name)
+
+    def reset(self, name: Optional[str] = None) -> None:
+        """Zero an account (or every account): the wedge-recovery /
+        epoch-fence path — the arrays the ledger tracked died with the
+        device context, so the ledger must not remember them."""
+        with self._mu:
+            tenants = (
+                [self._tenants[name]] if name in self._tenants else []
+            ) if name is not None else list(self._tenants.values())
+            for t in tenants:
+                t.used = 0
+        for t in tenants:
+            metrics.gauge(metrics.HBM_GOVERNOR_BYTES, 0, tenant=t.name)
+
+    # -- admission + relief ---------------------------------------------------
+
+    def admit(self, nbytes: int) -> bool:
+        """Fused-launch admission: does an estimated transient peak of
+        ``nbytes`` fit in current headroom? Relieves the tiers first
+        when it would not — admission prefers evicting rebuildable
+        cache state over refusing a launch."""
+        nbytes = int(nbytes)
+        if nbytes <= self.headroom():
+            return True
+        self.relieve(need=nbytes)
+        return nbytes <= self.headroom()
+
+    def relieve(self, need: int = 0, exclude: Optional[str] = None) -> int:
+        """Evict through the tiers (device plan cache first, then cold
+        stager blocks) until the ledger has ``need`` bytes of headroom
+        (or, with ``need=0``, is back under budget). Callbacks run
+        WITHOUT the governor lock — they take their owners' locks and
+        call ``release`` re-entrantly. Returns bytes freed."""
+        with self._mu:
+            tiers = sorted(
+                (t for t in self._tenants.values() if t.evict_fn is not None),
+                key=lambda t: t.tier,
+            )
+        freed_total = 0
+        for t in tiers:
+            deficit = (
+                max(0, int(need) - self.headroom()) if need else self.over_budget()
+            )
+            if deficit <= 0:
+                break
+            if t.name == exclude:
+                continue
+            try:
+                freed = int(t.evict_fn(deficit) or 0)
+            except Exception:
+                freed = 0
+            if freed > 0:
+                freed_total += freed
+                metrics.count(metrics.HBM_GOVERNOR_EVICTIONS, tier=t.name)
+        return freed_total
+
+    def relieve_for_oom(self) -> int:
+        """The aggressive post-OOM sweep: a real RESOURCE_EXHAUSTED
+        means the chip is out of memory regardless of what the ledger
+        believed (XLA scratch and fusion intermediates are invisible to
+        it), so skip the deficit arithmetic and ask every tier to free
+        everything it can before the single retry."""
+        with self._mu:
+            tiers = sorted(
+                (t for t in self._tenants.values() if t.evict_fn is not None),
+                key=lambda t: t.tier,
+            )
+            budget = self._budget_locked()
+        freed_total = 0
+        for t in tiers:
+            try:
+                freed = int(t.evict_fn(budget) or 0)
+            except Exception:
+                freed = 0
+            if freed > 0:
+                freed_total += freed
+                metrics.count(metrics.HBM_GOVERNOR_EVICTIONS, tier=t.name)
+        return freed_total
+
+    def _telemetry_relief(self, exclude: Optional[str] = None) -> None:
+        """Pressure relief driven by live DeviceTelemetry HBM gauges:
+        when the poller has a real ``memory_stats()`` sample showing
+        the device above the watermark, evict through the tiers even
+        though the ledger itself is under budget (the ledger only sees
+        OUR tenants; XLA scratch and fusion intermediates are real)."""
+        try:
+            from pilosa_tpu.utils import profiler
+
+            last = profiler.TELEMETRY.last or {}
+            devices = last.get("devices") or {}
+        except Exception:
+            return
+        for dev in devices.values():
+            in_use = dev.get("bytes_in_use") or 0
+            limit = dev.get("bytes_limit") or 0
+            if limit and in_use > limit * self.TELEMETRY_WATERMARK:
+                self.relieve(
+                    need=int(in_use - limit * self.TELEMETRY_WATERMARK),
+                    exclude=exclude,
+                )
+                return
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "budget_bytes": self._budget_locked(),
+                "used_bytes": sum(t.used for t in self._tenants.values()),
+                "tenants": {
+                    t.name: {"used": t.used, "share": t.share, "tier": t.tier}
+                    for t in self._tenants.values()
+                },
+            }
+
+
+# -- OOM recovery at the device-call boundaries -------------------------------
+
+
+class OomRecovery:
+    """Evict → retry once → degrade-to-CPU, with health tripped only on
+    repeat failure. One instance per executor, shared by ``_timed_kernel``
+    closures, the fused launcher, and the batched scorers."""
+
+    def __init__(
+        self,
+        governor: Optional[HbmGovernor] = None,
+        health=None,
+        on_degrade: Optional[Callable[[], None]] = None,
+        trip_after: int = 2,
+        window_s: float = 30.0,
+    ) -> None:
+        self.governor = governor
+        self.health = health
+        self.on_degrade = on_degrade
+        self.trip_after = trip_after
+        self.window_s = window_s
+        self._mu = threading.Lock()
+        self._failures: list[float] = []  # monotonic stamps of degrades
+        # telemetry (read by stats/tests)
+        self.ooms = 0
+        self.recovered = 0
+        self.degraded = 0
+
+    def run(self, fn: Callable, kind: str = "kernel"):
+        """Run a device call under the recovery policy. Raises
+        ``DeviceOom`` when the call must degrade to the CPU leg;
+        re-raises non-device errors untouched."""
+        try:
+            return fn()
+        except Exception as e:
+            cls = classify_device_error(e)
+            if cls is None:
+                raise
+            with self._mu:
+                self.ooms += 1
+            metrics.count(metrics.DEVICE_OOM, kind=kind, cls=cls)
+            events.record(
+                events.DEVICE_OOM, boundary=kind, cls=cls, error=str(e)[:200]
+            )
+            if cls == "alloc":
+                if self.governor is not None:
+                    self.governor.relieve_for_oom()
+                try:
+                    out = fn()
+                except Exception as e2:
+                    if classify_device_error(e2) is None:
+                        raise
+                else:
+                    with self._mu:
+                        self.recovered += 1
+                        self._failures.clear()
+                    metrics.count(metrics.DEVICE_OOM_RECOVERED, path="retry")
+                    events.record(
+                        events.DEVICE_OOM_RECOVERED, boundary=kind, path="retry"
+                    )
+                    return out
+            # allocation retry failed too, or a wedge-class fault:
+            # degrade this call to the CPU leg and remember the failure
+            self._degrade(kind, e)
+
+    def _degrade(self, kind: str, cause: BaseException) -> None:
+        now = time.monotonic()
+        with self._mu:
+            self.degraded += 1
+            self._failures = [
+                t for t in self._failures if now - t < self.window_s
+            ]
+            self._failures.append(now)
+            repeat = len(self._failures) >= self.trip_after
+        metrics.count(metrics.DEVICE_OOM_CPU_DEGRADES)
+        metrics.count(metrics.DEVICE_OOM_RECOVERED, path="cpu")
+        events.record(events.DEVICE_OOM_RECOVERED, boundary=kind, path="cpu")
+        cb = self.on_degrade
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
+        if repeat and self.health is not None:
+            # repeat unrecovered failures inside the window: this is no
+            # longer a transient — gate the device and let the probe
+            # loop + restore callback rebuild the device-side machinery
+            try:
+                self.health.trip("repeated unrecovered device OOM")
+            except Exception:
+                pass
+        raise DeviceOom(f"device {kind} failed after OOM recovery") from cause
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "ooms": self.ooms,
+                "recovered": self.recovered,
+                "degraded": self.degraded,
+                "recent_failures": len(self._failures),
+            }

@@ -122,6 +122,12 @@ class DeviceStager:
         # (the scatter stops winning once it rewrites much of the block)
         self.delta_enabled = delta_enabled
         self.delta_max_ratio = delta_max_ratio
+        # process-wide HBM governor (executor/hbm.py): when attached via
+        # set_governor, budget_bytes becomes this stager's tenant SHARE
+        # of the global ledger and cold LRU blocks its relief tier —
+        # the stager can no longer overcommit the chip jointly with the
+        # device plan cache
+        self.governor = None
         self._cache: OrderedDict[tuple, _Entry] = OrderedDict()
         self._bytes = 0
         self._mu = OrderedLock("stager.mu")
@@ -254,22 +260,46 @@ class DeviceStager:
                 fl.error = e
                 fl.event.set()
                 raise
+            # ledger first, insert second: reserve runs the governor's
+            # relief sweep over OTHER tenants (device plan cache) and
+            # MUST NOT hold _mu — its eviction callbacks take their
+            # owners' locks (lock order: tenant lock → governor lock,
+            # never the reverse)
+            gov = self.governor
+            if gov is not None:
+                gov.reserve("stager", nbytes)
+            gov_return = 0  # bytes handed back to the ledger after insert
             with self._mu:
                 if self._epoch == epoch:
                     old = self._cache.pop(key, None)
                     if old is not None:
                         self._bytes -= old.nbytes
+                        gov_return += old.nbytes
                     self._cache[key] = _Entry(value, nbytes, built_gen)
                     self._bytes += nbytes
-                    while self._bytes > self.budget_bytes and len(self._cache) > 1:
+                    # evict LRU past the tenant share — and past the
+                    # GLOBAL budget (over_budget already nets out the
+                    # gov_return bytes released below)
+                    while (
+                        self._bytes > self.budget_bytes
+                        or (gov is not None and gov.over_budget() > gov_return)
+                    ) and len(self._cache) > 1:
                         _, old_ent = self._cache.popitem(last=False)
                         self._bytes -= old_ent.nbytes
+                        gov_return += old_ent.nbytes
                     self._inflight.pop(key, None)
                     metrics.gauge(metrics.STAGER_BYTES, self._bytes)
-                elif self._inflight.get(key) is fl:
-                    # same epoch-stale builder still registered (no rebuild
-                    # raced in): unregister without caching the stale value
-                    self._inflight.pop(key, None)
+                else:
+                    # epoch-stale: the value never enters the cache, so
+                    # its reservation goes straight back
+                    gov_return += nbytes
+                    if self._inflight.get(key) is fl:
+                        # same epoch-stale builder still registered (no
+                        # rebuild raced in): unregister without caching
+                        # the stale value
+                        self._inflight.pop(key, None)
+            if gov is not None and gov_return:
+                gov.release("stager", gov_return)
             fl.gen = built_gen
             fl.value = value
             fl.event.set()
@@ -826,6 +856,43 @@ class DeviceStager:
             except BaseException:
                 pass  # advisory: the query path stages for real
 
+    def set_governor(self, governor) -> None:
+        """Attach the process-wide HBM governor (executor/hbm.py): the
+        budget knob becomes this stager's tenant share, cold LRU blocks
+        its relief tier (tier 1 — evicted after the device plan cache),
+        and any already-resident bytes join the ledger."""
+        self.governor = governor
+        if governor is None:
+            return
+        governor.register(
+            "stager",
+            share_bytes=self.budget_bytes,
+            evict_fn=self._evict_cold,
+            tier=1,
+        )
+        with self._mu:
+            current = self._bytes
+        if current:
+            governor.reserve("stager", current)
+
+    def _evict_cold(self, need: int) -> int:
+        """Governor relief tier: drop cold (LRU) staged blocks until
+        ``need`` bytes are freed, always keeping the hottest entry —
+        the block a query is most likely touching right now. Called by
+        the governor WITHOUT its lock held; the release below keeps the
+        ledger exact."""
+        freed = 0
+        with self._mu:
+            while freed < need and len(self._cache) > 1:
+                _, ent = self._cache.popitem(last=False)
+                self._bytes -= ent.nbytes
+                freed += ent.nbytes
+            if freed:
+                metrics.gauge(metrics.STAGER_BYTES, self._bytes)
+        if freed and self.governor is not None:
+            self.governor.release("stager", freed)
+        return freed
+
     def clear(self) -> None:
         with self._mu:
             self._cache.clear()
@@ -834,6 +901,8 @@ class DeviceStager:
             # value to current waiters through the _InFlight object, but
             # nothing stale survives here if one errors after clear().
             self._inflight.clear()
+        if self.governor is not None:
+            self.governor.reset("stager")
 
     def reset_after_wedge(self) -> None:
         """Recover from a device wedge (called by the health gate on
@@ -849,6 +918,10 @@ class DeviceStager:
             self._bytes = 0
             self._epoch += 1  # zombie builders must not repopulate
             stale, self._inflight = self._inflight, {}
+        # the ledger must forget the dead runtime's arrays with us —
+        # the epoch fence extends to the governor (ISSUE 14)
+        if self.governor is not None:
+            self.governor.reset("stager")
         for fl in stale.values():
             if not fl.event.is_set():
                 fl.error = RuntimeError("staging abandoned: device wedged")

@@ -331,6 +331,43 @@ class DevicePlanCache:
         self.invalidations = 0
         self.evictions = 0
         self.inserts = 0
+        # process-wide HBM governor (executor/hbm.py): when attached,
+        # max_bytes becomes this cache's tenant SHARE of the global
+        # ledger and the cache is the FIRST relief tier — pure derived
+        # state, cheapest thing on the chip to rebuild
+        self.governor = None
+
+    def set_governor(self, governor) -> None:
+        self.governor = governor
+        if governor is None:
+            return
+        governor.register(
+            "device_cache",
+            share_bytes=self.max_bytes,
+            evict_fn=self._evict_lru,
+            tier=0,
+        )
+        with self._mu:
+            current = self.bytes
+        if current:
+            governor.reserve("device_cache", current)
+
+    def _evict_lru(self, need: int) -> int:
+        """Governor relief tier 0: drop LRU entries until ``need``
+        bytes are freed. Called WITHOUT the governor lock held."""
+        freed = 0
+        with self._mu:
+            while freed < need and self._entries:
+                _, e = self._entries.popitem(last=False)
+                self.bytes -= e.nbytes
+                freed += e.nbytes
+                self.evictions += 1
+                metrics.count(metrics.PLANCACHE_DEVICE_EVICTIONS)
+            if freed:
+                metrics.gauge(metrics.PLANCACHE_DEVICE_BYTES, self.bytes)
+        if freed and self.governor is not None:
+            self.governor.release("device_cache", freed)
+        return freed
 
     def get(self, key, genvec_fn: Callable[[], tuple]):
         """The resident device array for ``key`` valid at the CURRENT
@@ -339,23 +376,29 @@ class DevicePlanCache:
         singleflight here, and concurrent misses for one key just
         upload the same immutable content twice."""
         genvec = genvec_fn()
-        with self._mu:
-            e = self._entries.get(key)
-            if e is None:
-                self.misses += 1
-                return None
-            if e.genvec != genvec:
-                del self._entries[key]
-                self.bytes -= e.nbytes
-                self.invalidations += 1
-                self.misses += 1
-                metrics.count(metrics.PLANCACHE_INVALIDATIONS)
-                metrics.gauge(metrics.PLANCACHE_DEVICE_BYTES, self.bytes)
-                return None
-            self._entries.move_to_end(key)
-            self.hits += 1
-            metrics.count(metrics.PLANCACHE_DEVICE_HITS)
-            return e.value
+        freed = 0
+        try:
+            with self._mu:
+                e = self._entries.get(key)
+                if e is None:
+                    self.misses += 1
+                    return None
+                if e.genvec != genvec:
+                    del self._entries[key]
+                    self.bytes -= e.nbytes
+                    freed = e.nbytes
+                    self.invalidations += 1
+                    self.misses += 1
+                    metrics.count(metrics.PLANCACHE_INVALIDATIONS)
+                    metrics.gauge(metrics.PLANCACHE_DEVICE_BYTES, self.bytes)
+                    return None
+                self._entries.move_to_end(key)
+                self.hits += 1
+                metrics.count(metrics.PLANCACHE_DEVICE_HITS)
+                return e.value
+        finally:
+            if freed and self.governor is not None:
+                self.governor.release("device_cache", freed)
 
     def put(self, key, genvec, value, nbytes: int, epoch0=None) -> None:
         """Insert a device array stamped with the generation vector
@@ -366,21 +409,36 @@ class DevicePlanCache:
         nbytes = int(nbytes)
         if nbytes > self.max_bytes:
             return
+        # reserve OUTSIDE _mu: the governor's relief sweep may evict
+        # cold stager blocks, and those callbacks take the stager lock
+        # (lock order: tenant lock → governor lock, never the reverse)
+        gov = self.governor
+        if gov is not None:
+            gov.reserve("device_cache", nbytes)
+        gov_return = 0
         with self._mu:
             if epoch0 is not None and self.epoch != epoch0:
-                return
-            old = self._entries.pop(key, None)
-            if old is not None:
-                self.bytes -= old.nbytes
-            self._entries[key] = _Entry(value, nbytes, genvec)
-            self.bytes += nbytes
-            self.inserts += 1
-            while self.bytes > self.max_bytes and self._entries:
-                _, e = self._entries.popitem(last=False)
-                self.bytes -= e.nbytes
-                self.evictions += 1
-                metrics.count(metrics.PLANCACHE_DEVICE_EVICTIONS)
-            metrics.gauge(metrics.PLANCACHE_DEVICE_BYTES, self.bytes)
+                gov_return = nbytes
+            else:
+                old = self._entries.pop(key, None)
+                if old is not None:
+                    self.bytes -= old.nbytes
+                    gov_return += old.nbytes
+                self._entries[key] = _Entry(value, nbytes, genvec)
+                self.bytes += nbytes
+                self.inserts += 1
+                while (
+                    self.bytes > self.max_bytes
+                    or (gov is not None and gov.over_budget() > gov_return)
+                ) and self._entries:
+                    _, e = self._entries.popitem(last=False)
+                    self.bytes -= e.nbytes
+                    gov_return += e.nbytes
+                    self.evictions += 1
+                    metrics.count(metrics.PLANCACHE_DEVICE_EVICTIONS)
+                metrics.gauge(metrics.PLANCACHE_DEVICE_BYTES, self.bytes)
+        if gov is not None and gov_return:
+            gov.release("device_cache", gov_return)
 
     def epoch_reset(self) -> None:
         """Drop every resident array and fence out packs that started
@@ -390,6 +448,9 @@ class DevicePlanCache:
             self.bytes = 0
             self.epoch += 1
             metrics.gauge(metrics.PLANCACHE_DEVICE_BYTES, 0)
+        # the epoch fence extends to the governor ledger (ISSUE 14)
+        if self.governor is not None:
+            self.governor.reset("device_cache")
 
     def stats(self) -> dict:
         """Merged into the /debug/fusion snapshot."""

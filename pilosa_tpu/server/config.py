@@ -241,6 +241,23 @@ class Config:
     # subtree bitmap stacks pinned on device so repeated subtrees stop
     # re-uploading. 0 disables (host plan cache still works)
     plan_cache_device_bytes: int = 64 << 20
+    # global HBM budget for the governor ledger (executor/hbm.py):
+    # every device-resident tenant (stager blocks, device plan cache,
+    # batcher pad scratch, fused-launch transients) reserves against
+    # ONE byte budget. 0 = the sum of the tenant shares (each subsystem
+    # capped at its own knob, as before); > 0 pins the global total
+    # BELOW that sum — the fix for the budgets jointly overcommitting
+    # the chip
+    hbm_budget_bytes: int = 0
+    # device fault injection (tests/dryruns only, utils/chaos.py):
+    # "oom_every=N,stall_every=N,stall_s=S,poison_every=N,after=K" —
+    # see chaos.DeviceFaultSpec; "" disables
+    device_faults: str = ""
+    # gate for the runtime chaos-window endpoint (POST /debug/chaos):
+    # installs/clears storage+device+distributed fault schedules on a
+    # LIVE server. Off by default — a production server must not expose
+    # a fault injector
+    chaos_enabled: bool = False
     # performance attribution (utils/profiler.py, utils/slo.py):
     # continuous thread-stack sampler frequency in Hz (0 disables)
     profiler_hz: float = 10.0
@@ -362,6 +379,9 @@ class Config:
             f"fusion-enabled = {'true' if self.fusion_enabled else 'false'}",
             f"fusion-max-calls = {self.fusion_max_calls}",
             f"plan-cache-device-bytes = {self.plan_cache_device_bytes}",
+            f"hbm-budget-bytes = {self.hbm_budget_bytes}",
+            f'device-faults = "{self.device_faults}"',
+            f"chaos-enabled = {'true' if self.chaos_enabled else 'false'}",
             f"profiler-hz = {self.profiler_hz}",
             f"hbm-watermark-pct = {self.hbm_watermark_pct}",
             f'slo-objectives = "{self.slo_objectives}"',

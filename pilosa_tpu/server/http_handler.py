@@ -205,6 +205,8 @@ class Handler:
             Route("GET", r"/debug/ingest", self.get_debug_ingest),
             Route("GET", r"/debug/dispatch", self.get_debug_dispatch),
             Route("GET", r"/debug/fusion", self.get_debug_fusion),
+            Route("GET", r"/debug/chaos", self.get_debug_chaos),
+            Route("POST", r"/debug/chaos", self.post_debug_chaos),
             Route("GET", r"/debug/multihost", self.get_debug_multihost),
             Route("GET", r"/debug/plancache", self.get_debug_plancache),
             Route("GET", r"/debug/vars", self.get_debug_vars),
@@ -835,6 +837,65 @@ class Handler:
         if fuser is None:
             return {"enabled": False}
         return fuser.stats()
+
+    def get_debug_chaos(self, req) -> dict:
+        """Device-robustness snapshot: the HBM governor ledger, the
+        OOM-recovery counters, health-gate trips, and which injected
+        fault schedules are currently installed."""
+        from pilosa_tpu.core import fragment as fragment_mod
+        from pilosa_tpu.utils import chaos as chaos_mod
+
+        ex = self.api.executor
+        server = getattr(self.api, "server", None)
+        gov = getattr(ex, "governor", None)
+        oom = getattr(ex, "_oom", None)
+        health = getattr(ex, "health", None)
+        return {
+            "enabled": bool(
+                server is not None
+                and getattr(server.config, "chaos_enabled", False)
+            ),
+            "governor": gov.stats() if gov is not None else None,
+            "oom": oom.stats() if oom is not None else None,
+            "health_trips": health.trips if health is not None else 0,
+            "faults": {
+                "storage": bool(fragment_mod.FAULTS),
+                "device": bool(chaos_mod.FAULTS),
+            },
+        }
+
+    def post_debug_chaos(self, req) -> dict:
+        """Install or clear fault windows on a LIVE server — the chaos
+        harness's window control. Body: ``{"storage": "<spec>",
+        "device": "<spec>"}``; an empty/absent spec clears that family
+        (distributed faults wrap the gang channel at boot, so they ride
+        the ``distributed-faults`` knob, not this endpoint). Gated by
+        ``chaos-enabled``: a production server must not expose a fault
+        injector. Each transition journals ``chaos.window``."""
+        server = getattr(self.api, "server", None)
+        if server is None or not getattr(server.config, "chaos_enabled", False):
+            raise APIError(
+                "chaos endpoint disabled (chaos-enabled = false)", status=403
+            )
+        from pilosa_tpu.core import fragment as fragment_mod
+        from pilosa_tpu.utils import chaos as chaos_mod
+
+        body = json.loads(req.body or b"{}")
+        storage = str(body.get("storage") or "")
+        device = str(body.get("device") or "")
+        try:
+            fragment_mod.install_storage_faults(storage)
+            chaos_mod.install_device_faults(device)
+        except ValueError as e:
+            raise APIError(str(e), status=400)
+        installed = bool(storage or device)
+        events.record(
+            events.CHAOS_WINDOW,
+            action="install" if installed else "clear",
+            storage=storage,
+            device=device,
+        )
+        return {"installed": installed, "storage": storage, "device": device}
 
     def get_debug_traces(self, req) -> dict:
         """Recent completed query traces (the tracer's ring buffer) as

@@ -155,6 +155,14 @@ class IngestQueue:
                 "ingest-commit", "ingest wave did not commit before the deadline"
             )
         if b.error is not None:
+            if isinstance(b.error, OSError):
+                # a storage-layer commit failure (fsync EIO, ENOSPC,
+                # torn append) nacked the whole wave BEFORE apply: the
+                # write did not happen, repair already re-opened the
+                # log, and a retry is safe — that is a 503, not a 500
+                raise Overloaded(
+                    f"write wave aborted: {b.error}", status=503
+                ) from b.error
             raise b.error
         return n
 

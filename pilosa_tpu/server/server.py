@@ -16,6 +16,7 @@ from typing import Optional
 
 from pilosa_tpu.core import Holder
 from pilosa_tpu.executor import DeviceStager, Executor
+from pilosa_tpu.executor.hbm import HbmGovernor
 from pilosa_tpu.server.api import API
 from pilosa_tpu.server.config import Config
 from pilosa_tpu.server.http_handler import Handler, make_http_server
@@ -167,6 +168,11 @@ class Server:
         # process-wide for the same reason
         fragment_mod.DELTA_MAX_BATCH = self.config.ingest_delta_max_batch
         fragment_mod.install_storage_faults(self.config.storage_faults)
+        # device fault injection (utils/chaos.py) is process-wide for
+        # the same reason; the chaos endpoint re-installs at runtime
+        from pilosa_tpu.utils import chaos as chaos_mod
+
+        chaos_mod.install_device_faults(self.config.device_faults)
         # serving deployments get the device health gate: a wedged
         # accelerator (hung tunnel/PJRT call) degrades reads to the CPU
         # roaring path instead of hanging them, and a background probe
@@ -219,6 +225,7 @@ class Server:
             fusion_enabled=self.config.fusion_enabled,
             fusion_max_calls=self.config.fusion_max_calls,
             plan_cache_device_bytes=self.config.plan_cache_device_bytes,
+            governor=HbmGovernor(budget_bytes=self.config.hbm_budget_bytes),
         )
         self.api = API(self.holder, self.executor, cluster=cluster, server=self)
         # federation (parallel/federation.py): epoch adopted from the

@@ -42,6 +42,12 @@ INGEST_FAULT = "ingest.fault"
 PROFILER_HBM_WATERMARK = "profiler.hbm_watermark"
 PROFILER_RECOMPILE_STORM = "profiler.recompile_storm"
 SLO_BURN = "slo.burn"
+# device robustness (ISSUE 14): OOM capture/recovery at the device
+# boundaries, injected device faults, and chaos-window transitions
+DEVICE_OOM = "device.oom"
+DEVICE_OOM_RECOVERED = "device.oom_recovered"
+DEVICE_FAULT = "device.fault"
+CHAOS_WINDOW = "chaos.window"
 
 # kind → one-line description; the docs/administration.md event-kind
 # catalog is sync-tested against this registry both directions, so a
@@ -58,6 +64,10 @@ EVENT_KINDS: dict = {
     PROFILER_HBM_WATERMARK: "device memory crossed hbm-watermark-pct of its limit",
     PROFILER_RECOMPILE_STORM: "XLA compile burst exceeded the storm window",
     SLO_BURN: "error-budget burn rate over threshold on both SLO windows",
+    DEVICE_OOM: "device allocation failure caught at a kernel/fusion/batcher boundary",
+    DEVICE_OOM_RECOVERED: "device OOM recovered via governor eviction + retry or CPU degrade",
+    DEVICE_FAULT: "injected device fault (fault-injection harness)",
+    CHAOS_WINDOW: "chaos harness fault window installed or cleared",
 }
 
 

@@ -158,6 +158,7 @@ FUSION_FUSED_LAUNCHES = "fusion.fused_launches"
 FUSION_FUSED_CALLS_PER_LAUNCH = "fusion.fused_calls_per_launch"
 FUSION_BYTES_RETURNED = "fusion.bytes_returned"
 FUSION_BYPASSES = "fusion.bypasses"
+FUSION_ADMISSION_SPLITS = "fusion.admission_splits"
 # device-resident plan cache (plan/cache.py DevicePlanCache)
 PLANCACHE_DEVICE_HITS = "plancache.device_hits"
 PLANCACHE_DEVICE_EVICTIONS = "plancache.device_evictions"
@@ -185,6 +186,15 @@ HBM_BYTES_IN_USE = "hbm.bytes_in_use"
 HBM_PEAK_BYTES = "hbm.peak_bytes"
 HBM_BYTES_LIMIT = "hbm.bytes_limit"
 HBM_STAGER_FRACTION = "hbm.stager_fraction"
+# device robustness (ISSUE 14): the process-wide HBM governor ledger,
+# OOM recovery at the kernel/fusion/batcher boundaries, and the device
+# fault-injection schedule (executor/hbm.py, utils/chaos.py)
+HBM_GOVERNOR_BYTES = "hbm.governor_bytes"
+HBM_GOVERNOR_EVICTIONS = "hbm.governor_evictions"
+DEVICE_OOM = "device.oom"
+DEVICE_OOM_RECOVERED = "device.oom_recovered"
+DEVICE_OOM_CPU_DEGRADES = "device.oom_cpu_degrades"
+DEVICE_FAULTS_INJECTED = "device.faults_injected"
 PROFILER_COMPILES = "profiler.compiles"
 PROFILER_RECOMPILE_STORMS = "profiler.recompile_storms"
 PROFILER_SAMPLES = "profiler.samples"
@@ -462,6 +472,12 @@ METRICS: dict[str, tuple[str, str]] = {
         "queries that skipped fusion and took the per-call path "
         "(label: reason)",
     ),
+    FUSION_ADMISSION_SPLITS: (
+        "counter",
+        "fused launches split into smaller programs (or partially "
+        "routed to the classic path) because the estimated transient "
+        "peak exceeded governor HBM headroom",
+    ),
     PLANCACHE_DEVICE_HITS: (
         "counter",
         "__cached subtree stacks served from the device-resident plan "
@@ -539,6 +555,37 @@ METRICS: dict[str, tuple[str, str]] = {
         "gauge",
         "fraction of device memory held by the HBM staging cache "
         "(stager bytes / device limit)",
+    ),
+    HBM_GOVERNOR_BYTES: (
+        "gauge",
+        "bytes reserved in the process-wide HBM governor ledger "
+        "(label: tenant = stager | device_cache | batcher | transient)",
+    ),
+    HBM_GOVERNOR_EVICTIONS: (
+        "counter",
+        "entries evicted by the governor's pressure tiers to restore "
+        "HBM headroom (label: tier = device_cache | stager)",
+    ),
+    DEVICE_OOM: (
+        "counter",
+        "device allocation failures (RESOURCE_EXHAUSTED) caught at a "
+        "kernel/fusion/batcher boundary (label: kind; label: cls = "
+        "alloc | wedge)",
+    ),
+    DEVICE_OOM_RECOVERED: (
+        "counter",
+        "device OOMs recovered in place: governor eviction freed "
+        "headroom and the single retry succeeded",
+    ),
+    DEVICE_OOM_CPU_DEGRADES: (
+        "counter",
+        "device OOMs that degraded the call to the CPU roaring leg "
+        "after the evict-and-retry failed",
+    ),
+    DEVICE_FAULTS_INJECTED: (
+        "counter",
+        "device faults injected by the device-faults schedule "
+        "(label: fault = oom | stall | poison_jit)",
     ),
     PROFILER_COMPILES: (
         "counter",

@@ -297,6 +297,78 @@ class TestDeadlines:
             gate.set()
             ex.close()
 
+    def test_failed_wave_never_reexecutes_lapsed_deadline_item(self, holder):
+        """Blast-radius fix (ISSUE 14): when a combined wave attempt
+        fails, an item whose deadline lapsed DURING the failed attempt
+        gets DeadlineExceeded (-> 504) instead of burning a full solo
+        re-execution on a future its waiter already abandoned.
+        Wave-mates still re-run solo and answer correctly."""
+        seed_mixed(holder)
+        oracle = Executor(holder, device_policy="never", dispatch_enabled=False)
+        want3 = oracle.execute("i", "Count(Row(f=3))")
+        want4 = oracle.execute("i", "Count(Row(f=4))")
+        ex, gate, first = _gated_executor(holder)
+        inner = ex._execute
+        state = {"faulted": False, "solo_calls": []}
+
+        def faulty(index, query, shards=None, opt=None):
+            n = len(query.calls)
+            if n == 4:  # the combined 3-item group (2 + 1 + 1 calls)
+                state["faulted"] = True
+                time.sleep(2.0)  # the doomed item's deadline lapses here
+                raise RuntimeError("injected wave fault")
+            if state["faulted"]:
+                state["solo_calls"].append(n)
+            return inner(index, query, shards, opt)
+
+        ex._execute = faulty
+        try:
+            blocker = threading.Thread(
+                target=lambda: ex.execute("i", "Count(Row(f=0))")
+            )
+            blocker.start()
+            assert first.wait(10)
+            outcome = {}
+
+            def doomed():
+                with dl_mod.activate(Deadline.after(1.2)):
+                    try:
+                        ex.execute("i", "Count(Row(f=1))Count(Row(f=2))")
+                    except DeadlineExceeded as e:
+                        outcome["err"] = e
+
+            def healthy(name, q):
+                outcome[name] = ex.execute("i", q)
+
+            ts = [
+                threading.Thread(target=doomed),
+                threading.Thread(
+                    target=healthy, args=("h3", "Count(Row(f=3))")
+                ),
+                threading.Thread(
+                    target=healthy, args=("h4", "Count(Row(f=4))")
+                ),
+            ]
+            for t in ts:
+                t.start()
+            _wait_queued(ex.dispatch_engine, 3)
+            gate.set()
+            for t in ts:
+                t.join()
+            blocker.join()
+            assert state["faulted"], "combined wave attempt never ran"
+            assert isinstance(outcome.get("err"), DeadlineExceeded)
+            assert outcome["h3"] == want3 and outcome["h4"] == want4
+            # the lapsed 2-call item was NEVER re-executed solo — only
+            # its two healthy wave-mates were
+            assert sorted(state["solo_calls"]) == [1, 1]
+            st = ex.dispatch_engine.stats()
+            assert st["fallbacks"] >= 1 and st["deadline_expired"] >= 1
+        finally:
+            gate.set()
+            ex.close()
+            oracle.close()
+
 
 class TestBypass:
     """The PR 5/6 determinism contract: gang-dispatched execution keeps

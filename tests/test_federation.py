@@ -59,6 +59,7 @@ class TestFaultSpec:
 
     def test_parse_unknown_knob_rejected(self):
         with pytest.raises(ValueError):
+            # check: disable=fault-spec (deliberately invalid knob — the ValueError is the assertion)
             FaultSpec.parse("explode_every=2")
 
     def test_maybe_faulty(self):

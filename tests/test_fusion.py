@@ -22,7 +22,7 @@ from pilosa_tpu.core.field import FIELD_TYPE_INT
 from pilosa_tpu.executor import ExecOptions, Executor
 from pilosa_tpu.plan.cache import DevicePlanCache, PlanCache
 from pilosa_tpu.pql import parse
-from pilosa_tpu.utils import metrics
+from pilosa_tpu.utils import chaos, metrics
 
 
 @pytest.fixture
@@ -489,6 +489,89 @@ class TestBypassMatrix:
             assert ex.execute("i", q) == oracle.execute("i", q)
             assert ex.fuser.bypasses.get("error", 0) >= 1
         finally:
+            ex.close()
+            oracle.close()
+
+
+# -- injected device faults (ISSUE 14) --------------------------------------
+
+
+class TestDeviceFaultDegrade:
+    """The chaos hooks against the REAL fused path: a poisoned jit
+    lowering and an injected launch OOM both land on the classic
+    per-call path (or recover in place) bit-identical to the oracle —
+    never a wrong answer, never an unhandled 500."""
+
+    def test_poisoned_lowering_degrades_to_classic_path(self, holder):
+        seed_mixed(holder)
+        oracle = oracle_of(holder)
+        want = oracle.execute("i", GAUNTLET)
+        ex = Executor(holder, device_policy="always", dispatch_enabled=False)
+        try:
+            chaos.install_device_faults("poison_every=1")
+            assert ex.execute("i", GAUNTLET) == want
+            assert ex.fuser.stats()["fused_launches"] == 0
+            assert ex.fuser.bypasses.get("error", 0) >= 1
+            assert chaos.FAULTS.injected >= 1
+            # clearing the schedule restores the fused path untouched
+            chaos.install_device_faults("")
+            assert ex.execute("i", GAUNTLET) == want
+            assert ex.fuser.stats()["fused_launches"] >= 1
+        finally:
+            chaos.install_device_faults("")
+            ex.close()
+            oracle.close()
+
+    def test_injected_launch_oom_recovers_via_evict_and_retry(self, holder):
+        """oom_every=N>1: the injected RESOURCE_EXHAUSTED fires inside
+        the attempted launch, the recovery sweep + single retry
+        re-consults the counter and passes — every OOM recovers in
+        place, nothing degrades, results stay bit-identical."""
+        seed_mixed(holder)
+        oracle = oracle_of(holder)
+        want = oracle.execute("i", GAUNTLET)
+        ex = Executor(holder, device_policy="always", dispatch_enabled=False)
+        try:
+            base = metrics.snapshot().get("device.oom_recovered;path:retry", 0)
+            chaos.install_device_faults("oom_every=2")
+            for rep in range(4):
+                assert ex.execute("i", GAUNTLET) == want, rep
+            assert chaos.FAULTS.injected >= 1
+            st = ex._oom.stats()
+            assert st["ooms"] >= 1 and st["recovered"] == st["ooms"]
+            assert st["degraded"] == 0  # no CPU degrade, no health trip
+            assert (
+                metrics.snapshot().get("device.oom_recovered;path:retry", 0)
+                > base
+            )
+        finally:
+            chaos.install_device_faults("")
+            ex.close()
+            oracle.close()
+
+    def test_unrecoverable_launch_oom_degrades_to_cpu_leg(self, holder):
+        """oom_every=1: the retry OOMs too, so the call degrades to the
+        CPU roaring leg (DeviceOom rides the DeviceDown fallback) and
+        the post-OOM cooldown forces later calls CPU-side — answers
+        still bit-identical."""
+        seed_mixed(holder)
+        oracle = oracle_of(holder)
+        want = oracle.execute("i", GAUNTLET)
+        ex = Executor(holder, device_policy="always", dispatch_enabled=False)
+        try:
+            base = metrics.snapshot().get("device.oom_cpu_degrades", 0)
+            chaos.install_device_faults("oom_every=1")
+            assert ex.execute("i", GAUNTLET) == want
+            st = ex._oom.stats()
+            assert st["degraded"] >= 1
+            assert metrics.snapshot().get("device.oom_cpu_degrades", 0) > base
+            assert ex._cpu_forced()  # the cooldown holds the CPU leg
+            # and the NEXT query never touches the device at all
+            n0 = chaos.FAULTS._kernels
+            assert ex.execute("i", GAUNTLET) == want
+            assert chaos.FAULTS._kernels == n0
+        finally:
+            chaos.install_device_faults("")
             ex.close()
             oracle.close()
 

@@ -1,0 +1,431 @@
+"""HBM-pressure governance + OOM recovery + device fault injection
+(ISSUE 14, executor/hbm.py + utils/chaos.py): the process-wide byte
+ledger (tenant shares, tiered relief, fused-launch admission), the
+double-budget overcommit regression (two caches can no longer jointly
+exceed the pinned global budget), the evict → retry once → degrade
+policy with health tripped only on repeat failure, device error
+classification, and the deterministic DeviceFaultSpec / seeded
+ChaosSchedule the soak harness replays from."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SHARD_WIDTH
+from pilosa_tpu.core import FieldOptions, Holder
+from pilosa_tpu.core.field import FIELD_TYPE_INT
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor.devicehealth import DeviceDown
+from pilosa_tpu.executor.hbm import (
+    DeviceOom,
+    HbmGovernor,
+    OomRecovery,
+    classify_device_error,
+)
+from pilosa_tpu.plan.cache import DevicePlanCache, PlanCache
+from pilosa_tpu.utils import chaos, metrics
+from pilosa_tpu.utils.chaos import (
+    ChaosSchedule,
+    DeviceFaultSpec,
+    InjectedDeviceOom,
+    InjectedPoisonError,
+    install_device_faults,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """No test leaks an installed device fault schedule."""
+    yield
+    install_device_faults("")
+
+
+# -- error classification ----------------------------------------------------
+
+
+class TestClassify:
+    def test_alloc_markers(self):
+        assert classify_device_error(RuntimeError("RESOURCE_EXHAUSTED: x")) == "alloc"
+        assert classify_device_error(RuntimeError("Out of memory allocating")) == "alloc"
+        assert classify_device_error(InjectedDeviceOom("RESOURCE_EXHAUSTED: i")) == "alloc"
+
+    def test_wedge_by_type_name_and_marker(self):
+        XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+        assert classify_device_error(XlaRuntimeError("boom")) == "wedge"
+        assert classify_device_error(RuntimeError("INTERNAL: stream")) == "wedge"
+        assert classify_device_error(RuntimeError("DATA_LOSS on fetch")) == "wedge"
+
+    def test_non_device_errors_stay_loud(self):
+        assert classify_device_error(ValueError("bad shape")) is None
+        assert classify_device_error(KeyError("f")) is None
+
+
+# -- the byte ledger ---------------------------------------------------------
+
+
+class TestGovernor:
+    def test_budget_is_sum_of_shares_unless_pinned(self):
+        gov = HbmGovernor()
+        gov.register("a", share_bytes=100)
+        gov.register("b", share_bytes=50)
+        assert gov.budget() == 150
+        pinned = HbmGovernor(budget_bytes=80)
+        pinned.register("a", share_bytes=100)
+        pinned.register("b", share_bytes=50)
+        assert pinned.budget() == 80  # the double-budget overcommit fix
+
+    def test_reserve_release_and_headroom(self):
+        gov = HbmGovernor(budget_bytes=100)
+        gov.register("a")
+        assert gov.reserve("a", 60) is True
+        assert gov.used("a") == 60 and gov.headroom() == 40
+        gov.release("a", 25)
+        assert gov.used() == 35
+        gov.release("a", 10**9)  # floor at zero, never negative
+        assert gov.used("a") == 0
+
+    def test_reserve_over_budget_relieves_other_tenants_only(self):
+        gov = HbmGovernor(budget_bytes=100)
+        evicted = []
+
+        def evict(need):
+            evicted.append(need)
+            gov.release("cache", min(need, gov.used("cache")))
+            return need
+
+        gov.register("cache", share_bytes=100, evict_fn=evict, tier=0)
+        me_evicted = []
+        gov.register(
+            "me", share_bytes=100, evict_fn=lambda n: me_evicted.append(n) or 0,
+            tier=1,
+        )
+        gov.reserve("cache", 90)
+        # my reserve pushes the ledger over: the OTHER tenant relieves,
+        # my own LRU loop is my job (exclude semantics)
+        assert gov.reserve("me", 50) is True
+        assert evicted and not me_evicted
+        assert gov.over_budget() == 0
+
+    def test_tier_order_device_cache_before_stager(self):
+        gov = HbmGovernor(budget_bytes=100)
+        order = []
+
+        def tier0(need):
+            order.append("device_cache")
+            gov.release("device_cache", 40)
+            return 40
+
+        def tier1(need):
+            order.append("stager")
+            gov.release("stager", need)
+            return need
+
+        gov.register("device_cache", share_bytes=50, evict_fn=tier0, tier=0)
+        gov.register("stager", share_bytes=50, evict_fn=tier1, tier=1)
+        gov.reserve("device_cache", 40)
+        gov.reserve("stager", 60)
+        gov.register("transient")
+        gov.reserve("transient", 60)  # 160 total: needs both tiers
+        assert order[0] == "device_cache"
+        assert gov.over_budget() == 0
+
+    def test_admit_relieves_then_answers(self):
+        gov = HbmGovernor(budget_bytes=100)
+        gov.register(
+            "cache", share_bytes=100, tier=0,
+            evict_fn=lambda need: (gov.release("cache", 70), 70)[1],
+        )
+        gov.reserve("cache", 70)
+        assert gov.admit(20) is True  # fits in headroom, no eviction
+        assert gov.used("cache") == 70
+        assert gov.admit(90) is True  # relieved tier 0 first
+        assert gov.used("cache") == 0
+        assert gov.admit(10**12) is False  # can never fit
+
+    def test_reset_is_the_epoch_fence(self):
+        gov = HbmGovernor(budget_bytes=100)
+        gov.register("a")
+        gov.register("b")
+        gov.reserve("a", 30)
+        gov.reserve("b", 40)
+        gov.reset("a")
+        assert gov.used("a") == 0 and gov.used("b") == 40
+        gov.reset()
+        assert gov.used() == 0
+
+    def test_stats_shape(self):
+        gov = HbmGovernor(budget_bytes=64)
+        gov.register("a", share_bytes=64, tier=3)
+        gov.reserve("a", 8)
+        st = gov.stats()
+        assert st["budget_bytes"] == 64 and st["used_bytes"] == 8
+        assert st["tenants"]["a"] == {"used": 8, "share": 64, "tier": 3}
+
+
+class TestDoubleBudgetOvercommit:
+    """The PR 12 regression: stager and device plan cache each honored
+    their OWN byte budget, so together they could overcommit the chip.
+    With the governor pinned below the sum of shares, the joint ledger
+    must stay under the GLOBAL budget — each cache evicting for the
+    other's pressure."""
+
+    def test_device_cache_respects_global_budget_below_its_share(self):
+        gov = HbmGovernor(budget_bytes=1000)
+        cache = DevicePlanCache(max_bytes=2000)  # share alone overcommits
+        cache.set_governor(gov)
+        # a second tenant (the stager's stand-in) holds most of the chip
+        gov.register("stager", share_bytes=1000)
+        gov.reserve("stager", 700)
+        for i in range(10):
+            cache.put(("k", i), (1,), object(), nbytes=100)
+            assert gov.used() <= gov.budget(), (i, gov.stats())
+        # the cache held itself far below its own 2000-byte share
+        assert cache.bytes <= 300
+        assert gov.used("device_cache") == cache.bytes
+
+    def test_both_caches_jointly_bounded_under_pressure(self):
+        gov = HbmGovernor(budget_bytes=500)
+        cache = DevicePlanCache(max_bytes=400)
+        cache.set_governor(gov)
+
+        stager_held = {"n": 0}
+
+        def stager_evict(need):
+            freed = min(need, stager_held["n"])
+            stager_held["n"] -= freed
+            gov.release("stager", freed)
+            return freed
+
+        gov.register("stager", share_bytes=400, evict_fn=stager_evict, tier=1)
+        for i in range(20):
+            if i % 2:
+                stager_held["n"] += 60
+                gov.reserve("stager", 60)
+                # the stager's own LRU loop: reserve excludes the
+                # requester, so its share is its job (mirrors stager.put)
+                while gov.over_budget() > 0 and stager_held["n"]:
+                    stager_evict(gov.over_budget())
+            else:
+                cache.put(("k", i), (1,), object(), nbytes=60)
+            assert gov.used() <= gov.budget(), (i, gov.stats())
+        assert gov.used() == gov.used("device_cache") + gov.used("stager")
+
+    def test_executor_wires_one_ledger_for_all_tenants(self):
+        """End to end: a pinned global budget smaller than the shares'
+        sum holds across real staged blocks + device plan cache."""
+        h = Holder()
+        h.open()
+        rng = np.random.default_rng(5)
+        idx = h.create_index("i")
+        f = idx.create_field("f")
+        v = idx.create_field(
+            "v", FieldOptions(type=FIELD_TYPE_INT, min=-50, max=5000)
+        )
+        f.import_bits(
+            rng.integers(0, 10, size=2000).tolist(),
+            rng.integers(0, 2 * SHARD_WIDTH, size=2000).tolist(),
+        )
+        vcols = rng.choice(2 * SHARD_WIDTH, size=400, replace=False)
+        v.import_values(vcols.tolist(), rng.integers(-50, 5000, size=400).tolist())
+        gov = HbmGovernor(budget_bytes=32 << 20)
+        ex = Executor(
+            h, device_policy="always", dispatch_enabled=False,
+            plan_cache=PlanCache(), governor=gov,
+        )
+        try:
+            assert ex.governor is gov
+            st = gov.stats()["tenants"]
+            assert "stager" in st and "device_cache" in st
+            q = (
+                "Count(Intersect(Row(f=1), Row(f=2)))"
+                "TopN(f, Intersect(Row(f=1), Row(f=2)), n=5)"
+                'Sum(Row(f=3), field="v")'
+            )
+            for _ in range(3):
+                ex.execute("i", q)
+                assert gov.used() <= gov.budget(), gov.stats()
+            # the ledger reflects real resident bytes
+            assert gov.used("stager") == ex.stager._bytes
+        finally:
+            ex.close()
+
+
+# -- OOM recovery policy -----------------------------------------------------
+
+
+class _FakeHealth:
+    def __init__(self):
+        self.reasons = []
+
+    def trip(self, reason):
+        self.reasons.append(reason)
+
+
+class TestOomRecovery:
+    def test_alloc_failure_evicts_and_retries_once(self):
+        gov = HbmGovernor(budget_bytes=100)
+        swept = []
+        gov.register(
+            "cache", share_bytes=100, tier=0,
+            evict_fn=lambda need: swept.append(need) or 0,
+        )
+        rec = OomRecovery(governor=gov)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("RESOURCE_EXHAUSTED: alloc failed")
+            return 42
+
+        assert rec.run(flaky, kind="kernel") == 42
+        assert calls["n"] == 2 and swept  # the sweep ran before the retry
+        assert rec.stats()["recovered"] == 1
+        assert rec.stats()["degraded"] == 0
+
+    def test_persistent_alloc_failure_degrades_to_cpu(self):
+        degraded = []
+        health = _FakeHealth()
+        rec = OomRecovery(
+            health=health, on_degrade=lambda: degraded.append(1), trip_after=2
+        )
+
+        def dead():
+            raise RuntimeError("RESOURCE_EXHAUSTED: still full")
+
+        with pytest.raises(DeviceOom) as ei:
+            rec.run(dead, kind="fused_query")
+        assert isinstance(ei.value, DeviceDown)  # rides the CPU fallback
+        assert degraded == [1]
+        assert health.reasons == []  # ONE failure never gates the device
+        assert rec.stats()["degraded"] == 1
+
+    def test_wedge_skips_retry_and_degrades(self):
+        XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+        calls = {"n": 0}
+
+        def wedged():
+            calls["n"] += 1
+            raise XlaRuntimeError("INTERNAL: stream executor died")
+
+        rec = OomRecovery()
+        with pytest.raises(DeviceOom):
+            rec.run(wedged)
+        assert calls["n"] == 1  # retry is pointless for a wedge
+
+    def test_repeat_degrades_trip_health(self):
+        health = _FakeHealth()
+        rec = OomRecovery(health=health, trip_after=2, window_s=30.0)
+
+        def dead():
+            raise RuntimeError("RESOURCE_EXHAUSTED")
+
+        for _ in range(2):
+            with pytest.raises(DeviceOom):
+                rec.run(dead)
+        assert health.reasons  # second unrecovered failure in the window
+
+    def test_non_device_errors_propagate_untouched(self):
+        rec = OomRecovery()
+        with pytest.raises(ValueError):
+            rec.run(lambda: (_ for _ in ()).throw(ValueError("shape bug")))
+        assert rec.stats()["ooms"] == 0
+
+    def test_recovery_is_thread_safe_bookkeeping(self):
+        rec = OomRecovery()
+
+        def one():
+            try:
+                rec.run(lambda: (_ for _ in ()).throw(
+                    RuntimeError("RESOURCE_EXHAUSTED")
+                ))
+            except DeviceOom:
+                pass
+
+        ts = [threading.Thread(target=one) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        st = rec.stats()
+        assert st["ooms"] == 8 and st["degraded"] == 8
+
+
+# -- deterministic device fault injection ------------------------------------
+
+
+class TestDeviceFaultSpec:
+    def test_parse_roundtrip_and_unknown_knob(self):
+        s = DeviceFaultSpec.parse(
+            "oom_every=3,stall_every=5,stall_s=0.01,poison_every=2,after=4"
+        )
+        assert (s.oom_every, s.stall_every, s.poison_every, s.after) == (3, 5, 2, 4)
+        assert s.stall_s == 0.01 and bool(s)
+        assert not DeviceFaultSpec.parse("")
+        with pytest.raises(ValueError):
+            # check: disable=fault-spec (deliberately invalid knob — the ValueError is the assertion)
+            DeviceFaultSpec.parse("explode_every=1")
+
+    def test_oom_every_nth_kernel_is_deterministic(self):
+        s = DeviceFaultSpec.parse("oom_every=2")
+        s.on_kernel("k")  # 1: clean
+        with pytest.raises(InjectedDeviceOom) as ei:
+            s.on_kernel("k")  # 2: injected
+        assert classify_device_error(ei.value) == "alloc"
+        s.on_kernel("k")  # 3: clean — a retry right after the OOM passes
+        with pytest.raises(InjectedDeviceOom):
+            s.on_kernel("k")  # 4
+        assert s.injected == 2
+
+    def test_after_arms_late(self):
+        s = DeviceFaultSpec.parse("oom_every=1,after=2")
+        s.on_kernel("k")
+        s.on_kernel("k")  # warmup window
+        with pytest.raises(InjectedDeviceOom):
+            s.on_kernel("k")
+
+    def test_stall_injects_without_failing(self):
+        s = DeviceFaultSpec.parse("stall_every=1,stall_s=0.0")
+        s.on_kernel("k")
+        assert s.injected == 1  # latency, never an error
+
+    def test_poisoned_lowering(self):
+        s = DeviceFaultSpec.parse("poison_every=2")
+        s.on_lowering()
+        with pytest.raises(InjectedPoisonError):
+            s.on_lowering()
+
+    def test_install_and_clear_process_schedule(self):
+        install_device_faults("oom_every=7")
+        assert chaos.FAULTS is not None and chaos.FAULTS.oom_every == 7
+        install_device_faults("")
+        assert chaos.FAULTS is None
+
+    def test_injection_counts_metric(self):
+        base = metrics.snapshot().get("device.faults_injected;fault:oom", 0)
+        s = DeviceFaultSpec.parse("oom_every=1")
+        with pytest.raises(InjectedDeviceOom):
+            s.on_kernel("k")
+        assert metrics.snapshot().get("device.faults_injected;fault:oom", 0) > base
+
+
+class TestChaosSchedule:
+    def test_seeded_schedule_is_reproducible(self):
+        a = list(ChaosSchedule(seed=14, windows=6, duration_s=1.0))
+        b = list(ChaosSchedule(seed=14, windows=6, duration_s=1.0))
+        assert a == b
+        assert a != list(ChaosSchedule(seed=15, windows=6, duration_s=1.0))
+
+    def test_windows_cover_all_families_with_parsable_specs(self):
+        from pilosa_tpu.core.fragment import StorageFaultSpec
+
+        ws = list(ChaosSchedule(seed=3, windows=6))
+        assert [w["name"].split("-", 1)[1] for w in ws] == [
+            "storage", "device", "mixed", "storage", "device", "mixed",
+        ]
+        for w in ws:
+            StorageFaultSpec.parse(w["storage"])  # empty parses clean too
+            DeviceFaultSpec.parse(w["device"])
+            if "mixed" in w["name"]:
+                assert w["storage"] and w["device"]

@@ -164,6 +164,7 @@ def test_fault_spec_parse_and_unknown_knob():
     assert s.fsync_fail_every == 3 and s.torn_at == 100 and bool(s)
     assert not StorageFaultSpec.parse("")
     with pytest.raises(ValueError):
+        # check: disable=fault-spec (deliberately invalid knob — the ValueError is the assertion)
         StorageFaultSpec.parse("rm_rf_every=1")
 
 
@@ -366,8 +367,13 @@ def test_queue_commit_failure_nacks_submitter():
     api = _StubAPI(fail=True)
     q = IngestQueue(api, wave_interval=0.0)
     try:
-        with pytest.raises(OSError):
+        # a storage-layer wave abort surfaces as a RETRYABLE 503, not
+        # the raw OSError (the wave never applied; repair re-opened the
+        # log) — the chaos contract: faults cost retries, never a 500
+        with pytest.raises(Overloaded) as ei:
             q.submit("i", "f", [1], [1])
+        assert ei.value.status == 503
+        assert isinstance(ei.value.__cause__, OSError)
         assert q.stats()["nacked"] == 1 and q.stats()["acked"] == 0
     finally:
         q.close()
