@@ -8,6 +8,10 @@ read/write load, plus a federated sub-phase with gang-channel faults:
   * device windows: injected RESOURCE_EXHAUSTED on every Nth kernel
     launch (``oom_every=N``) — the HBM governor's evict → retry
     recovery serves every read, DeviceHealth never trips,
+  * bit-rot windows (ISSUE 15): ``bitrot=N`` flips a snapshot-base
+    byte on disk under a dedicated ``rot`` index; a scoped scrub sweep
+    must DETECT it (digest mismatch → quarantine + journal) while the
+    main index's load is untouched,
   * a federated sub-phase: a 2-process gang booted with
     ``distributed-faults`` (frame delay + a deterministic drop) — the
     gang degrades to replicated-solo behind a bounded 503 fence and
@@ -161,6 +165,36 @@ def _spawn(mode: str, tmp: str, tag: str, **extra_env):
 
 
 # -- load generation ----------------------------------------------------------
+
+
+_ROT_FRAG_PATH = "/internal/fragment/data?index=rot&field=f&view=standard&shard=0"
+
+
+def _setup_rot_index(port: int) -> bytes:
+    """Dedicated sacrificial index for bit-rot windows (ISSUE 15): a
+    few bits, snapshotted so the file carries a digest trailer for the
+    scrub sweep to verify. Returns the fragment's checksummed archive —
+    the known-good copy each bit-rot window restores from (the repair
+    role a replica would play in a federated deployment)."""
+    for path in ("/index/rot", "/index/rot/field/f"):
+        st, body = _http(port, "POST", path, b"{}")
+        assert st in (200, 409), (st, body[:200])
+    body = json.dumps(
+        {
+            "rowIDs": [1] * 64 + [2] * 64,
+            "columnIDs": list(range(64)) + list(range(100, 164)),
+            "sets": [True] * 128,
+        }
+    ).encode()
+    st, body = _http(port, "POST", "/index/rot/field/f/ingest", body)
+    assert st == 200, (st, body[:200])
+    st, archive = _http(port, "GET", _ROT_FRAG_PATH)
+    assert st == 200
+    # round-trip through the verify-before-apply restore: unmarshal
+    # forces a snapshot, so the on-disk file gains its digest trailer
+    st, body = _http(port, "POST", _ROT_FRAG_PATH, archive)
+    assert st == 200, (st, body[:200])
+    return archive
 
 
 def _static_cells() -> dict:
@@ -343,7 +377,7 @@ def _journal_seq(port: int) -> int:
 def _window_phase(port: int, quick: bool, result: dict) -> list:
     from pilosa_tpu.utils.chaos import ChaosSchedule
 
-    n_windows = 3 if quick else 6
+    n_windows = 4 if quick else 8
     duration = 2.0 if quick else 4.0
     n_writers = 2 if quick else 4
     n_readers = 3 if quick else 5
@@ -354,6 +388,7 @@ def _window_phase(port: int, quick: bool, result: dict) -> list:
         _ingest_acked(port, [(r, c, True) for c in sorted(cells)])
     for r, cells in static.items():
         assert _read_row_acked(port, r) == cells, f"static seed verify row {r}"
+    rot_archive = _setup_rot_index(port)
 
     schedule = list(ChaosSchedule(seed=SEED, windows=n_windows, duration_s=duration))
     result["seed"] = SEED
@@ -361,8 +396,18 @@ def _window_phase(port: int, quick: bool, result: dict) -> list:
     all_writers: list[Writer] = []
     wid = 0
     for w in schedule:
+        bitrot = "bitrot" in w["name"]
         print(f"== window {w['name']}: storage={w['storage'] or '-'} "
               f"device={w['device'] or '-'} ({w['duration_s']}s)")
+        if bitrot:
+            # re-arm: a previous bit-rot window left the rot fragment
+            # quarantined (no replica to repair from on one node);
+            # restoring the known-good archive clears it so THIS
+            # window's verification detects a FRESH flip
+            st, body = _http(
+                port, "POST", _ROT_FRAG_PATH, rot_archive, timeout=60
+            )
+            assert st == 200, (st, body[:200])
         seq0 = _journal_seq(port)
         st, body = _http(
             port, "POST", "/debug/chaos",
@@ -372,6 +417,45 @@ def _window_phase(port: int, quick: bool, result: dict) -> list:
         # sample the install transition NOW — a busy window floods the
         # bounded journal ring and would evict it before window end
         installed_ev = len(_events(port, "chaos.window", seq0))
+
+        scrub_res = None
+        if bitrot:
+            # scoped scrub sweeps on the rot index: the sweep's digest
+            # verification is where the installed bitrot spec flips a
+            # base byte — detection, quarantine, and journal all happen
+            # against a LIVE server. bitrot=N fires every Nth
+            # verification (N ≤ 3), so up to 4 sweeps arm it. Then the
+            # storage fault is cleared BEFORE the mixed load: a main-
+            # index snapshot also re-verifies its digest, and rotting
+            # the load-bearing index would poison the soak's oracle.
+            for _ in range(4):
+                st, body = _http(
+                    port, "POST", "/debug/scrub",
+                    json.dumps({"index": "rot"}).encode(), timeout=60,
+                )
+                assert st == 200, (st, body[:200])
+                scrub_res = json.loads(body)
+                if scrub_res["corrupt"]:
+                    break
+            st, _ = _http(
+                port, "POST", "/debug/chaos",
+                json.dumps({"storage": "", "device": w["device"]}).encode(),
+            )
+            assert st == 200
+            # sample the rot events NOW, like the install transition
+            # above: the detection sweeps ran before the load, and a
+            # busy window floods the bounded journal ring, evicting
+            # them before the window-end count
+            rot_ev = {
+                k: len(_events(port, kind, seq0))
+                for k, kind in (
+                    ("ingest_fault", "ingest.fault"),
+                    ("scrub_corruption", "scrub.corruption"),
+                    ("scrub_quarantine", "scrub.quarantine"),
+                )
+            }
+        else:
+            rot_ev = {}
 
         writers = [Writer(wid + k, port) for k in range(n_writers)]
         wid += n_writers
@@ -393,7 +477,11 @@ def _window_phase(port: int, quick: bool, result: dict) -> list:
             "device_oom_recovered": len(
                 _events(port, "device.oom_recovered", seq0)
             ),
+            "scrub_corruption": len(_events(port, "scrub.corruption", seq0)),
+            "scrub_quarantine": len(_events(port, "scrub.quarantine", seq0)),
         }
+        for k, v in rot_ev.items():
+            fault_ev[k] = max(fault_ev[k], v)
         st, _ = _http(port, "POST", "/debug/chaos", b"{}")
         assert st == 200
         cleared_ev = len(_events(port, "chaos.window", seq1))
@@ -414,6 +502,7 @@ def _window_phase(port: int, quick: bool, result: dict) -> list:
             "storage": w["storage"],
             "device": w["device"],
             "journal": journal,
+            "scrub": scrub_res,
             "write_requests": sum(x.requests for x in writers),
             "write_retries": sum(x.retries for x in writers),
             "acked_batches": sum(len(x.acked_batches) for x in writers),
@@ -463,6 +552,13 @@ def _window_phase(port: int, quick: bool, result: dict) -> list:
             failures.append(f"{w['name']}: storage faults journaled no ingest.fault")
         if w["device"] and not j["device_oom"]:
             failures.append(f"{w['name']}: device faults journaled no device.oom")
+        if "bitrot" in w["name"]:
+            if not w["scrub"] or not w["scrub"]["corrupt"]:
+                failures.append(f"{w['name']}: scrub detected no bit rot")
+            if not j["scrub_corruption"] or not j["scrub_quarantine"]:
+                failures.append(
+                    f"{w['name']}: bit rot left no scrub journal events"
+                )
     if any(w["device"] for w in result["windows"]) and result["oom"]["recovered"] < 1:
         failures.append("no injected OOM recovered in place")
     if result["health_trips"] != 0:

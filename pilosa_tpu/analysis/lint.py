@@ -665,6 +665,9 @@ _FAULT_KNOBS: dict[str, dict[str, str]] = {
         "fsync_fail_every": "int",
         "torn_at": "int",
         "enospc_after": "int",
+        "corrupt_at": "int",
+        "bitrot": "int",
+        "snapshot_kill": "enum:pre|post",
     },
     "device": {
         "oom_every": "int",
@@ -710,12 +713,21 @@ def _fault_spec_errors(family: str, text: str) -> list[str]:
         if not sep:
             errors.append(f"{family} fault knob {key!r} missing '=value'")
             continue
+        kind = knobs[key]
+        if kind.startswith("enum:"):
+            allowed = kind[len("enum:"):].split("|")
+            if value.strip() not in allowed:
+                errors.append(
+                    f"{family} fault knob {key!r} must be one of "
+                    f"{' | '.join(allowed)}, got {value.strip()!r}"
+                )
+            continue
         try:
-            (int if knobs[key] == "int" else float)(value.strip())
+            (int if kind == "int" else float)(value.strip())
         except ValueError:
             errors.append(
                 f"{family} fault knob {key!r} needs "
-                f"{'an integer' if knobs[key] == 'int' else 'a number'}, "
+                f"{'an integer' if kind == 'int' else 'a number'}, "
                 f"got {value.strip()!r}"
             )
     return errors

@@ -16,6 +16,7 @@ import os
 import signal
 import sys
 import time
+import urllib.error
 import urllib.parse
 import urllib.request
 from datetime import datetime
@@ -114,6 +115,24 @@ def main(argv=None) -> int:
     p.set_defaults(fn=cmd_export)
 
     p = sub.add_parser(
+        "backup",
+        help="download a full-holder backup archive (schema + every "
+        "fragment, with a per-entry checksum manifest)",
+    )
+    p.add_argument("--host", default="http://localhost:10101")
+    p.add_argument("-o", "--output", required=True, help="archive file to write")
+    p.set_defaults(fn=cmd_backup)
+
+    p = sub.add_parser(
+        "restore",
+        help="restore a holder backup archive; the whole archive is "
+        "checksum-verified before any byte is applied",
+    )
+    p.add_argument("--host", default="http://localhost:10101")
+    p.add_argument("archive", help="archive file written by backup")
+    p.set_defaults(fn=cmd_restore)
+
+    p = sub.add_parser(
         "check",
         help="run the invariant checker over source trees, or verify "
         "integrity of fragment files",
@@ -129,6 +148,12 @@ def main(argv=None) -> int:
         action="store_true",
         help="also fail on suppression hygiene (unknown rule ids, "
         "reasonless disables)",
+    )
+    p.add_argument(
+        "--repair",
+        action="store_true",
+        help="fragment files only: truncate a torn op-log tail in place "
+        "(offline repair; the snapshot base and every intact op survive)",
     )
     p.set_defaults(fn=cmd_check)
 
@@ -432,6 +457,44 @@ def cmd_export(args) -> int:
     return 0
 
 
+def cmd_backup(args) -> int:
+    """Stream GET /backup to a file (reference ctl/backup.go)."""
+    host = args.host if args.host.startswith("http") else f"http://{args.host}"
+    host = host.rstrip("/")
+    r = urllib.request.Request(host + "/backup", method="GET")
+    with urllib.request.urlopen(r, timeout=600) as resp:
+        data = resp.read()
+    with open(args.output, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    print(f"backup: wrote {len(data)} bytes to {args.output}")
+    return 0
+
+
+def cmd_restore(args) -> int:
+    """POST an archive to /restore (reference ctl/restore.go). The
+    server verifies the manifest before applying; a refusal (400)
+    exits non-zero with the server's reason."""
+    host = args.host if args.host.startswith("http") else f"http://{args.host}"
+    host = host.rstrip("/")
+    with open(args.archive, "rb") as f:
+        data = f.read()
+    r = urllib.request.Request(host + "/restore", data=data, method="POST")
+    try:
+        with urllib.request.urlopen(r, timeout=600) as resp:
+            body = json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        try:
+            reason = json.loads(e.read() or b"{}").get("error", str(e))
+        except Exception:
+            reason = str(e)
+        print(f"restore: REFUSED: {reason}", file=sys.stderr)
+        return 1
+    print(f"restore: applied ({body.get('fragments', 0)} fragments)")
+    return 0
+
+
 def _open_lazy(path):
     """Mmap-open a roaring file: check/inspect of a 1B-scale fragment
     (~15.6M containers) must stream, not materialize one Python object
@@ -458,7 +521,7 @@ def cmd_check(args) -> int:
     if code_paths is None or code_paths:
         rc = max(rc, _check_code(code_paths, strict=args.strict))
     if frag_paths:
-        rc = max(rc, _check_fragments(frag_paths))
+        rc = max(rc, _check_fragments(frag_paths, repair=args.repair))
     return rc
 
 
@@ -479,12 +542,18 @@ def _check_code(paths, strict: bool) -> int:
     return 0
 
 
-def _check_fragments(files) -> int:
+def _check_fragments(files, repair: bool = False) -> int:
     rc = 0
     for path in files:
         if path.endswith(".cache") or path.endswith(".snapshotting"):
             continue
         try:
+            # byte-level integrity first (digest trailer + op-log CRC
+            # walk): a rotted base or torn tail must exit non-zero
+            # BEFORE the container walk can trip over decoded garbage
+            err = _check_file_bytes(path, repair=repair)
+            if err is not None:
+                raise ValueError(err)
             b = _open_lazy(path)
             # container-level invariants (streaming: one ephemeral
             # decode at a time)
@@ -510,6 +579,47 @@ def _check_fragments(files) -> int:
             print(f"{path}: FAILED: {e}", file=sys.stderr)
             rc = 1
     return rc
+
+
+def _check_file_bytes(path: str, repair: bool = False) -> "str | None":
+    """Offline byte-level verification (reference ctl/check.go, extended
+    for the checksummed snapshot format): the blake2b digest trailer
+    over the base, then a CRC/framing walk of the op-log tail. Returns
+    an error string (→ exit 1), or None. With ``repair``, a torn tail
+    is truncated in place at the last valid record boundary — the
+    exact cut crash recovery would make at the next open, done offline
+    so the file verifies clean NOW."""
+    from pilosa_tpu.roaring import bitmap as bm
+
+    with open(path, "rb") as f:
+        data = f.read()
+    if len(data) < bm.HEADER_BASE_SIZE:
+        return None  # empty/new fragment: nothing to verify
+    try:
+        base_end = bm.snapshot_base_end(data)
+    except Exception as e:
+        return f"snapshot header unparseable: {e}"
+    if bm.has_digest_trailer(data, base_end):
+        if not bm.verify_digest_trailer(data, base_end):
+            return "snapshot digest mismatch (base bytes rotted)"
+    ops_offset = bm.ops_offset_of(data)
+    valid_end, n_ops = bm.scan_op_log(data, ops_offset)
+    if valid_end < len(data):
+        torn = len(data) - valid_end
+        if not repair:
+            return (
+                f"op log torn/corrupt at byte {valid_end} "
+                f"({torn} trailing bytes; --repair truncates them)"
+            )
+        with open(path, "r+b") as f:
+            f.truncate(valid_end)
+            f.flush()
+            os.fsync(f.fileno())
+        print(
+            f"{path}: repaired (truncated {torn} torn bytes; "
+            f"{n_ops} intact ops kept)"
+        )
+    return None
 
 
 def _check_occ_sidecar(path: str, b) -> "str | None":

@@ -77,16 +77,30 @@ class StorageFaultSpec:
     prefix reaches the file, then EIO (a partial sector landing before
     power loss), ``enospc_after=K`` fails every append after the first
     K with ENOSPC, writing nothing. No RNG — crash-recovery tests
-    reproduce exactly. Injected failures journal ``ingest.fault``."""
+    reproduce exactly. Injected failures journal ``ingest.fault``.
+
+    Integrity faults (PR 15): ``corrupt_at=K`` flips one byte at file
+    offset K of the next snapshot base as it is written (a latent write
+    corruption the digest trailer must catch), ``bitrot=N`` flips one
+    on-disk base byte right before every Nth digest verification (a
+    latent sector flip under the mmap the scrubber must catch), and
+    ``snapshot_kill=pre|post`` hard-kills the process (os._exit) inside
+    ``snapshot()`` immediately before/after the atomic os.replace — the
+    crash-atomicity property test's kill switch."""
 
     __slots__ = (
         "fsync_fail_every",
         "torn_at",
         "enospc_after",
+        "corrupt_at",
+        "bitrot",
+        "snapshot_kill",
         "_fsyncs",
         "_bytes",
         "_appends",
         "_torn_done",
+        "_corrupt_done",
+        "_verifies",
         "_mu",
     )
 
@@ -95,14 +109,22 @@ class StorageFaultSpec:
         fsync_fail_every: int = 0,
         torn_at: int = 0,
         enospc_after: int = 0,
+        corrupt_at: int = 0,
+        bitrot: int = 0,
+        snapshot_kill: str = "",
     ) -> None:
         self.fsync_fail_every = fsync_fail_every
         self.torn_at = torn_at
         self.enospc_after = enospc_after
+        self.corrupt_at = corrupt_at
+        self.bitrot = bitrot
+        self.snapshot_kill = snapshot_kill
         self._fsyncs = 0
         self._bytes = 0
         self._appends = 0
         self._torn_done = False
+        self._corrupt_done = False
+        self._verifies = 0
         self._mu = threading.Lock()
 
     @classmethod
@@ -114,14 +136,34 @@ class StorageFaultSpec:
                 continue
             key, _, value = part.partition("=")
             key = key.strip()
-            if key in ("fsync_fail_every", "torn_at", "enospc_after"):
+            if key in (
+                "fsync_fail_every",
+                "torn_at",
+                "enospc_after",
+                "corrupt_at",
+                "bitrot",
+            ):
                 setattr(spec, key, int(value))
+            elif key == "snapshot_kill":
+                value = value.strip()
+                if value not in ("pre", "post"):
+                    raise ValueError(
+                        f"snapshot_kill must be 'pre' or 'post', got {value!r}"
+                    )
+                spec.snapshot_kill = value
             else:
                 raise ValueError(f"unknown storage fault knob: {key!r}")
         return spec
 
     def __bool__(self) -> bool:
-        return bool(self.fsync_fail_every or self.torn_at or self.enospc_after)
+        return bool(
+            self.fsync_fail_every
+            or self.torn_at
+            or self.enospc_after
+            or self.corrupt_at
+            or self.bitrot
+            or self.snapshot_kill
+        )
 
     def _injected(self, fault: str) -> None:
         metrics.count(metrics.INGEST_FAULTS_INJECTED, fault=fault)
@@ -165,6 +207,37 @@ class StorageFaultSpec:
             raise OSError(5, "fsync failed (injected)")
         os.fsync(fd)
 
+    def corrupt_offset(self, size: int) -> Optional[int]:
+        """Byte offset to flip in the snapshot base being written (once
+        per schedule), or None. Only offsets inside the base corrupt —
+        the point is a flip the digest trailer must catch."""
+        with self._mu:
+            if not self.corrupt_at or self._corrupt_done:
+                return None
+            if not (0 <= self.corrupt_at < size):
+                return None
+            self._corrupt_done = True
+        self._injected("corrupt_write")
+        return self.corrupt_at
+
+    def bitrot_due(self) -> bool:
+        """True on every Nth digest verification — the caller flips one
+        on-disk base byte before verifying (latent sector rot)."""
+        with self._mu:
+            if not self.bitrot:
+                return False
+            self._verifies += 1
+            due = self._verifies % self.bitrot == 0
+        if due:
+            self._injected("bitrot")
+        return due
+
+    def kill_point(self, phase: str) -> None:
+        """Hard-kill (no atexit, no flush) when the schedule names this
+        snapshot phase — simulates power loss at the worst moments."""
+        if self.snapshot_kill == phase:
+            os._exit(137)
+
 
 # Process-wide injected fault schedule (None = clean). Installed by the
 # server from the `storage-faults` config knob; tests install directly.
@@ -178,6 +251,26 @@ def install_storage_faults(text: str = "") -> None:
     text = text or os.environ.get(STORAGE_FAULTS_ENV, "")
     spec = StorageFaultSpec.parse(text)
     FAULTS = spec if spec else None
+
+
+class FragmentQuarantinedError(Exception):
+    """Raised by reads/writes on a quarantined fragment: verification
+    found corruption, so serving from it could return poisoned bits.
+    Maps to a clean HTTP 503 + Retry-After (never a wrong answer);
+    clients back off while repair pulls a healthy replica copy."""
+
+    status = 503
+    retry_after = 2
+
+    def __init__(self, index: str, field: str, view: str, shard: int, reason: str):
+        super().__init__(
+            f"fragment {index}/{field}/{view}/{shard} quarantined: {reason}"
+        )
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+        self.reason = reason
 
 
 def pos(row_id: int, column_id: int) -> int:
@@ -268,6 +361,12 @@ class Fragment:
         # internally; dict stores would otherwise rebuild O(N log N)
         # per query in the auto-policy estimate)
         self._occ: Optional[tuple] = None
+        # integrity quarantine: set when verification found corruption.
+        # Reads/writes raise FragmentQuarantinedError (503) until repair
+        # replaces the data; the generation bump at quarantine time
+        # fences plan/device caches off the poisoned content.
+        self.quarantined = False
+        self.quarantine_reason = ""
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -312,8 +411,20 @@ class Fragment:
         failing the open."""
         if os.path.getsize(self.path) == 0:
             return
-        self._recover_storage_tail()
+        try:
+            self._recover_storage_tail()
+        except Exception:
+            # a rotted header/meta region can make even the recovery
+            # scan unparseable — that is corruption, not a crash
+            self._set_quarantined("snapshot header unparseable at open")
+            return
         if os.path.getsize(self.path) == 0:
+            return
+        if not self._verify_snapshot_digest():
+            # Never parse (let alone serve) a base that fails its
+            # digest: leave storage empty and quarantine — reads 503
+            # until repair pulls a healthy replica copy.
+            self._set_quarantined("snapshot digest mismatch at open")
             return
         self.storage = Bitmap.open_mmap_file(self.path)
         self.op_n = self.storage.op_n
@@ -352,6 +463,147 @@ class Fragment:
             replayed_ops=n_ops,
         )
 
+    # -- integrity: digest verification + quarantine (PR 15) -----------------
+
+    def check_serving(self) -> None:
+        """Raise when verification has found corruption: a quarantined
+        fragment must never serve (or accept) bits — a clean 503 beats
+        a silent wrong answer."""
+        if self.quarantined:
+            raise FragmentQuarantinedError(
+                self.index,
+                self.field,
+                self.view,
+                self.shard,
+                self.quarantine_reason,
+            )
+
+    def _set_quarantined(self, reason: str) -> None:
+        """Mark corrupt (caller holds mu, or is inside open()). The
+        generation bump fences plan/device caches off the poisoned
+        content: it bypasses the delta log, so staged snapshots can
+        never patch forward from it."""
+        if self.quarantined:
+            return
+        self.quarantined = True
+        self.quarantine_reason = reason
+        self.generation += 1
+        self._row_cache.clear()
+        self.checksums.clear()
+        self._occ = None
+        metrics.count(metrics.SCRUB_QUARANTINED)
+        events.record(
+            events.SCRUB_QUARANTINE,
+            index=self.index,
+            field=self.field,
+            view=self.view,
+            shard=self.shard,
+            reason=reason,
+        )
+
+    def quarantine(self, reason: str) -> None:
+        with self.mu:
+            self._set_quarantined(reason)
+
+    def clear_quarantine(self) -> None:
+        """Lift the quarantine after repair replaced the data (the
+        repair path bumps generation + delta_reset itself)."""
+        with self.mu:
+            self.quarantined = False
+            self.quarantine_reason = ""
+
+    def _verify_snapshot_digest(self) -> bool:
+        """True when the on-disk snapshot base matches its digest
+        trailer — or the file predates the checksummed format (no
+        trailer). Re-reads the file rather than trusting a live mmap,
+        so rot under the map is seen. The ``bitrot`` storage fault
+        injects here: one base byte flips on disk before the check."""
+        try:
+            with open(self.path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return False
+        if len(data) < bitmap_mod.HEADER_BASE_SIZE:
+            return True  # recovery resets short files to empty
+        try:
+            end = bitmap_mod.snapshot_base_end(data)
+        except Exception:
+            return False  # unparseable header/metas: corrupt
+        if not bitmap_mod.has_digest_trailer(data, end):
+            return True  # legacy file: nothing to verify against
+        spec = FAULTS
+        if spec is not None and spec.bitrot_due():
+            self._flip_disk_byte(max(0, end - 1))
+            with open(self.path, "rb") as f:
+                data = f.read()
+        return bitmap_mod.verify_digest_trailer(data, end)
+
+    def _flip_disk_byte(self, off: int) -> None:
+        """Flip one byte of the on-disk file in place (bitrot fault).
+        Goes through the page cache, so live mmaps see it — exactly
+        the silent-corruption-under-the-map failure mode."""
+        with open(self.path, "r+b") as f:
+            f.seek(off)
+            b = f.read(1)
+            if not b:
+                return
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0x01]))
+            f.flush()
+            os.fsync(f.fileno())
+
+    def verify_integrity(self, deep: bool = False) -> Optional[str]:
+        """Scrub this fragment; returns a reason string when corruption
+        was found (the fragment is quarantined first) or None when
+        clean. Checks, cheapest first: (1) snapshot digest trailer vs a
+        fresh re-read of the base bytes, (2) op-log tail CRC walk, (3)
+        ``deep``: re-parse the file and compare block checksums against
+        the live in-memory storage (catches rot under the mmap that
+        landed after open). Holds mu throughout so no reader can race
+        a flip-then-verify window and serve poisoned bits."""
+        if not self.path:
+            return None
+        with self.mu:
+            if self.quarantined:
+                return self.quarantine_reason
+            if not os.path.exists(self.path):
+                return None
+            if self._op_file:
+                # the scan below reads the file: flush buffered appends
+                # so a half-buffered record isn't mistaken for a tear
+                try:
+                    self._op_file.flush()
+                except OSError:
+                    pass
+            if not self._verify_snapshot_digest():
+                self._set_quarantined("snapshot digest mismatch")
+                return self.quarantine_reason
+            try:
+                with open(self.path, "rb") as f:
+                    data = f.read()
+                ops_off = bitmap_mod.ops_offset_of(data)
+                valid_end, _ = bitmap_mod.scan_op_log(data, ops_off)
+            except Exception:
+                self._set_quarantined("op log unreadable")
+                return self.quarantine_reason
+            if valid_end < len(data):
+                self._set_quarantined(
+                    f"op log CRC mismatch at byte {valid_end}"
+                )
+                return self.quarantine_reason
+            if deep and self.storage.is_mmap_backed():
+                try:
+                    fresh = Bitmap.unmarshal_binary(data)
+                except Exception:
+                    self._set_quarantined("snapshot base unparseable")
+                    return self.quarantine_reason
+                if self._blocks_of(fresh) != self.blocks():
+                    self._set_quarantined(
+                        "on-disk blocks diverge from memory"
+                    )
+                    return self.quarantine_reason
+            return None
+
     def close(self) -> None:
         with self.mu:
             if self._op_file:
@@ -373,8 +625,8 @@ class Fragment:
         fragment.go:227-266). The recount is a vectorised pass over the
         container occupancy index — no row materialisation."""
         p = self.cache_path()
-        if not p:
-            return
+        if not p or self.quarantined:
+            return  # cache rebuilds after repair
         ids = cache_mod.read_cache(p)
         if not ids:
             return
@@ -394,6 +646,7 @@ class Fragment:
         in ONE occupancy snapshot (row r spans keys [r*16, (r+1)*16));
         callers must not mix arrays from separate snapshots — a mutation
         between calls can change the index length."""
+        self.check_serving()
         occ = self._occ
         if occ is None or occ[0] != self.generation:
             # capture the generation BEFORE reading: if a writer bumps
@@ -442,6 +695,7 @@ class Fragment:
             return self._unprotected_row(row_id)
 
     def _unprotected_row(self, row_id: int, update_cache: bool = True) -> Row:
+        self.check_serving()
         r = self._row_cache.get(row_id)
         if r is not None:
             return r
@@ -472,6 +726,7 @@ class Fragment:
         return pos(row_id, column_id)
 
     def _unprotected_set_bit(self, row_id: int, column_id: int) -> bool:
+        self.check_serving()
         p = self._check_pos(row_id, column_id)
         if not self.storage.add(p):
             return False
@@ -491,6 +746,7 @@ class Fragment:
             return self._unprotected_clear_bit(row_id, column_id)
 
     def _unprotected_clear_bit(self, row_id: int, column_id: int) -> bool:
+        self.check_serving()
         p = self._check_pos(row_id, column_id)
         if not self.storage.remove(p):
             return False
@@ -504,6 +760,7 @@ class Fragment:
         return True
 
     def bit(self, row_id: int, column_id: int) -> bool:
+        self.check_serving()
         return self.storage.contains(self._check_pos(row_id, column_id))
 
     def _increment_op_n(self) -> None:
@@ -535,6 +792,7 @@ class Fragment:
         if rows.size == 0:
             return 0
         with self.mu:
+            self.check_serving()
             pairs = [
                 (self._check_pos(r, c), bool(s), int(r))
                 for r, c, s in zip(rows.tolist(), cols.tolist(), sets.tolist())
@@ -1127,11 +1385,31 @@ class Fragment:
                 self._op_file.close()
                 self._op_file = None
             tmp = self.path + ".snapshotting"
-            with open(tmp, "wb") as f:
-                self.storage.write_to(f)
+            spec = FAULTS
+            with open(tmp, "w+b") as f:
+                n = self.storage.write_to(f)
+                f.flush()
+                f.seek(0)
+                base = f.read(n)
+                # digest the base BEFORE any injected corruption: the
+                # corrupt_write fault models bytes rotting between the
+                # digest computation and the media, which is exactly
+                # what verification must catch
+                trailer = bitmap_mod.make_digest_trailer(base)
+                if spec is not None:
+                    off = spec.corrupt_offset(n)
+                    if off is not None:
+                        f.seek(off)
+                        f.write(bytes([base[off] ^ 0x01]))
+                f.seek(n)
+                f.write(trailer)
                 f.flush()
                 os.fsync(f.fileno())
+            if spec is not None:
+                spec.kill_point("pre")
             os.replace(tmp, self.path)
+            if spec is not None:
+                spec.kill_point("post")
             # the base just changed: the occupancy sidecar is stale by
             # construction (its stamp may even collide — equal size +
             # container count after a balanced clear/set pair), so
@@ -1164,10 +1442,16 @@ class Fragment:
 
     def blocks(self) -> list[tuple[int, bytes]]:
         """(block_id, checksum) for each 100-row block with any bits."""
+        return self._blocks_of(self.storage)
+
+    @staticmethod
+    def _blocks_of(storage) -> list[tuple[int, bytes]]:
+        """blocks() over an arbitrary Bitmap — the deep scrub compares
+        the live storage against a fresh re-read of the file."""
         out: dict[int, "hashlib._Hash"] = {}
         order: list[int] = []
-        for key in self.storage._iter_keys_sorted():
-            c = self.storage.containers[key]
+        for key in storage._iter_keys_sorted():
+            c = storage.containers[key]
             if not c.n:
                 continue
             row_id = (key << 16) // SHARD_WIDTH
@@ -1243,6 +1527,7 @@ class Fragment:
 
     def row_words(self, row_id: int) -> np.ndarray:
         """One row as packed uint64[16384] (2^20 bits)."""
+        self.check_serving()
         return self.storage.to_words_range(
             row_id * SHARD_WIDTH, (row_id + 1) * SHARD_WIDTH
         )

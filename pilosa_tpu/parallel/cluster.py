@@ -1417,6 +1417,11 @@ class Cluster:
                         if not any(n.id == self.node_id for n in nodes):
                             continue
                         remotes = [n for n in nodes if n.id != self.node_id]
+                        if frag.quarantined:
+                            # a quarantined fragment's bits are poisoned:
+                            # syncing would vote them into the consensus.
+                            # The scrubber repairs it; skip until then.
+                            continue
                         if remotes:
                             self._sync_fragment(
                                 iname, fname, vname, shard,
@@ -1502,3 +1507,30 @@ class Cluster:
                         )
                     except ClientError:
                         pass
+
+    def repair_fragment(self, index, field, view, shard) -> bool:
+        """Repair a quarantined fragment by pulling a full verified copy
+        from a healthy replica (the fragment-backup plane: the archive
+        carries a digest that unmarshal_fragment checks before applying,
+        so a rotted source can't re-poison us — and a quarantined source
+        refuses to serve at all, 503). True when a replica delivered."""
+        if self.server is None:
+            return False
+        nodes = self.shard_nodes(index, shard)
+        for node in nodes:
+            if node.id == self.node_id:
+                continue
+            try:
+                data = self.client.retrieve_fragment(
+                    node.uri, index, field, view, shard
+                )
+                self.server.api.unmarshal_fragment(index, field, view, shard, data)
+                return True
+            except Exception as e:
+                if self.logger:
+                    self.logger.printf(
+                        "repair pull %s/%s/%s/%s from %s failed: %s",
+                        index, field, view, shard, node.id, e,
+                    )
+                continue
+        return False

@@ -922,8 +922,11 @@ class Bitmap:
             else:
                 raise ValueError(f"unknown container type {typ}")
             self.containers[key] = c
-        # Replay trailing op log.
+        # Replay trailing op log (skipping the digest trailer when the
+        # snapshot carries one).
         off = ops_offset
+        if has_digest_trailer(data, off):
+            off += DIGEST_TRAILER_SIZE
         while off < len(data):
             ops, off = read_op_record(data, off)
             for op_typ, value in ops:
@@ -961,6 +964,46 @@ def _fnv32a(data: bytes) -> int:
         h ^= byte
         h = (h * 0x01000193) & 0xFFFFFFFF
     return h
+
+
+# -- snapshot digest trailer (checksummed snapshot format) -------------------
+#
+# Layout: [snapshot base][magic u32][blake2b-128 of the base][op log].
+# The trailer sits between the base and the op log so the ONE atomic
+# os.replace in fragment.snapshot() covers it — a sidecar file would
+# reintroduce the torn-write window the rename exists to close. The
+# magic's first byte (0xd7) can never be a valid op type (0/1/2), so a
+# trailer is unambiguous from op records; files written before this
+# format (no trailer) parse unchanged, with verification skipped.
+
+DIGEST_MAGIC = b"\xd7IG1"
+DIGEST_SIZE = 16  # blake2b digest_size=16, same as block checksums
+DIGEST_TRAILER_SIZE = len(DIGEST_MAGIC) + DIGEST_SIZE
+
+
+def base_digest(base) -> bytes:
+    """blake2b-128 over the serialized snapshot base bytes."""
+    import hashlib
+
+    return hashlib.blake2b(bytes(base), digest_size=DIGEST_SIZE).digest()
+
+
+def make_digest_trailer(base) -> bytes:
+    return DIGEST_MAGIC + base_digest(base)
+
+
+def has_digest_trailer(data, base_end: int) -> bool:
+    return (
+        len(data) >= base_end + DIGEST_TRAILER_SIZE
+        and bytes(data[base_end : base_end + len(DIGEST_MAGIC)]) == DIGEST_MAGIC
+    )
+
+
+def verify_digest_trailer(data, base_end: int) -> bool:
+    """True when the stored trailer digest matches the base bytes.
+    Only meaningful when ``has_digest_trailer(data, base_end)``."""
+    want = bytes(data[base_end + len(DIGEST_MAGIC) : base_end + DIGEST_TRAILER_SIZE])
+    return base_digest(memoryview(data)[:base_end]) == want
 
 
 def marshal_op(typ: int, value: int) -> bytes:
@@ -1033,12 +1076,13 @@ def read_op_record(buf, off: int = 0) -> tuple[list[tuple[int, int]], int]:
     raise ValueError(f"invalid op type: {typ}")
 
 
-def ops_offset_of(data) -> int:
-    """Offset where the trailing op log begins, computed from the
-    header, meta, and offset tables alone (plus one 2-byte run-count
-    read for a trailing run container) — no payload decode, so the
-    crash-recovery scan can bound the snapshot prefix before anything
-    mmaps the file."""
+def snapshot_base_end(data) -> int:
+    """End of the serialized snapshot base (header + meta/offset tables
+    + container payloads), computed from the header, meta, and offset
+    tables alone (plus one 2-byte run-count read for a trailing run
+    container) — no payload decode, so the crash-recovery scan can
+    bound the snapshot prefix before anything mmaps the file. The
+    digest trailer (when present) and the op log follow this offset."""
     if len(data) < HEADER_BASE_SIZE:
         raise ValueError("data too small")
     file_magic = struct.unpack_from("<H", data, 0)[0]
@@ -1074,6 +1118,16 @@ def ops_offset_of(data) -> int:
         raise ValueError(f"unknown container type {typ}")
     if end > len(data):
         raise ValueError("container payload out of bounds")
+    return end
+
+
+def ops_offset_of(data) -> int:
+    """Offset where the trailing op log begins: the snapshot base end,
+    plus the digest trailer when the file carries one (checksummed
+    snapshot format). Legacy files without a trailer parse unchanged."""
+    end = snapshot_base_end(data)
+    if has_digest_trailer(data, end):
+        end += DIGEST_TRAILER_SIZE
     return end
 
 

@@ -116,6 +116,13 @@ class MmapContainers:
 
         Returns (store, ops_offset) where ops_offset is the byte offset
         of the trailing op log. The payloads are NOT decoded.
+
+        When the file carries a digest trailer (checksummed snapshot
+        format), the RETURNED ops_offset skips it — op replay starts
+        past the trailer — but ``store.ops_offset`` stays at the base
+        end: serialize_clean's verbatim copy must emit the bare base
+        (fragment.snapshot appends a fresh trailer itself), and the
+        .occ sidecar stamp compares against the same base-end value.
         """
         if len(buf) < HEADER_BASE_SIZE:
             raise ValueError("data too small")
@@ -151,7 +158,12 @@ class MmapContainers:
             if ops_offset > len(buf):
                 raise ValueError(f"offset out of bounds: off={ops_offset}")
         store = cls(buf, metas, offsets, ops_offset=ops_offset)
-        return store, ops_offset
+        from pilosa_tpu.roaring.bitmap import DIGEST_TRAILER_SIZE, has_digest_trailer
+
+        replay_offset = ops_offset
+        if has_digest_trailer(buf, ops_offset):
+            replay_offset += DIGEST_TRAILER_SIZE
+        return store, replay_offset
 
     # -- base access ---------------------------------------------------------
 

@@ -9,6 +9,7 @@ restricted set is callable.
 from __future__ import annotations
 
 import io
+import json
 import time
 from typing import Optional
 
@@ -20,7 +21,7 @@ from pilosa_tpu.core.view import VIEW_STANDARD
 from pilosa_tpu.executor import ExecOptions
 from pilosa_tpu.pql import parse
 from pilosa_tpu.server import deadline, pipeline
-from pilosa_tpu.utils import metrics, profiler, trace
+from pilosa_tpu.utils import events, metrics, profiler, trace
 
 # cluster states (reference cluster.go:42-45)
 STATE_STARTING = "STARTING"
@@ -644,9 +645,13 @@ class API:
         return {"rows": rows.tolist(), "columns": cols.tolist()}
 
     def marshal_fragment(self, index: str, field: str, view: str, shard: int) -> bytes:
-        """Fragment backup archive: a tar with "data" (roaring bytes)
-        and "cache" (protobuf id list) entries, the reference's
-        WriteTo format (fragment.go:1511-1568)."""
+        """Fragment backup archive: a tar with "data" (roaring bytes),
+        "cache" (protobuf id list), and "digest" (blake2b-128 hex of
+        the data entry) entries, the reference's WriteTo format
+        (fragment.go:1511-1568) extended with the checksum the restore
+        side verifies before applying. A quarantined fragment refuses
+        (503): its bits are poisoned and must not propagate to peers."""
+        import hashlib
         import io
         import tarfile
 
@@ -654,14 +659,16 @@ class API:
         frag = self.holder.fragment(index, field, view, shard)
         if frag is None:
             raise NotFoundError("fragment not found")
+        frag.check_serving()
         from pilosa_tpu.core.cache import encode_cache
 
         with frag.mu:  # consistent (data, cache) snapshot under writers
             data = frag.storage.to_bytes()
             cbuf = encode_cache(frag.cache.ids())
+        digest = hashlib.blake2b(data, digest_size=16).hexdigest().encode()
         out = io.BytesIO()
         with tarfile.open(fileobj=out, mode="w") as tw:
-            for name, blob in (("data", data), ("cache", cbuf)):
+            for name, blob in (("data", data), ("cache", cbuf), ("digest", digest)):
                 info = tarfile.TarInfo(name)
                 info.size = len(blob)
                 info.mode = 0o600
@@ -673,7 +680,11 @@ class API:
     ) -> None:
         """Restore a fragment from a tar archive (reference ReadFrom,
         fragment.go:1570-1681) or from raw roaring bytes (this
-        framework's pre-tar wire format)."""
+        framework's pre-tar wire format). The archive's checksum (the
+        "digest" entry, when present) is verified and the bytes fully
+        PARSED before the live fragment is touched — a corrupt backup
+        can never clobber a healthy fragment mid-apply."""
+        import hashlib
         import io
         import tarfile
 
@@ -687,6 +698,7 @@ class API:
         from pilosa_tpu.roaring import Bitmap
 
         cache_ids = None
+        want_digest = None
         try:
             with tarfile.open(fileobj=io.BytesIO(data)) as tr:
                 members = {m.name: m for m in tr.getmembers()}
@@ -699,17 +711,62 @@ class API:
                 cfile = tr.extractfile(centry) if centry is not None else None
                 if cfile is not None:
                     cache_ids = decode_cache(cfile.read())
+                dentry = members.get("digest")
+                dfile = tr.extractfile(dentry) if dentry is not None else None
+                if dfile is not None:
+                    want_digest = dfile.read().decode("ascii", "replace").strip()
         except tarfile.ReadError:
             pass  # raw roaring bytes
 
+        if want_digest is not None:
+            got = hashlib.blake2b(data, digest_size=16).hexdigest()
+            if got != want_digest:
+                metrics.count(metrics.RESTORE_REFUSED)
+                events.record(
+                    events.RESTORE_REFUSED,
+                    index=index,
+                    field=field,
+                    view=view,
+                    shard=shard,
+                    reason="fragment archive digest mismatch",
+                )
+                raise APIError(
+                    "fragment archive checksum mismatch; restore refused",
+                    status=400,
+                )
+        try:
+            storage = Bitmap.unmarshal_binary(data)
+        except Exception as e:
+            metrics.count(metrics.RESTORE_REFUSED)
+            events.record(
+                events.RESTORE_REFUSED,
+                index=index,
+                field=field,
+                view=view,
+                shard=shard,
+                reason="fragment archive unparseable",
+            )
+            raise APIError(
+                f"fragment archive unparseable; restore refused: {e}",
+                status=400,
+            )
+        self._replace_fragment_storage(frag, storage, cache_ids)
+
+    def _replace_fragment_storage(self, frag, storage, cache_ids=None) -> None:
+        """Swap a fragment's bitmap for an already-verified one and
+        rebuild every derived structure. Clears any quarantine: the
+        incoming storage passed verification, so this IS the repair."""
         with frag.mu:
             op_writer = frag.storage.op_writer
-            frag.storage = Bitmap.unmarshal_binary(data)
+            frag.storage = storage
             frag.storage.op_writer = op_writer
             frag.generation += 1
+            frag.quarantined = False
+            frag.quarantine_reason = ""
             frag._delta_reset()  # wholesale replace: no replayable deltas
             frag._row_cache.clear()
             frag.checksums.clear()
+            frag._occ = None
             frag._recompute_max_row_id()
             frag.cache.clear()
             if cache_ids is None:
@@ -723,6 +780,139 @@ class API:
                 )
             frag.cache.invalidate()
             frag.snapshot()
+
+    # -- holder backup / restore (ISSUE 15) --
+
+    BACKUP_MANIFEST_VERSION = 1
+
+    def backup(self) -> bytes:
+        """Full-holder backup: a tar of the schema plus every fragment's
+        roaring bytes, led by a MANIFEST.json naming every member with
+        its blake2b-128 digest and size. The manifest is written FIRST
+        so a restore can verify the whole archive before applying a
+        byte. A quarantined fragment refuses the backup (503) — backing
+        up known-poisoned bits would launder the corruption into the
+        recovery path."""
+        import hashlib
+        import io
+        import tarfile
+
+        self._validate("fragment_data")
+        entries: list[tuple[str, bytes]] = []
+        schema_blob = json.dumps(self.holder.schema()).encode()
+        entries.append(("schema.json", schema_blob))
+        for iname, idx in self.holder.indexes.items():
+            for fname, fld in idx.fields.items():
+                for vname, view in fld.views.items():
+                    for shard, frag in sorted(view.fragments.items()):
+                        frag.check_serving()
+                        with frag.mu:
+                            data = frag.storage.to_bytes()
+                        entries.append(
+                            (f"fragments/{iname}/{fname}/{vname}/{shard}", data)
+                        )
+        manifest = {
+            "version": self.BACKUP_MANIFEST_VERSION,
+            "entries": {
+                name: {
+                    "blake2b": hashlib.blake2b(blob, digest_size=16).hexdigest(),
+                    "size": len(blob),
+                }
+                for name, blob in entries
+            },
+        }
+        out = io.BytesIO()
+        with tarfile.open(fileobj=out, mode="w") as tw:
+            for name, blob in [
+                ("MANIFEST.json", json.dumps(manifest, indent=1).encode())
+            ] + entries:
+                info = tarfile.TarInfo(name)
+                info.size = len(blob)
+                info.mode = 0o600
+                tw.addfile(info, io.BytesIO(blob))
+        metrics.count(metrics.BACKUP_ARCHIVES)
+        return out.getvalue()
+
+    def restore(self, archive: bytes) -> dict:
+        """Restore a holder backup. EVERYTHING is verified before
+        ANYTHING is applied: the manifest must name exactly the members
+        present, every blob must match its recorded digest and size,
+        the schema must parse, and every fragment blob must parse as a
+        roaring bitmap. Any failure refuses the whole restore (400)
+        with the holder untouched."""
+        import hashlib
+        import io
+        import tarfile
+
+        self._validate("fragment_data")
+        from pilosa_tpu.roaring import Bitmap
+
+        def refuse(reason: str) -> APIError:
+            metrics.count(metrics.RESTORE_REFUSED)
+            events.record(events.RESTORE_REFUSED, reason=reason)
+            return APIError(f"{reason}; restore refused", status=400)
+
+        try:
+            with tarfile.open(fileobj=io.BytesIO(archive)) as tr:
+                blobs = {}
+                for m in tr.getmembers():
+                    f = tr.extractfile(m)
+                    if f is not None:
+                        blobs[m.name] = f.read()
+        except tarfile.ReadError:
+            raise refuse("backup archive is not a tar")
+        mblob = blobs.pop("MANIFEST.json", None)
+        if mblob is None:
+            raise refuse("backup archive has no MANIFEST.json")
+        try:
+            manifest = json.loads(mblob)
+            version = manifest["version"]
+            want = manifest["entries"]
+        except Exception:
+            raise refuse("backup manifest unparseable")
+        if version != self.BACKUP_MANIFEST_VERSION:
+            raise refuse(f"backup manifest version {version} unsupported")
+        if set(want) != set(blobs):
+            missing = sorted(set(want) - set(blobs))[:3]
+            extra = sorted(set(blobs) - set(want))[:3]
+            raise refuse(
+                f"backup members diverge from manifest"
+                f" (missing={missing} extra={extra})"
+            )
+        for name, meta in want.items():
+            blob = blobs[name]
+            if len(blob) != meta.get("size"):
+                raise refuse(f"backup entry {name} size mismatch")
+            got = hashlib.blake2b(blob, digest_size=16).hexdigest()
+            if got != meta.get("blake2b"):
+                raise refuse(f"backup entry {name} checksum mismatch")
+        try:
+            schema = json.loads(blobs["schema.json"])
+        except Exception:
+            raise refuse("backup schema.json unparseable")
+        fragments = []
+        for name, blob in blobs.items():
+            if not name.startswith("fragments/"):
+                continue
+            parts = name.split("/")
+            if len(parts) != 5 or not parts[4].isdigit():
+                raise refuse(f"backup entry {name} has a malformed path")
+            try:
+                storage = Bitmap.unmarshal_binary(blob)
+            except Exception:
+                raise refuse(f"backup entry {name} unparseable")
+            fragments.append((parts[1], parts[2], parts[3], int(parts[4]), storage))
+        # -- verification complete: apply --
+        self.holder.apply_schema(schema)
+        for iname, fname, vname, shard, storage in fragments:
+            fld = self.holder.field(iname, fname)
+            view = fld.create_view_if_not_exists(vname)
+            frag = view.create_fragment_if_not_exists(shard)
+            self._replace_fragment_storage(frag, storage)
+        metrics.count(metrics.RESTORE_APPLIED)
+        if self.server is not None:
+            self.server.send_sync({"type": "schema", "schema": schema})
+        return {"fragments": len(fragments), "version": version}
 
     # -- caches --
 
@@ -779,6 +969,38 @@ class API:
         )
         if job is not None:
             out["resizeJob"] = job
+        integ = self._integrity_status()
+        if integ:
+            out["integrity"] = integ
+        return out
+
+    def _integrity_status(self) -> dict:
+        """Quarantined fragments + scrub-unrecoverable records for
+        /status — empty dict when the holder is healthy so the common
+        path stays unchanged."""
+        quarantined = []
+        for iname, idx in self.holder.indexes.items():
+            for fname, fld in idx.fields.items():
+                for vname, view in fld.views.items():
+                    for shard, frag in view.fragments.items():
+                        if frag.quarantined:
+                            quarantined.append(
+                                {
+                                    "index": iname,
+                                    "field": fname,
+                                    "view": vname,
+                                    "shard": shard,
+                                    "reason": frag.quarantine_reason,
+                                }
+                            )
+        out: dict = {}
+        if quarantined:
+            out["quarantined"] = quarantined
+        scrubber = getattr(self.server, "scrubber", None) if self.server else None
+        if scrubber is not None:
+            unrec = scrubber.unrecoverable_list()
+            if unrec:
+                out["unrecoverable"] = unrec
         return out
 
     def hosts(self) -> list[dict]:

@@ -201,9 +201,26 @@ class Config:
     # resetting the delta log and forcing a full re-stage
     ingest_delta_max_batch: int = 512
     # storage fault injection (tests/dryruns only, core/fragment.py):
-    # "fsync_fail_every=N,torn_at=N,enospc_after=N" — see
-    # fragment.StorageFaultSpec; "" disables
+    # "fsync_fail_every=N,torn_at=N,enospc_after=N,corrupt_at=K,
+    # bitrot=N,snapshot_kill=pre|post" — see fragment.StorageFaultSpec;
+    # "" disables
     storage_faults: str = ""
+    # background integrity scrubber (server/scrub.py): a low-priority
+    # loop re-verifying owned fragments at rest — snapshot digest,
+    # op-log CRC walk, and (scrub-deep) in-memory blocks vs an on-disk
+    # re-read. Corrupt fragments quarantine (reads 503) and repair from
+    # a healthy replica. 0 disables the loop; /debug/scrub still works.
+    scrub_interval: float = 300.0
+    # sleep between fragments within a sweep — bounds the scrubber's
+    # IO/CPU share so it never competes with serving
+    scrub_throttle: float = 0.05
+    # include the expensive deep check (full file re-read + block
+    # checksum compare against live memory) in every sweep
+    scrub_deep: bool = True
+    # repair quarantined fragments automatically from a healthy replica
+    # (federated/replicated clusters); off leaves them quarantined for
+    # operator action
+    scrub_repair: bool = True
     # continuous-batching dispatch engine (executor/dispatch.py): the
     # async executor↔device boundary. Callers submit futures; a
     # persistent loop admits queued queries into in-flight waves grouped
@@ -369,6 +386,10 @@ class Config:
             f"ingest-retry-after = {self.ingest_retry_after}",
             f"ingest-delta-max-batch = {self.ingest_delta_max_batch}",
             f'storage-faults = "{self.storage_faults}"',
+            f"scrub-interval = {self.scrub_interval}",
+            f"scrub-throttle = {self.scrub_throttle}",
+            f"scrub-deep = {'true' if self.scrub_deep else 'false'}",
+            f"scrub-repair = {'true' if self.scrub_repair else 'false'}",
             f"dispatch-enabled = {'true' if self.dispatch_enabled else 'false'}",
             f"dispatch-max-wave = {self.dispatch_max_wave}",
             f"dispatch-max-inflight = {self.dispatch_max_inflight}",

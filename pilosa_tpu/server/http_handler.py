@@ -17,6 +17,7 @@ from typing import Any, Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu.core import Row
+from pilosa_tpu.core.fragment import FragmentQuarantinedError
 from pilosa_tpu.executor import ValCount
 from pilosa_tpu.server import deadline as deadline_mod
 from pilosa_tpu.server.api import API, APIError
@@ -207,6 +208,12 @@ class Handler:
             Route("GET", r"/debug/fusion", self.get_debug_fusion),
             Route("GET", r"/debug/chaos", self.get_debug_chaos),
             Route("POST", r"/debug/chaos", self.post_debug_chaos),
+            # data integrity (ISSUE 15): scrub introspection/trigger +
+            # holder-level checksummed backup/restore
+            Route("GET", r"/debug/scrub", self.get_debug_scrub),
+            Route("POST", r"/debug/scrub", self.post_debug_scrub),
+            Route("GET", r"/backup", self.get_backup),
+            Route("POST", r"/restore", self.post_restore),
             Route("GET", r"/debug/multihost", self.get_debug_multihost),
             Route("GET", r"/debug/plancache", self.get_debug_plancache),
             Route("GET", r"/debug/vars", self.get_debug_vars),
@@ -897,6 +904,46 @@ class Handler:
         )
         return {"installed": installed, "storage": storage, "device": device}
 
+    def get_debug_scrub(self, req) -> dict:
+        """Background scrubber state: sweep counters, last-sweep timing,
+        config, and the unrecoverable-fragment record. NOT chaos-gated —
+        this is an operator health surface, not a fault injector."""
+        scrubber = getattr(
+            getattr(self.api, "server", None), "scrubber", None
+        )
+        if scrubber is None:
+            raise APIError("no scrubber (server not running)", status=503)
+        return scrubber.stats()
+
+    def post_debug_scrub(self, req) -> dict:
+        """Operator "scrub now": run one synchronous sweep and return
+        its summary ({scanned, corrupt, repaired, unrecoverable}).
+        Body ``{"index": "<name>"}`` scopes the sweep to one index;
+        ``{"repair": false}`` detects and quarantines without pulling
+        replica copies (damage survey before repair)."""
+        scrubber = getattr(
+            getattr(self.api, "server", None), "scrubber", None
+        )
+        if scrubber is None:
+            raise APIError("no scrubber (server not running)", status=503)
+        body = json.loads(req.body or b"{}")
+        return scrubber.sweep(
+            index=str(body.get("index") or ""),
+            repair=body.get("repair"),
+        )
+
+    def get_backup(self, req):
+        """Full-holder backup archive (tar): MANIFEST.json with per-entry
+        blake2b checksums, schema.json, and every fragment's roaring
+        bytes. ``pilosa_tpu backup`` streams this to a file."""
+        return RawResponse(self.api.backup(), "application/x-tar")
+
+    def post_restore(self, req) -> dict:
+        """Restore a holder backup. The whole archive is verified
+        against its manifest (and every fragment parsed) before any
+        byte is applied; a tampered archive is refused with 400."""
+        return self.api.restore(req.body)
+
     def get_debug_traces(self, req) -> dict:
         """Recent completed query traces (the tracer's ring buffer) as
         JSON span trees, newest last; stitched with any remote spans
@@ -1156,6 +1203,15 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
                 # stage boundary — 504, like a gateway timeout
                 payload, ctype = self._error_payload(str(e))
                 self.send_response(504)
+            except FragmentQuarantinedError as e:
+                # corrupt fragment under repair: clean 503 + Retry-After
+                # (never a wrong answer) — by the time a well-behaved
+                # client retries, scrub has usually pulled a replica copy
+                payload, ctype = self._error_payload(str(e))
+                extra_headers.append(
+                    ("Retry-After", str(max(1, round(e.retry_after))))
+                )
+                self.send_response(e.status)
             except APIError as e:
                 payload, ctype = self._error_payload(str(e))
                 self.send_response(e.status)

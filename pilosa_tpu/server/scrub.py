@@ -1,0 +1,201 @@
+"""Background integrity scrubber (ISSUE 15) — a low-priority server
+loop that sweeps every locally-owned fragment verifying its on-disk
+bytes: the snapshot's blake2b digest trailer, a CRC walk of the op-log
+tail, and (deep mode) a full re-parse of the file compared block-by-
+block against the in-memory bitmap.
+
+Bit rot is the failure the durability work (ISSUE 11) can't see: fsync
+told the truth at write time, then the medium lied later. Waiting for
+a query to trip over a rotted page means serving wrong answers in the
+meantime; the scrubber finds the rot first, quarantines the fragment
+(reads fail with a clean 503 instead of garbage), and repairs it by
+pulling a verified copy from a healthy replica over the fragment-backup
+plane. Fragments with no healthy source are journaled and surfaced in
+``/status`` under ``integrity.unrecoverable`` — loud, not silent.
+
+The sweep is throttled (``scrub-throttle`` seconds between fragments)
+so a big holder scrubs in the background without starving queries.
+``GET /debug/scrub`` reports the stats below; ``POST /debug/scrub``
+runs a synchronous sweep (operator "scrub now").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from pilosa_tpu.utils import events, metrics
+
+
+class Scrubber:
+    """One per server; owns sweep state and the unrecoverable record."""
+
+    def __init__(self, server) -> None:
+        self.server = server
+        cfg = server.config
+        self.interval = float(getattr(cfg, "scrub_interval", 300.0))
+        self.throttle = float(getattr(cfg, "scrub_throttle", 0.05))
+        self.deep = bool(getattr(cfg, "scrub_deep", True))
+        self.repair = bool(getattr(cfg, "scrub_repair", True))
+        self._mu = threading.Lock()
+        # (index, field, view, shard) -> record dict; cleared on repair
+        self._unrecoverable: dict = {}
+        self.sweeps = 0
+        self.fragments_scanned = 0
+        self.corruptions = 0
+        self.repairs = 0
+        self.last_sweep_seconds = 0.0
+        self.last_sweep_at = 0.0
+
+    # -- sweep ----------------------------------------------------------
+
+    def sweep(self, index: str = "", repair=None) -> dict:
+        """One full pass over the local holder (or one index when
+        ``index`` is given — the operator's scoped "scrub now").
+        ``repair`` overrides the configured scrub-repair for this sweep
+        (False = detect-and-quarantine only, e.g. to survey damage
+        before pulling replica copies). Returns a summary dict (also
+        what POST /debug/scrub responds with)."""
+        do_repair = self.repair if repair is None else bool(repair)
+        start = time.monotonic()
+        scanned = corrupt = repaired = unrecoverable = 0
+        only = index
+        for index, field, view, shard, frag in self._local_fragments(only):
+            scanned += 1
+            if frag.quarantined:
+                # found corrupt earlier (open-time check or a previous
+                # sweep) and still unrepaired — retry the repair only
+                reason = frag.quarantine_reason
+            else:
+                reason = frag.verify_integrity(deep=self.deep)
+                if reason is not None:
+                    corrupt += 1
+                    metrics.count(
+                        metrics.SCRUB_CORRUPTIONS,
+                        reason=reason.split(" at ")[0],
+                    )
+                    events.record(
+                        events.SCRUB_CORRUPTION,
+                        index=index,
+                        field=field,
+                        view=view,
+                        shard=shard,
+                        reason=reason,
+                    )
+            if reason is not None and do_repair:
+                if self._repair(index, field, view, shard, frag, reason):
+                    repaired += 1
+                else:
+                    unrecoverable += 1
+            if self.throttle > 0:
+                closed = getattr(self.server, "_closed", None)
+                if closed is not None and closed.wait(self.throttle):
+                    break
+                if closed is None:
+                    time.sleep(self.throttle)
+        elapsed = time.monotonic() - start
+        with self._mu:
+            self.sweeps += 1
+            self.fragments_scanned += scanned
+            self.corruptions += corrupt
+            self.repairs += repaired
+            self.last_sweep_seconds = elapsed
+            self.last_sweep_at = time.time()
+        metrics.count(metrics.SCRUB_SWEEPS)
+        metrics.count(metrics.SCRUB_FRAGMENTS_SCANNED, scanned)
+        metrics.observe(metrics.SCRUB_SWEEP_SECONDS, elapsed)
+        return {
+            "scanned": scanned,
+            "corrupt": corrupt,
+            "repaired": repaired,
+            "unrecoverable": unrecoverable,
+            "seconds": elapsed,
+        }
+
+    def _local_fragments(self, index: str = ""):
+        holder = self.server.holder
+        cluster = getattr(self.server, "cluster", None)
+        for iname, idx in list(holder.indexes.items()):
+            if index and iname != index:
+                continue
+            for fname, fld in list(idx.fields.items()):
+                for vname, view in list(fld.views.items()):
+                    for shard, frag in sorted(view.fragments.items()):
+                        if not frag.path:
+                            continue  # in-memory fragment: nothing on disk
+                        if cluster is not None and not cluster.owns_shard(
+                            iname, shard
+                        ):
+                            continue
+                        yield iname, fname, vname, shard, frag
+
+    def _repair(self, index, field, view, shard, frag, reason) -> bool:
+        key = (index, field, view, shard)
+        cluster = getattr(self.server, "cluster", None)
+        ok = False
+        if cluster is not None:
+            try:
+                ok = cluster.repair_fragment(index, field, view, shard)
+            except Exception as e:
+                self.server.logger.printf(
+                    "scrub repair %s/%s/%s/%s failed: %s",
+                    index, field, view, shard, e,
+                )
+        if ok:
+            metrics.count(metrics.SCRUB_REPAIRS)
+            events.record(
+                events.SCRUB_REPAIR,
+                index=index,
+                field=field,
+                view=view,
+                shard=shard,
+                reason=reason,
+            )
+            with self._mu:
+                self._unrecoverable.pop(key, None)
+            return True
+        metrics.count(metrics.SCRUB_UNRECOVERABLE)
+        events.record(
+            events.SCRUB_UNRECOVERABLE,
+            index=index,
+            field=field,
+            view=view,
+            shard=shard,
+            reason=reason,
+        )
+        with self._mu:
+            self._unrecoverable[key] = {
+                "index": index,
+                "field": field,
+                "view": view,
+                "shard": shard,
+                "reason": reason,
+                "since": self._unrecoverable.get(key, {}).get(
+                    "since", time.time()
+                ),
+            }
+        return False
+
+    # -- introspection --------------------------------------------------
+
+    def unrecoverable_list(self) -> list[dict]:
+        with self._mu:
+            return [dict(v) for _, v in sorted(self._unrecoverable.items())]
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "interval": self.interval,
+                "throttle": self.throttle,
+                "deep": self.deep,
+                "repair": self.repair,
+                "sweeps": self.sweeps,
+                "fragmentsScanned": self.fragments_scanned,
+                "corruptions": self.corruptions,
+                "repairs": self.repairs,
+                "lastSweepSeconds": self.last_sweep_seconds,
+                "lastSweepAt": self.last_sweep_at,
+                "unrecoverable": [
+                    dict(v) for _, v in sorted(self._unrecoverable.items())
+                ],
+            }

@@ -361,6 +361,9 @@ class Server:
             version=__version__,
             logger=self.logger,
         )
+        from pilosa_tpu.server.scrub import Scrubber
+
+        self.scrubber = Scrubber(self)
         self.httpd = None
         self._serve_thread: Optional[threading.Thread] = None
         self.node_id: str = ""
@@ -795,7 +798,24 @@ class Server:
                             metrics.ANTI_ENTROPY_SECONDS, time.monotonic() - t0
                         )
                 except Exception as e:
+                    # a silently dead syncer is an availability bug:
+                    # count + journal so the failure is fleet-visible
+                    self.stats.count(metrics.ANTI_ENTROPY_ERRORS)
+                    events.record(events.ANTI_ENTROPY_ERROR, error=str(e))
                     self.logger.printf("anti-entropy sync error: %s", e)
+
+        def scrub_loop():
+            # background data-integrity sweep (server/scrub.py) — sleep
+            # first so boot-time opens (which verify digests themselves)
+            # aren't doubled, then sweep on the interval
+            interval = self.scrubber.interval
+            if interval <= 0:
+                return
+            while not self._closed.wait(interval):
+                try:
+                    self.scrubber.sweep()
+                except Exception as e:
+                    self.logger.printf("scrub sweep error: %s", e)
 
         def runtime_monitor_loop():
             import gc
@@ -890,6 +910,7 @@ class Server:
         for fn in (
             cache_flush_loop,
             anti_entropy_loop,
+            scrub_loop,
             runtime_monitor_loop,
             diagnostics_loop,
             translate_replication_loop,

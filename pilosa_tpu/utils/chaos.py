@@ -183,10 +183,10 @@ class ChaosSchedule:
     - ``duration_s``: how long mixed load runs under the window
     """
 
-    FAMILIES = ("storage", "device", "mixed")
+    FAMILIES = ("storage", "device", "mixed", "bitrot")
 
     def __init__(
-        self, seed: int, windows: int = 3, duration_s: float = 3.0
+        self, seed: int, windows: int = 4, duration_s: float = 3.0
     ) -> None:
         self.seed = int(seed)
         rng = random.Random(self.seed)
@@ -204,6 +204,11 @@ class ChaosSchedule:
                 w["storage"] = f"fsync_fail_every={rng.randint(2, 5)}"
             if family in ("device", "mixed"):
                 w["device"] = f"oom_every={rng.randint(2, 6)}"
+            if family == "bitrot":
+                # every Nth integrity verification flips a byte of the
+                # snapshot base on disk before checking — the scrub /
+                # open-time digest pass must detect it (ISSUE 15)
+                w["storage"] = f"bitrot={rng.randint(1, 3)}"
             self.windows.append(w)
 
     def __iter__(self):

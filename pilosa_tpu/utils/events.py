@@ -48,6 +48,14 @@ DEVICE_OOM = "device.oom"
 DEVICE_OOM_RECOVERED = "device.oom_recovered"
 DEVICE_FAULT = "device.fault"
 CHAOS_WINDOW = "chaos.window"
+# data integrity (ISSUE 15): scrub findings, quarantine/repair
+# lifecycle, anti-entropy sweep failures, refused restores
+SCRUB_CORRUPTION = "scrub.corruption"
+SCRUB_QUARANTINE = "scrub.quarantine"
+SCRUB_REPAIR = "scrub.repair"
+SCRUB_UNRECOVERABLE = "scrub.unrecoverable"
+ANTI_ENTROPY_ERROR = "antientropy.error"
+RESTORE_REFUSED = "restore.refused"
 
 # kind → one-line description; the docs/administration.md event-kind
 # catalog is sync-tested against this registry both directions, so a
@@ -68,6 +76,12 @@ EVENT_KINDS: dict = {
     DEVICE_OOM_RECOVERED: "device OOM recovered via governor eviction + retry or CPU degrade",
     DEVICE_FAULT: "injected device fault (fault-injection harness)",
     CHAOS_WINDOW: "chaos harness fault window installed or cleared",
+    SCRUB_CORRUPTION: "scrub (or open-time verification) detected fragment corruption",
+    SCRUB_QUARANTINE: "corrupt fragment quarantined — reads fail 503 until repaired",
+    SCRUB_REPAIR: "quarantined fragment repaired from a healthy replica",
+    SCRUB_UNRECOVERABLE: "corrupt fragment has no healthy replica to repair from",
+    ANTI_ENTROPY_ERROR: "anti-entropy sweep failed against a replica",
+    RESTORE_REFUSED: "backup archive failed checksum verification; restore refused",
 }
 
 

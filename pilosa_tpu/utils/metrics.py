@@ -148,6 +148,18 @@ INGEST_SHEDS = "ingest.sheds"
 INGEST_RECOVERY_REPLAYS = "ingest.recovery_replays"
 INGEST_RECOVERY_TRUNCATED_BYTES = "ingest.recovery_truncated_bytes"
 INGEST_FAULTS_INJECTED = "ingest.faults_injected"
+# end-to-end data integrity (ISSUE 15): background scrubber findings,
+# quarantine/repair lifecycle, holder backup/restore
+SCRUB_SWEEPS = "scrub.sweeps"
+SCRUB_FRAGMENTS_SCANNED = "scrub.fragments_scanned"
+SCRUB_CORRUPTIONS = "scrub.corruptions"
+SCRUB_QUARANTINED = "scrub.quarantined"
+SCRUB_REPAIRS = "scrub.repairs"
+SCRUB_UNRECOVERABLE = "scrub.unrecoverable"
+SCRUB_SWEEP_SECONDS = "scrub.sweep_seconds"
+BACKUP_ARCHIVES = "backup.archives"
+RESTORE_APPLIED = "restore.applied"
+RESTORE_REFUSED = "restore.refused"
 # async continuous-batching dispatch engine (executor/dispatch.py)
 DISPATCH_WAVE_SIZE = "dispatch.wave_size"
 DISPATCH_INFLIGHT_DEPTH = "dispatch.inflight_depth"
@@ -214,6 +226,7 @@ GC_GEN0 = "gcGen0"
 GARBAGE_COLLECTION = "garbage_collection"
 OPEN_FRAGMENTS = "openFragments"
 ANTI_ENTROPY_SECONDS = "antiEntropyDurationSeconds"
+ANTI_ENTROPY_ERRORS = "antiEntropyErrors"
 
 # name -> (prometheus type, help). "summary" renders quantiles + _sum/_count.
 METRICS: dict[str, tuple[str, str]] = {
@@ -434,7 +447,51 @@ METRICS: dict[str, tuple[str, str]] = {
     INGEST_FAULTS_INJECTED: (
         "counter",
         "storage faults injected by the storage-faults schedule "
-        "(label: fault = fsync_fail | torn_write | enospc)",
+        "(label: fault = fsync_fail | torn_write | enospc | "
+        "corrupt_write | bitrot)",
+    ),
+    SCRUB_SWEEPS: (
+        "counter",
+        "background-scrub sweeps completed over the owned fragment set",
+    ),
+    SCRUB_FRAGMENTS_SCANNED: (
+        "counter",
+        "fragments verified by the scrubber (digest + op-log CRC, and "
+        "block compare when scrub-deep)",
+    ),
+    SCRUB_CORRUPTIONS: (
+        "counter",
+        "corruptions detected by verification (label: reason)",
+    ),
+    SCRUB_QUARANTINED: (
+        "counter",
+        "fragments quarantined after failing verification (reads 503 "
+        "until repaired)",
+    ),
+    SCRUB_REPAIRS: (
+        "counter",
+        "quarantined fragments repaired from a healthy replica copy",
+    ),
+    SCRUB_UNRECOVERABLE: (
+        "counter",
+        "quarantined fragments with no healthy replica to repair from",
+    ),
+    SCRUB_SWEEP_SECONDS: (
+        "summary",
+        "wall time of one full scrub sweep (includes throttle sleeps)",
+    ),
+    BACKUP_ARCHIVES: (
+        "counter",
+        "holder backup archives streamed (CLI or GET /backup)",
+    ),
+    RESTORE_APPLIED: (
+        "counter",
+        "holder restores applied after full archive checksum verification",
+    ),
+    RESTORE_REFUSED: (
+        "counter",
+        "restores refused: archive failed checksum/manifest verification "
+        "before any byte was applied",
     ),
     DISPATCH_WAVE_SIZE: (
         "summary",
@@ -638,6 +695,12 @@ METRICS: dict[str, tuple[str, str]] = {
     GARBAGE_COLLECTION: ("counter", "completed gc collection cycles"),
     OPEN_FRAGMENTS: ("gauge", "fragments currently open in the holder"),
     ANTI_ENTROPY_SECONDS: ("summary", "anti-entropy sweep duration"),
+    ANTI_ENTROPY_ERRORS: (
+        "counter",
+        "anti-entropy sweeps that failed (per-fragment sync errors "
+        "also journal antientropy.error) — a silently dead syncer is "
+        "visible on the fleet scrape",
+    ),
 }
 
 # -- trace stage names (pilosa_tpu/utils/trace.py span names) --------------

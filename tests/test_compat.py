@@ -111,7 +111,14 @@ def test_fragment_tar_archive_roundtrip(tmp_path):
     f.import_bits([1, 1, 2], [10, 11, 12])
     blob = api.marshal_fragment("t", "f", "standard", 0)
     with tarfile.open(fileobj=io.BytesIO(blob)) as tr:
-        assert {m.name for m in tr.getmembers()} == {"data", "cache"}
+        # "digest" extends the reference format: the receiver verifies
+        # the data member against it before replacing anything
+        assert {m.name for m in tr.getmembers()} == {"data", "cache", "digest"}
+        import hashlib
+
+        data = tr.extractfile("data").read()
+        digest = tr.extractfile("digest").read().decode()
+        assert digest == hashlib.blake2b(data, digest_size=16).hexdigest()
 
     h2 = Holder(str(tmp_path / "b"))
     h2.open()

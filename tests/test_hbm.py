@@ -420,12 +420,16 @@ class TestChaosSchedule:
     def test_windows_cover_all_families_with_parsable_specs(self):
         from pilosa_tpu.core.fragment import StorageFaultSpec
 
-        ws = list(ChaosSchedule(seed=3, windows=6))
+        ws = list(ChaosSchedule(seed=3, windows=8))
         assert [w["name"].split("-", 1)[1] for w in ws] == [
-            "storage", "device", "mixed", "storage", "device", "mixed",
+            "storage", "device", "mixed", "bitrot",
+            "storage", "device", "mixed", "bitrot",
         ]
         for w in ws:
             StorageFaultSpec.parse(w["storage"])  # empty parses clean too
             DeviceFaultSpec.parse(w["device"])
             if "mixed" in w["name"]:
                 assert w["storage"] and w["device"]
+            if "bitrot" in w["name"]:
+                # the bit-rot window rides the storage injector (ISSUE 15)
+                assert w["storage"].startswith("bitrot=")

@@ -121,19 +121,26 @@ def test_truncated_snapshot_header_resets_to_empty(tmp_path):
     f2.close()
 
 
-def test_corrupt_snapshot_prefix_still_fails_open(tmp_path):
+def test_corrupt_snapshot_prefix_quarantines_at_open(tmp_path):
     # the snapshot prefix is written atomically (tmp+fsync+rename), so
     # base corruption is NOT a crash artifact — recovery must not
-    # silently wipe it
+    # silently wipe it. Since the integrity work the open succeeds but
+    # the fragment is QUARANTINED: reads fail clean (503 upstream,
+    # never garbage) and the file is kept intact for repair.
     p = tmp_path / "frag"
     f = _frag(p)
     f.set_bit(0, 1)
     f.close()
+    size = os.path.getsize(p)
     with open(p, "r+b") as fh:
         fh.seek(0)
         fh.write(b"\xff\xff\xff\xff")
-    with pytest.raises(Exception):
-        _frag(p)
+    f2 = _frag(p)
+    assert f2.quarantined
+    with pytest.raises(fragment_mod.FragmentQuarantinedError):
+        f2.row(0)
+    assert os.path.getsize(p) == size  # nothing wiped or truncated
+    f2.close()
 
 
 def test_recovery_replays_multiple_waves_bit_identical(tmp_path):

@@ -1,0 +1,564 @@
+"""End-to-end data integrity (ISSUE 15): the checksummed snapshot
+format (blake2b digest trailer), integrity fault injection
+(corrupt_at / bitrot / snapshot_kill), open-time and scrub-time
+verification with quarantine's clean-503 contract, SIGKILL-mid-snapshot
+atomicity, the offline `check` data-file mode, verify-before-apply
+fragment transfer, and holder backup/restore.
+
+The property under test everywhere: corruption is DETECTED before it is
+SERVED — a rotted fragment answers 503 (never garbage) until repair
+replaces it with a verified replica copy, and a tampered archive is
+refused before a single byte is applied.
+"""
+
+import io
+import os
+import random
+import subprocess
+import sys
+import tarfile
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.core import fragment as fragment_mod
+from pilosa_tpu.core.fragment import (
+    Fragment,
+    FragmentQuarantinedError,
+    StorageFaultSpec,
+)
+from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.roaring import bitmap as bm
+from pilosa_tpu.server import ClusterConfig, Config, Server
+from pilosa_tpu.utils import events, metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fragment_mod.FAULTS = None
+    yield
+    fragment_mod.FAULTS = None
+
+
+def _frag(path) -> Fragment:
+    f = Fragment(str(path), "i", "f", VIEW_STANDARD, 0)
+    f.open()
+    return f
+
+
+def _seed(f: Fragment) -> None:
+    for r in range(4):
+        for c in range(0, 400, 7):
+            f.set_bit(r, (r * 31 + c) % 4096)
+
+
+# -- checksummed snapshot format ---------------------------------------------
+
+
+def test_snapshot_carries_digest_trailer_and_verifies(tmp_path):
+    p = tmp_path / "frag"
+    f = _frag(p)
+    _seed(f)
+    f.snapshot()
+    f.close()
+    data = open(p, "rb").read()
+    end = bm.snapshot_base_end(data)
+    assert bm.has_digest_trailer(data, end)
+    assert bm.verify_digest_trailer(data, end)
+    # any flipped base byte breaks verification
+    rotted = bytearray(data)
+    rotted[end // 2] ^= 0x01
+    assert not bm.verify_digest_trailer(bytes(rotted), end)
+    # a legacy file (base only, no trailer) has nothing to verify
+    legacy = data[:end]
+    assert not bm.has_digest_trailer(legacy, end)
+
+
+def test_legacy_file_without_trailer_still_opens(tmp_path):
+    p = tmp_path / "frag"
+    f = _frag(p)
+    _seed(f)
+    f.snapshot()
+    f.close()
+    data = open(p, "rb").read()
+    end = bm.snapshot_base_end(data)
+    with open(p, "wb") as fh:  # strip the trailer: pre-PR-15 file
+        fh.write(data[:end])
+    f2 = _frag(p)
+    assert not f2.quarantined
+    assert f2.bit(0, 0)
+    assert f2.verify_integrity() is None
+    f2.close()
+
+
+def test_ops_appended_after_trailer_replay(tmp_path):
+    p = tmp_path / "frag"
+    f = _frag(p)
+    _seed(f)
+    f.snapshot()
+    f.set_bit(9, 4095)  # op-log record lands AFTER the trailer
+    f.close()
+    f2 = _frag(p)
+    assert f2.bit(9, 4095) and f2.bit(0, 0)
+    assert f2.verify_integrity(deep=True) is None
+    f2.close()
+
+
+# -- fault spec: integrity knobs ---------------------------------------------
+
+
+def test_fault_spec_parses_integrity_knobs():
+    s = StorageFaultSpec.parse("corrupt_at=12, bitrot=2, snapshot_kill=post")
+    assert s.corrupt_at == 12 and s.bitrot == 2 and s.snapshot_kill == "post"
+    assert bool(s)
+    with pytest.raises(ValueError):
+        # check: disable=fault-spec (deliberately invalid phase — the ValueError is the assertion)
+        StorageFaultSpec.parse("snapshot_kill=sideways")
+
+
+def test_bitrot_fires_every_nth_verification():
+    s = StorageFaultSpec(bitrot=2)
+    assert [s.bitrot_due() for _ in range(4)] == [False, True, False, True]
+
+
+# -- corruption detection + quarantine ---------------------------------------
+
+
+def test_corrupt_write_caught_at_open(tmp_path):
+    """corrupt_at flips a byte between digest computation and the media
+    — exactly what the trailer must catch at the next open."""
+    p = tmp_path / "frag"
+    f = _frag(p)
+    _seed(f)
+    fragment_mod.FAULTS = StorageFaultSpec(corrupt_at=10)
+    f.snapshot()
+    fragment_mod.FAULTS = None
+    f.close()
+    f2 = _frag(p)
+    assert f2.quarantined
+    assert f2.quarantine_reason == "snapshot digest mismatch at open"
+    with pytest.raises(FragmentQuarantinedError) as ei:
+        f2.check_serving()
+    assert ei.value.status == 503 and ei.value.retry_after >= 1
+    with pytest.raises(FragmentQuarantinedError):
+        f2.set_bit(0, 1)  # writes are fenced too
+    f2.close()
+
+
+def test_bitrot_detected_by_scrub_and_sticky(tmp_path):
+    p = tmp_path / "frag"
+    f = _frag(p)
+    _seed(f)
+    f.snapshot()
+    assert f.verify_integrity(deep=True) is None  # clean baseline
+    fragment_mod.FAULTS = StorageFaultSpec(bitrot=1)
+    reason = f.verify_integrity()
+    assert reason == "snapshot digest mismatch"
+    assert f.quarantined
+    fragment_mod.FAULTS = None
+    # quarantine is sticky: re-verifying reports, never un-quarantines
+    assert f.verify_integrity() == reason
+    f.close()
+
+
+def test_deep_verify_catches_consistent_but_wrong_disk(tmp_path):
+    """Rot that rewrites the base AND its trailer (valid digest over
+    wrong bytes) passes the shallow check; only the deep blocks-vs-disk
+    compare sees the live mmap (old inode) diverge from the file."""
+    p = tmp_path / "frag"
+    f = _frag(p)
+    _seed(f)
+    f.snapshot()
+    f.close()
+    f = _frag(p)  # mmap-backed: deep compare applies
+    data = open(p, "rb").read()
+    end = bm.snapshot_base_end(data)
+    base = bytearray(data[:end])
+    base[end - 1] ^= 0x01
+    with open(str(p) + ".rot", "wb") as fh:
+        fh.write(bytes(base) + bm.make_digest_trailer(bytes(base)))
+    os.replace(str(p) + ".rot", p)
+    assert f.verify_integrity(deep=False) is None  # digest says fine
+    reason = f.verify_integrity(deep=True)
+    assert reason in (
+        "on-disk blocks diverge from memory",
+        "snapshot base unparseable",
+    )
+    assert f.quarantined
+    f.close()
+
+
+def test_op_log_crc_walk_catches_garbage_tail(tmp_path):
+    p = tmp_path / "frag"
+    f = _frag(p)
+    _seed(f)
+    f.snapshot()
+    f.set_bit(5, 99)
+    with open(p, "ab") as fh:
+        fh.write(b"\xde\xad\xbe\xef" * 4)
+    reason = f.verify_integrity()
+    assert reason is not None and reason.startswith("op log CRC mismatch")
+    assert f.quarantined
+    f.close()
+
+
+# -- SIGKILL mid-snapshot: atomicity property --------------------------------
+
+_KILL_CHILD = r"""
+import os, random, sys
+sys.path.insert(0, sys.argv[4])
+from pilosa_tpu.core import fragment as fragment_mod
+from pilosa_tpu.core.fragment import Fragment, StorageFaultSpec
+from pilosa_tpu.core.view import VIEW_STANDARD
+
+path, phase, seed = sys.argv[1], sys.argv[2], int(sys.argv[3])
+rng = random.Random(seed)
+bits = sorted({(rng.randrange(8), rng.randrange(5000)) for _ in range(300)})
+f = Fragment(path, "i", "f", VIEW_STANDARD, 0)
+f.open()
+for r, c in bits[:150]:
+    f.set_bit(r, c)
+f.snapshot()  # durable base
+for r, c in bits[150:]:
+    f.set_bit(r, c)  # durable op-log tail
+fragment_mod.FAULTS = StorageFaultSpec(snapshot_kill=phase)
+f.snapshot()  # dies at the scheduled point
+os._exit(3)  # unreachable: the kill point must fire
+"""
+
+
+@pytest.mark.parametrize("phase", ["pre", "post"])
+@pytest.mark.parametrize("seed", [15, 16])
+def test_sigkill_mid_snapshot_is_atomic(tmp_path, phase, seed):
+    """Kill the process immediately before and immediately after the
+    snapshot's atomic rename: either way the reopened fragment must be
+    bit-identical to everything written (old base + op log, or the new
+    base) — never a half-written file."""
+    p = tmp_path / "frag"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", _KILL_CHILD, str(p), phase, str(seed), REPO],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=env,
+    )
+    assert proc.returncode == 137, (proc.returncode, proc.stderr[-500:])
+    rng = random.Random(seed)
+    bits = sorted({(rng.randrange(8), rng.randrange(5000)) for _ in range(300)})
+    f = _frag(p)
+    assert not f.quarantined
+    assert f.verify_integrity(deep=True) is None
+    for r, c in bits:
+        assert f.bit(r, c), f"lost bit ({r}, {c}) after {phase}-rename kill"
+    f.close()
+
+
+# -- offline `check` data-file mode ------------------------------------------
+
+
+def _run_check(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "pilosa_tpu", "check", *argv],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+    )
+
+
+def test_check_cli_clean_torn_repair_and_rot(tmp_path):
+    p = tmp_path / "frag"
+    f = _frag(p)
+    _seed(f)
+    f.snapshot()
+    f.set_bit(5, 99)
+    f.close()
+    intact = os.path.getsize(p)
+
+    assert _run_check(str(p)).returncode == 0
+
+    with open(p, "ab") as fh:  # torn tail: non-zero exit, names --repair
+        fh.write(b"\x01\x02\x03")
+    r = _run_check(str(p))
+    assert r.returncode == 1 and "--repair" in r.stdout + r.stderr
+
+    r = _run_check("--repair", str(p))  # truncates the torn bytes
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert os.path.getsize(p) == intact
+    assert _run_check(str(p)).returncode == 0
+    f2 = _frag(p)  # acked ops survive the repair
+    assert f2.bit(5, 99) and f2.bit(0, 0)
+    f2.close()
+
+    data = bytearray(open(p, "rb").read())  # rotted base: fails, loudly
+    data[bm.snapshot_base_end(bytes(data)) // 2] ^= 0x01
+    open(p, "wb").write(bytes(data))
+    r = _run_check(str(p))
+    assert r.returncode == 1 and "digest mismatch" in r.stdout + r.stderr
+
+
+# -- scrub / quarantine / repair over a live cluster -------------------------
+
+
+def _flip_frag(server, index="i", field="f", shard=0):
+    frag = server.holder.fragment(index, field, "standard", shard)
+    with frag.mu:
+        frag.snapshot()
+    frag._flip_disk_byte(10)
+    return frag
+
+
+def test_scrub_quarantine_503_and_repair_from_replica(tmp_path):
+    from tests.test_cluster import boot_static_cluster, req
+
+    servers = boot_static_cluster(tmp_path, n=2, replicas=2)
+    try:
+        uri = servers[0].uri
+        assert req(uri, "POST", "/index/i", {})[0] == 200
+        assert req(uri, "POST", "/index/i/field/f", {})[0] == 200
+        for col in range(0, 120, 3):
+            st, _ = req(uri, "POST", "/index/i/query", f"Set({col}, f=7)".encode())
+            assert st == 200
+        for s in servers:
+            frag = s.holder.fragment("i", "f", "standard", 0)
+            with frag.mu:
+                frag.snapshot()
+
+        _flip_frag(servers[0])
+        # detect-only sweep (repair suppressed): quarantines and stays
+        st, body = req(uri, "POST", "/debug/scrub", {"repair": False})
+        assert st == 200 and body["corrupt"] == 1 and body["repaired"] == 0
+        frag = servers[0].holder.fragment("i", "f", "standard", 0)
+        assert frag.quarantined
+
+        # with a healthy replica the cluster keeps answering — and the
+        # answer must be RIGHT (node 1's copy), never node 0's poison
+        st, body = req(uri, "POST", "/index/i/query", b"Row(f=7)")
+        if st == 200:
+            assert body["results"][0]["columns"] == list(range(0, 120, 3))
+        else:
+            assert st == 503
+
+        # /status surfaces the quarantine
+        st, body = req(uri, "GET", "/status")
+        q = body["integrity"]["quarantined"]
+        assert q and q[0]["shard"] == 0 and "mismatch" in q[0]["reason"]
+
+        # repairing sweep pulls the healthy replica copy from node 1
+        st, body = req(uri, "POST", "/debug/scrub", {})
+        assert st == 200 and body["repaired"] == 1, body
+        frag = servers[0].holder.fragment("i", "f", "standard", 0)
+        assert not frag.quarantined
+        assert frag.verify_integrity(deep=True) is None
+        st, body = req(uri, "POST", "/index/i/query", b"Row(f=7)")
+        assert st == 200
+        assert body["results"][0]["columns"] == list(range(0, 120, 3))
+
+        # stats surface
+        st, body = req(uri, "GET", "/debug/scrub")
+        assert st == 200 and body["sweeps"] >= 2 and body["repairs"] >= 1
+        assert body["unrecoverable"] == []
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_scrub_unrecoverable_without_healthy_replica(tmp_path):
+    from tests.test_cluster import boot_static_cluster, req
+
+    servers = boot_static_cluster(tmp_path, n=1, replicas=1)
+    try:
+        uri = servers[0].uri
+        assert req(uri, "POST", "/index/i", {})[0] == 200
+        assert req(uri, "POST", "/index/i/field/f", {})[0] == 200
+        assert req(uri, "POST", "/index/i/query", b"Set(3, f=1)")[0] == 200
+        _flip_frag(servers[0])
+        seq0 = events.JOURNAL._seq
+        st, body = req(uri, "POST", "/debug/scrub", {})
+        assert st == 200 and body["unrecoverable"] == 1, body
+        # no replica to fail over to: reads 503 + Retry-After, never
+        # garbage (the quarantine's whole contract)
+        r = urllib.request.Request(
+            uri + "/index/i/query", data=b"Row(f=1)", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(r, timeout=30)
+        assert ei.value.code == 503
+        assert int(ei.value.headers["Retry-After"]) >= 1
+        st, body = req(uri, "GET", "/status")
+        unrec = body["integrity"]["unrecoverable"]
+        assert unrec and unrec[0]["index"] == "i"
+        assert events.snapshot(
+            kind=events.SCRUB_UNRECOVERABLE, since_seq=seq0
+        )
+    finally:
+        for s in servers:
+            s.close()
+
+
+# -- verify-before-apply fragment transfer -----------------------------------
+
+
+def _tamper_tar_member(archive: bytes, prefix: str) -> bytes:
+    """Flip a byte inside the payload of the first member whose name
+    starts with ``prefix`` (a flip at an arbitrary offset can land in
+    tar padding and change nothing)."""
+    with tarfile.open(fileobj=io.BytesIO(archive)) as tr:
+        off = next(
+            m.offset_data
+            for m in tr.getmembers()
+            if m.name.startswith(prefix) and m.size > 0
+        )
+    bad = bytearray(archive)
+    bad[off] ^= 0x01
+    return bytes(bad)
+
+
+def test_unmarshal_fragment_refuses_tampered_archive(tmp_path):
+    from tests.test_cluster import boot_static_cluster, req
+
+    servers = boot_static_cluster(tmp_path, n=1, replicas=1)
+    try:
+        uri = servers[0].uri
+        assert req(uri, "POST", "/index/i", {})[0] == 200
+        assert req(uri, "POST", "/index/i/field/f", {})[0] == 200
+        assert req(uri, "POST", "/index/i/query", b"Set(8, f=2)")[0] == 200
+        path = "/internal/fragment/data?index=i&field=f&view=standard&shard=0"
+        st, archive = req(uri, "GET", path, raw=True)
+        assert st == 200
+        st, body = req(uri, "POST", path, _tamper_tar_member(archive, "data"))
+        assert st == 400, body
+        # the fragment is untouched: still serving the original bits
+        st, body = req(uri, "POST", "/index/i/query", b"Row(f=2)")
+        assert st == 200 and body["results"][0]["columns"] == [8]
+        # the pristine archive still applies
+        assert req(uri, "POST", path, archive)[0] == 200
+    finally:
+        for s in servers:
+            s.close()
+
+
+# -- holder backup / restore -------------------------------------------------
+
+
+def test_backup_restore_roundtrip_and_tamper_refusal(tmp_path):
+    from tests.test_cluster import boot_static_cluster, req
+
+    servers = boot_static_cluster(tmp_path, n=1, replicas=1)
+    try:
+        uri = servers[0].uri
+        assert req(uri, "POST", "/index/i", {})[0] == 200
+        assert req(uri, "POST", "/index/i/field/f", {})[0] == 200
+        cols = list(range(0, 90, 9))
+        for c in cols:
+            assert req(uri, "POST", "/index/i/query", f"Set({c}, f=4)".encode())[0] == 200
+
+        st, archive = req(uri, "GET", "/backup", raw=True)
+        assert st == 200
+        with tarfile.open(fileobj=io.BytesIO(archive)) as tr:
+            names = tr.getnames()
+        assert names[0] == "MANIFEST.json"  # manifest leads the stream
+        assert "schema.json" in names
+        assert any(n.startswith("fragments/i/f/") for n in names)
+
+        # tampered: refused with 400 + journal, nothing applied
+        seq0 = events.JOURNAL._seq
+        st, body = req(uri, "POST", "/restore", _tamper_tar_member(archive, "fragments/"))
+        assert st == 400 and "restore refused" in body["error"], body
+        assert events.snapshot(kind=events.RESTORE_REFUSED, since_seq=seq0)
+        st, body = req(uri, "POST", "/index/i/query", b"Row(f=4)")
+        assert body["results"][0]["columns"] == cols
+
+        # wipe → restore: every bit comes back
+        assert req(uri, "DELETE", "/index/i")[0] == 200
+        st, body = req(uri, "POST", "/restore", archive)
+        assert st == 200 and body["fragments"] >= 1, body
+        st, body = req(uri, "POST", "/index/i/query", b"Row(f=4)")
+        assert st == 200 and body["results"][0]["columns"] == cols
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_backup_restore_cli_roundtrip(tmp_path):
+    from tests.test_cluster import boot_static_cluster, req
+
+    servers = boot_static_cluster(tmp_path, n=1, replicas=1)
+    try:
+        uri = servers[0].uri
+        host = servers[0].config.bind
+        assert req(uri, "POST", "/index/i", {})[0] == 200
+        assert req(uri, "POST", "/index/i/field/f", {})[0] == 200
+        assert req(uri, "POST", "/index/i/query", b"Set(44, f=6)")[0] == 200
+
+        out = str(tmp_path / "holder.tar")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "pilosa_tpu", "backup", "--host", host, "-o", out],
+            capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+        )
+        assert r.returncode == 0 and os.path.getsize(out) > 0, r.stderr
+
+        bad = str(tmp_path / "tampered.tar")
+        open(bad, "wb").write(
+            _tamper_tar_member(open(out, "rb").read(), "fragments/")
+        )
+        r = subprocess.run(
+            [sys.executable, "-m", "pilosa_tpu", "restore", "--host", host, bad],
+            capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+        )
+        assert r.returncode == 1 and "REFUSED" in r.stderr, (r.stdout, r.stderr)
+
+        r = subprocess.run(
+            [sys.executable, "-m", "pilosa_tpu", "restore", "--host", host, out],
+            capture_output=True, text=True, timeout=120, cwd=REPO, env=env,
+        )
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        st, body = req(uri, "POST", "/index/i/query", b"Row(f=6)")
+        assert st == 200 and body["results"][0]["columns"] == [44]
+    finally:
+        for s in servers:
+            s.close()
+
+
+# -- anti-entropy failure accounting -----------------------------------------
+
+
+def test_anti_entropy_error_counted_and_journaled(tmp_path):
+    ports_mod = __import__("tests.test_cluster", fromlist=["free_ports"])
+    port = ports_mod.free_ports(1)[0]
+    host = f"127.0.0.1:{port}"
+    cfg = Config(
+        data_dir=str(tmp_path / "n0"),
+        bind=host,
+        device_policy="never",
+        metric="expvar",
+        anti_entropy_interval=0.05,
+        cluster=ClusterConfig(
+            disabled=False, coordinator=True, replicas=1, hosts=[host]
+        ),
+    )
+    s = Server(cfg)
+    s.open()
+    try:
+        seq0 = events.JOURNAL._seq
+
+        def boom():
+            raise RuntimeError("peer sync exploded")
+
+        s.cluster.sync_holder = boom
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if events.snapshot(kind=events.ANTI_ENTROPY_ERROR, since_seq=seq0):
+                break
+            time.sleep(0.05)
+        evs = events.snapshot(kind=events.ANTI_ENTROPY_ERROR, since_seq=seq0)
+        assert evs and "exploded" in evs[-1]["error"]
+        assert s._expvar._root.get(metrics.ANTI_ENTROPY_ERRORS, 0) >= 1
+    finally:
+        s.close()
