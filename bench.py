@@ -1308,6 +1308,19 @@ def main():
                 snapshot["metrics"] = _metrics.snapshot()
             except Exception:
                 pass
+            # workload heat + placement skew (ISSUE 16): top-K hot
+            # shards and imbalance ratio, the baseline curve future
+            # tiering/rebalancing PRs compare against
+            try:
+                from pilosa_tpu.utils import heat as _heat
+
+                hs = _heat.snapshot(dim="reads")
+                snapshot["heat"] = {
+                    "cells": len(hs["cells"]),
+                    "skew": hs["skew"],
+                }
+            except Exception:
+                pass
             # a result without a measured headline must never be
             # persisted over the last COMPLETE measurement
             if not final or snapshot.get("value", 0.0) == 0.0:

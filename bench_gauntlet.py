@@ -832,6 +832,14 @@ def main():
         gauntlet_metrics = _metrics.snapshot()
     except Exception:
         gauntlet_metrics = {}
+    # heat + placement-skew snapshot riding the artifact (ISSUE 16)
+    try:
+        from pilosa_tpu.utils import heat as _heat
+
+        _hs = _heat.snapshot(dim="reads")
+        gauntlet_heat = {"cells": len(_hs["cells"]), "skew": _hs["skew"]}
+    except Exception:
+        gauntlet_heat = {}
     print(
         json.dumps(
             {
@@ -839,6 +847,7 @@ def main():
                 "all_bit_identical": all_ok,
                 "wall_s": round(time.time() - t0, 1),
                 "metrics": gauntlet_metrics,
+                "heat": gauntlet_heat,
             }
         )
     )

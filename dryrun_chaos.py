@@ -363,9 +363,10 @@ def _events(port: int, kind: str, since: int = 0) -> list:
 
 
 def _journal_seq(port: int) -> int:
-    """Newest journal seq — the per-window watermark. The journal is a
-    bounded ring (256), so cumulative end-of-run counts under-read any
-    busy soak; every window samples its own delta instead."""
+    """Newest journal seq — the per-window watermark. The journal is
+    durable (segmented on-disk backing, ISSUE 16), so each window's
+    events are counted AFTER its soak with ``since=<watermark>`` — no
+    more sampling before load to beat ring eviction."""
     _, body = _http(port, "GET", "/debug/events?limit=1")
     ev = json.loads(body).get("events", [])
     return ev[-1]["seq"] if ev else 0
@@ -414,9 +415,6 @@ def _window_phase(port: int, quick: bool, result: dict) -> list:
             json.dumps({"storage": w["storage"], "device": w["device"]}).encode(),
         )
         assert st == 200, (st, body[:200])
-        # sample the install transition NOW — a busy window floods the
-        # bounded journal ring and would evict it before window end
-        installed_ev = len(_events(port, "chaos.window", seq0))
 
         scrub_res = None
         if bitrot:
@@ -442,20 +440,6 @@ def _window_phase(port: int, quick: bool, result: dict) -> list:
                 json.dumps({"storage": "", "device": w["device"]}).encode(),
             )
             assert st == 200
-            # sample the rot events NOW, like the install transition
-            # above: the detection sweeps ran before the load, and a
-            # busy window floods the bounded journal ring, evicting
-            # them before the window-end count
-            rot_ev = {
-                k: len(_events(port, kind, seq0))
-                for k, kind in (
-                    ("ingest_fault", "ingest.fault"),
-                    ("scrub_corruption", "scrub.corruption"),
-                    ("scrub_quarantine", "scrub.quarantine"),
-                )
-            }
-        else:
-            rot_ev = {}
 
         writers = [Writer(wid + k, port) for k in range(n_writers)]
         wid += n_writers
@@ -469,8 +453,12 @@ def _window_phase(port: int, quick: bool, result: dict) -> list:
             t.thread.join(timeout=30)
         all_writers.extend(writers)
 
-        # clear the window, then quiesce-verify this window's writes
-        seq1 = _journal_seq(port)
+        # clear the window, then count this window's journal events
+        # AFTER the soak — the durable backing pages past any ring
+        # eviction, which is exactly what the before-load sampling
+        # workaround existed to dodge
+        st, _ = _http(port, "POST", "/debug/chaos", b"{}")
+        assert st == 200
         fault_ev = {
             "ingest_fault": len(_events(port, "ingest.fault", seq0)),
             "device_oom": len(_events(port, "device.oom", seq0)),
@@ -480,11 +468,7 @@ def _window_phase(port: int, quick: bool, result: dict) -> list:
             "scrub_corruption": len(_events(port, "scrub.corruption", seq0)),
             "scrub_quarantine": len(_events(port, "scrub.quarantine", seq0)),
         }
-        for k, v in rot_ev.items():
-            fault_ev[k] = max(fault_ev[k], v)
-        st, _ = _http(port, "POST", "/debug/chaos", b"{}")
-        assert st == 200
-        cleared_ev = len(_events(port, "chaos.window", seq1))
+        chaos_ev = len(_events(port, "chaos.window", seq0))
         oracle = _oracle_rows(writers)
         unknown: dict[int, set] = {}
         for x in writers:
@@ -496,7 +480,7 @@ def _window_phase(port: int, quick: bool, result: dict) -> list:
             skip = unknown.get(r, set())
             if got - skip != want - skip:
                 mismatches.append(r)
-        journal = {"chaos_window": installed_ev + cleared_ev, **fault_ev}
+        journal = {"chaos_window": chaos_ev, **fault_ev}
         wres = {
             "name": w["name"],
             "storage": w["storage"],

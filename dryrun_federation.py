@@ -450,6 +450,78 @@ def parent(quick: bool) -> int:
                 "fleet_scrape_all_ranks": fleet_ok,
             }
 
+            # -- phase 1.6: federated workload heat (ISSUE 16) ------------
+            # a deliberately skewed index: 40 bits land in shard 0, 10
+            # in shard 1 (SHARD_WIDTH apart). Write heat is recorded
+            # once per applying rank, and the replication x gang-replay
+            # multiplier is IDENTICAL for both shards (replicas=2 over
+            # both nodes), so the fleet-merged ``writes`` dimension must
+            # reproduce the 4:1 ratio and imbalance_ratio
+            # max/mean = 40/25 = 1.6 exactly — a hand-computed
+            # placement-skew oracle on raw integer counters.
+            SW = 1 << 20  # pilosa_tpu.SHARD_WIDTH
+            n0, n1 = 40, 10
+            st, _ = _http(http_a, "POST", "/index/hx", b"")
+            assert st in (200, 409), st
+            st, _ = _http(http_a, "POST", "/index/hx/field/hf", b"")
+            assert st in (200, 409), st
+            hsets = [f"Set({c}, hf=1)" for c in range(n0)]
+            hsets += [f"Set({SW + c}, hf=1)" for c in range(n1)]
+            st, body = _http(
+                http_a, "POST", "/index/hx/query", " ".join(hsets).encode(), timeout=120
+            )
+            assert st == 200, (st, body[:300])
+            # read heat on both shards; cache=false so the plan cache
+            # can't short-circuit the executor's per-shard map legs
+            for _ in range(3):
+                _http(
+                    http_a,
+                    "POST",
+                    "/index/hx/query?cache=false",
+                    b"Count(Row(hf=1))",
+                    timeout=120,
+                )
+            heat_ok = False
+            hx: dict = {}
+            w0 = w1 = 0
+            t_end = time.monotonic() + 30
+            while time.monotonic() < t_end:
+                st, body = _http(
+                    http_a, "GET", "/debug/heat?fleet=true&dim=writes&index=hx"
+                )
+                if st == 200:
+                    hx = json.loads(body)
+                    by_shard: dict = {}
+                    reads_by_shard: dict = {}
+                    for c in hx.get("cells") or []:
+                        by_shard[c["shard"]] = by_shard.get(c["shard"], 0) + c["writes"]
+                        reads_by_shard[c["shard"]] = (
+                            reads_by_shard.get(c["shard"], 0) + c["reads"]
+                        )
+                    w0, w1 = by_shard.get(0, 0), by_shard.get(1, 0)
+                    skew = hx.get("skew") or {}
+                    top = skew.get("top") or [{}]
+                    if (
+                        w1 > 0
+                        and w0 == 4 * w1  # replication multiplier cancels
+                        and skew.get("imbalance_ratio") == 1.6
+                        and (top[0].get("index"), top[0].get("shard")) == ("hx", 0)
+                        and len(hx.get("instances") or []) >= 4
+                        and reads_by_shard.get(0, 0) > 0
+                        and reads_by_shard.get(1, 0) > 0
+                    ):
+                        heat_ok = True
+                        break
+                time.sleep(0.5)
+            ok &= heat_ok
+            summary["heat"] = {
+                "ok": heat_ok,
+                "oracle": {"writes_ratio": 4.0, "imbalance_ratio": 1.6},
+                "merged_writes": {"shard0": w0, "shard1": w1},
+                "instances": hx.get("instances"),
+                "skew": hx.get("skew"),
+            }
+
             # -- phase 2: follower SIGKILL -> bounded fence + DEGRADED ----
             t_kill = time.monotonic()
             procs["A1"].kill()

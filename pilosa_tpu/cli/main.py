@@ -213,7 +213,32 @@ def main(argv=None) -> int:
         default=0,
         help="only events with a sequence number above this",
     )
+    p.add_argument(
+        "--follow",
+        action="store_true",
+        help="tail the journal live: print new events as JSONL, polling "
+        "from the last seen seq (Ctrl-C to stop)",
+    )
+    p.add_argument(
+        "--interval",
+        type=float,
+        default=1.0,
+        help="poll interval in seconds for --follow",
+    )
     p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser(
+        "debug-bundle",
+        help="capture a node's forensics bundle (/debug/bundle) to a tar: "
+        "config, status, metrics, traces, events tail, heat snapshot, "
+        "governor/dispatch/fusion stats",
+    )
+    p.add_argument("--host", default="http://localhost:10101")
+    p.add_argument(
+        "-o", "--output", default="pilosa-debug-bundle.tar",
+        help="output tar path",
+    )
+    p.set_defaults(fn=cmd_debug_bundle)
 
     p = sub.add_parser("config", help="print the effective configuration")
     p.add_argument("-c", "--config", help="TOML config file")
@@ -698,16 +723,49 @@ def cmd_metrics(args) -> int:
 def cmd_events(args) -> int:
     """Dump a node's lifecycle event journal: gang state transitions,
     degrades, re-formations, and retry exhaustions, each stamped with
-    seq/time/trace/gang/rank/epoch."""
+    seq/time/trace/gang/rank/epoch. ``--follow`` tails the journal
+    live, paging from the durable backing via ``since=<last seq>``."""
     host = args.host if args.host.startswith("http") else f"http://{args.host}"
-    query = []
-    if args.kind:
-        query.append(f"kind={urllib.parse.quote(args.kind)}")
-    if args.since:
-        query.append(f"since={args.since}")
-    path = "/debug/events" + (("?" + "&".join(query)) if query else "")
-    with urllib.request.urlopen(host + path, timeout=60) as resp:
-        print(json.dumps(json.loads(resp.read().decode()), indent=2))
+
+    def fetch(since: int) -> list:
+        query = []
+        if args.kind:
+            query.append(f"kind={urllib.parse.quote(args.kind)}")
+        if since:
+            query.append(f"since={since}")
+        path = "/debug/events" + (("?" + "&".join(query)) if query else "")
+        with urllib.request.urlopen(host + path, timeout=60) as resp:
+            return json.loads(resp.read().decode()).get("events", [])
+
+    if not getattr(args, "follow", False):
+        evs = fetch(args.since)
+        print(json.dumps({"events": evs}, indent=2))
+        return 0
+    since = args.since
+    try:
+        while True:
+            for ev in fetch(since):
+                print(json.dumps(ev, separators=(",", ":")), flush=True)
+                if ev.get("seq", 0) > since:
+                    since = ev["seq"]
+            time.sleep(max(0.05, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_debug_bundle(args) -> int:
+    """Stream GET /debug/bundle to a tar file — everything an incident
+    writeup needs from a live (or about-to-die) node in one capture."""
+    host = args.host if args.host.startswith("http") else f"http://{args.host}"
+    host = host.rstrip("/")
+    r = urllib.request.Request(host + "/debug/bundle", method="GET")
+    with urllib.request.urlopen(r, timeout=120) as resp:
+        data = resp.read()
+    with open(args.output, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    print(f"debug-bundle: wrote {len(data)} bytes to {args.output}")
     return 0
 
 

@@ -62,7 +62,7 @@ from typing import Any, Optional
 
 from pilosa_tpu.analysis.locks import OrderedLock
 from pilosa_tpu.pql import Query
-from pilosa_tpu.utils import metrics, trace
+from pilosa_tpu.utils import heat, metrics, trace
 
 # Request-deadline seam (server/deadline.py), imported lazily for the
 # same L4→L6 layering reason as executor.py.
@@ -337,6 +337,13 @@ class DispatchEngine:
                     it.finish(error=_deadline().DeadlineExceeded("dispatch"))
                     continue
                 live.append(it)
+            if heat.LEDGER.enabled:
+                # wave-membership heat: one count per (index, shard)
+                # admitted into this wave (fused launches ride the same
+                # membership — a deduped item still occupied a slot)
+                for it in live:
+                    for s in it.shards or ():
+                        heat.record_wave(it.index, "", s)
             groups: dict[tuple, list[_Item]] = {}
             for it in live:
                 o = it.opt

@@ -28,7 +28,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from pilosa_tpu.utils import chaos, metrics, profiler, trace
+from pilosa_tpu.utils import chaos, heat, metrics, profiler, trace
 
 from pilosa_tpu import SHARD_WIDTH, ops
 from pilosa_tpu.core import Row, TopOptions, VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD
@@ -989,9 +989,22 @@ class Executor:
         parent = trace.current()
         dl = _deadline().current()
         attrib = trace.attrib_current()  # same single-capture discipline
+        # heat ledger read hook, captured once per query like the tracer:
+        # the per-shard body pays one is-not-None branch when disabled
+        if heat.LEDGER.enabled:
+            _heat_read = heat.LEDGER.record_read
+            try:
+                _heat_field = c.field_arg()
+            except (ValueError, AttributeError):
+                _heat_field = ""
+        else:
+            _heat_read = None
+            _heat_field = ""
         for shard in shards:
             if dl is not None:
                 dl.check(metrics.STAGE_MAP_SHARD)
+            if _heat_read is not None:
+                _heat_read(index, _heat_field, shard)
             if parent is not None:
                 with parent.child(metrics.STAGE_MAP_SHARD, shard=shard):
                     v = map_fn(shard)
@@ -1008,6 +1021,21 @@ class Executor:
                     time.monotonic() - t0r
                 )
         return result
+
+    def _heat_read_legs(self, index, c, shards) -> None:
+        """Shard-batched device launches (Count/Sum/TopN stacks, fused
+        whole-query reads) bypass ``_map_reduce``'s per-shard loop, so
+        their read legs land here — one per shard in the stack, same
+        accounting as the serial path."""
+        if not heat.LEDGER.enabled or not shards:
+            return
+        try:
+            field = c.field_arg()
+        except (ValueError, AttributeError):
+            field = ""
+        rec = heat.LEDGER.record_read
+        for s in shards:
+            rec(index, field, s)
 
     # -- bitmap calls ---------------------------------------------------------
 
@@ -1701,7 +1729,9 @@ class Executor:
         ):
             try:
                 with trace.child(metrics.STAGE_DEVICE_BATCH, call="Count"):
-                    return self._count_device_batched(index, child, shards)
+                    n = self._count_device_batched(index, child, shards)
+                self._heat_read_legs(index, child, shards)
+                return n
             except _NotDeviceable:
                 pass
 
@@ -1804,9 +1834,11 @@ class Executor:
                 if any(frags):
                     try:
                         with trace.child(metrics.STAGE_DEVICE_BATCH, call="Sum"):
-                            return self._sum_device_batched(
+                            vc = self._sum_device_batched(
                                 index, c, batch, bsig, frags
                             )
+                        self._heat_read_legs(index, c, shards)
+                        return vc
                     except _NotDeviceable:
                         pass
 
@@ -1967,14 +1999,13 @@ class Executor:
             try:
                 with trace.child(metrics.STAGE_DEVICE_BATCH, call="TopN"):
                     if self.mesh is not None:
-                        return sort_pairs(
-                            self._topn_shards_spmd(index, c, shards, carry)
-                        )
-                    return sort_pairs(
-                        self._topn_shards_batched(
+                        pairs = self._topn_shards_spmd(index, c, shards, carry)
+                    else:
+                        pairs = self._topn_shards_batched(
                             index, c, shards, carry, prescored=prescored
                         )
-                    )
+                self._heat_read_legs(index, c, shards)
+                return sort_pairs(pairs)
             except _NotDeviceable:
                 pass
 
@@ -2211,6 +2242,9 @@ class Executor:
             timestamp = datetime.strptime(ts_str, TIME_FORMAT)
         if self.cluster is not None and not opt.remote:
             return self.cluster.set_bit(index, c, f, row_id, col_id, timestamp, opt)
+        # local apply leg: every rank that lands the bit (direct,
+        # remote-leg, or gang replay) records the write exactly once
+        heat.record_write(index, field_name, col_id // SHARD_WIDTH, 1)
         return f.set_bit(row_id, col_id, timestamp)
 
     def _execute_clear_bit(self, index, c: Call, opt) -> bool:
@@ -2226,6 +2260,7 @@ class Executor:
             raise ValueError("Clear() col argument required")
         if self.cluster is not None and not opt.remote:
             return self.cluster.clear_bit(index, c, f, row_id, col_id, opt)
+        heat.record_write(index, field_name, col_id // SHARD_WIDTH, 1)
         return f.clear_bit(row_id, col_id)
 
     def _gang_forward_write(self, index, c: Call, opt) -> bool:

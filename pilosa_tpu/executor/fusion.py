@@ -203,8 +203,14 @@ class QueryFuser:
             served = ex.health.guard(fused)
         else:
             served = fused()
+        bycall = dict(lower)
         for i, result, cost in served:
             out[i] = result
+            # calls served by the fused launch never enter _map_reduce;
+            # account their per-shard read legs here (cache hits above
+            # short-circuit before the classic path records, so they
+            # stay unrecorded on both routes)
+            ex._heat_read_legs(index, bycall[i], shards)
             info = cacheinfo.get(i)
             if info is not None and pc is not None:
                 key, genvec, epoch0 = info

@@ -51,7 +51,7 @@ import numpy as np
 
 from pilosa_tpu import SHARD_WIDTH, ops
 from pilosa_tpu.analysis.locks import OrderedLock
-from pilosa_tpu.utils import metrics, trace
+from pilosa_tpu.utils import heat, metrics, trace
 
 _W32 = SHARD_WIDTH // 32  # u32 words per staged row
 
@@ -154,12 +154,29 @@ class DeviceStager:
         # track their snapshot generation in _Entry.gen instead
         return (id(frag), kind) + tuple(extra)
 
+    @staticmethod
+    def _heat_stage(frag, nbytes: int, hit: bool) -> None:
+        """Attribute a stager hit/miss to the heat ledger. ``frag`` is a
+        fragment, a list of fragments (stacked forms — the uploaded
+        bytes are split evenly across live members), or None (untracked
+        internal entries)."""
+        if frag is None or not heat.LEDGER.enabled:
+            return
+        frags = frag if isinstance(frag, (list, tuple)) else (frag,)
+        live = [f for f in frags if f is not None]
+        if not live:
+            return
+        per = 0 if hit else int(nbytes) // len(live)
+        for f in live:
+            heat.LEDGER.record_stage(f.index, f.field, f.shard, per, hit)
+
     def _get_or_build(
         self,
         key,
         gen,
         builder: Callable,
         delta_fn: Optional[Callable] = None,
+        frag=None,
     ):
         """Return the staged value for ``key``, fresh w.r.t. the
         caller-observed generation token ``gen``.
@@ -180,6 +197,7 @@ class DeviceStager:
                     self._cache.move_to_end(key)
                     self.hits += 1
                     metrics.count(metrics.STAGER_HITS)
+                    self._heat_stage(frag, 0, True)
                     return ent.value
                 epoch = self._epoch
                 fl = self._inflight.get(key)
@@ -240,6 +258,7 @@ class DeviceStager:
                     )
                     trace.attrib_add(trace.WF_STAGER, time.monotonic() - t0)
                     metrics.count(metrics.STAGER_MISSES)
+                    self._heat_stage(frag, nbytes, False)
                     if stale is None:
                         metrics.count(metrics.STAGER_MISSES_COLD)
                     else:
@@ -401,6 +420,7 @@ class DeviceStager:
             frag.generation,
             build,
             delta,
+            frag=frag,
         )
 
     def _delta_for_slots(self, frag, slot_of: dict, n_rows_staged: int):
@@ -462,6 +482,7 @@ class DeviceStager:
             frag.generation,
             build,
             self._delta_for_slots(frag, slot_of, nrows),
+            frag=frag,
         )
 
     def sparse_rows(self, frag, row_ids: tuple[int, ...]):
@@ -502,6 +523,7 @@ class DeviceStager:
             frag.generation,
             build,
             self._sparse_fallback,
+            frag=frag,
         )
 
     def _sparse_fallback(self, old, old_gen):
@@ -564,7 +586,7 @@ class DeviceStager:
             return (ids, new_dev), gen, n
 
         return self._get_or_build(
-            self._key(frag, "matrix"), frag.generation, build, delta
+            self._key(frag, "matrix"), frag.generation, build, delta, frag=frag
         )
 
     def planes(self, frag, bit_depth: int):
@@ -583,6 +605,7 @@ class DeviceStager:
             frag.generation,
             build,
             self._delta_for_slots(frag, slot_of, bit_depth + 1),
+            frag=frag,
         )
 
     # -- shard-batched staging (one array covering many fragments) ----------
@@ -669,6 +692,7 @@ class DeviceStager:
             self._stack_gen(frags),
             build,
             delta,
+            frag=frags,
         )
 
     def sparse_rows_stacked(
@@ -727,6 +751,7 @@ class DeviceStager:
             self._stack_gen(frags),
             build,
             self._sparse_fallback,
+            frag=frags,
         )
 
     def sparse_rows_stack(
@@ -791,6 +816,7 @@ class DeviceStager:
             self._stack_gen(frags),
             build,
             self._sparse_fallback,
+            frag=frags,
         )
 
     def planes_stack(self, frags, bit_depth: int):
@@ -816,6 +842,7 @@ class DeviceStager:
             self._stack_gen(frags),
             build,
             delta,
+            frag=frags,
         )
 
     def stage_ahead(self, thunk) -> None:

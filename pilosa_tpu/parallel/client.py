@@ -443,6 +443,12 @@ class InternalClient:
         resp = self._request("GET", uri, "/internal/fleet/snapshots")
         return resp.get("snapshots", [])
 
+    def fleet_heat(self, uri: str) -> list:
+        """One member's gang-local ``[[label, heat-snapshot], ...]``
+        list — the heat-ledger leg of the fleet telemetry plane."""
+        resp = self._request("GET", uri, "/internal/fleet/heat")
+        return resp.get("heat", [])
+
     def gang_rejoin(self, uri: str, follower_uri: str) -> dict:
         """Announce a re-staged follower to its gang leader; the leader
         re-forms the gang around it and returns the new epoch."""

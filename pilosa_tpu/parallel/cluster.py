@@ -30,7 +30,7 @@ from pilosa_tpu.parallel.client import ClientError, InternalClient
 from pilosa_tpu.parallel.hashing import DEFAULT_PARTITION_N, Jmphasher, partition
 from pilosa_tpu.parallel.multihost import GangUnavailable
 from pilosa_tpu.parallel.node import Node
-from pilosa_tpu.utils import metrics, trace
+from pilosa_tpu.utils import heat, metrics, trace
 from pilosa_tpu.utils.errors import NotFoundError
 from pilosa_tpu.parallel.wire import pairs_to_tuples
 
@@ -927,8 +927,13 @@ class Cluster:
                     res = self.local_executor(index, c, None, opt)
                     if res is True:
                         ret = True
-                elif local_fn():
-                    ret = True
+                else:
+                    # direct local apply (no gang to replay through):
+                    # the heat write hook fires here, mirroring the
+                    # executor's local-apply leg
+                    heat.record_write(index, getattr(field, "name", ""), shard, 1)
+                    if local_fn():
+                        ret = True
             elif not opt.remote:
                 res = self.client.query_node(
                     node.uri,

@@ -21,7 +21,7 @@ from pilosa_tpu.core.view import VIEW_STANDARD
 from pilosa_tpu.executor import ExecOptions
 from pilosa_tpu.pql import parse
 from pilosa_tpu.server import deadline, pipeline
-from pilosa_tpu.utils import events, metrics, profiler, trace
+from pilosa_tpu.utils import events, heat, metrics, profiler, trace
 
 # cluster states (reference cluster.go:42-45)
 STATE_STARTING = "STARTING"
@@ -488,6 +488,10 @@ class API:
                 [int(column_ids[i]) for i in idxs],
                 [bool(flags[i]) for i in idxs],
             )
+            # heat write hook lives in the local-apply leg, so gang
+            # replay (every rank re-enters here with dispatch false)
+            # records the wave exactly once per rank
+            heat.record_write(index, field, shard, len(idxs))
         return changed
 
     def import_values(

@@ -288,6 +288,28 @@ class Config:
     # burn-rate alert threshold (fires when BOTH windows exceed it);
     # 14.4 = the SRE-workbook fast-burn page (budget gone in ~2 days)
     slo_burn_threshold: float = 14.4
+    # workload heat ledger (utils/heat.py): per-(index, field, shard)
+    # read/write/staging accounting behind GET /debug/heat; the hooks
+    # collapse to one branch per shard when disabled
+    heat_enabled: bool = True
+    # EWMA half-life (seconds) for the per-cell heat score decay
+    heat_decay_halflife: float = 300.0
+    # durable event journal (utils/events.py): directory for the
+    # segmented append-only backing; "" defaults to <data-dir>/.events
+    # when journal-max-bytes > 0
+    journal_dir: str = ""
+    # on-disk retention budget in bytes across journal segments;
+    # 0 disables the durable backing (in-memory ring only)
+    journal_max_bytes: int = 8 << 20
+    # telemetry export pipeline (utils/telemetry_export.py): JSONL file
+    # sink path and/or OTLP-compatible HTTP/JSON endpoint URL; both
+    # empty = exporter not started (zero hot-path cost)
+    export_path: str = ""
+    export_url: str = ""
+    # background flush interval (seconds) and bounded queue depth; a
+    # full queue DROPS (counted) rather than blocking producers
+    export_interval: float = 5.0
+    export_queue: int = 1024
     # opt-in diagnostics phone-home endpoint (reference diagnostics.go);
     # empty = disabled
     diagnostics_host: str = ""
@@ -407,6 +429,14 @@ class Config:
             f"hbm-watermark-pct = {self.hbm_watermark_pct}",
             f'slo-objectives = "{self.slo_objectives}"',
             f"slo-burn-threshold = {self.slo_burn_threshold}",
+            f"heat-enabled = {'true' if self.heat_enabled else 'false'}",
+            f"heat-decay-halflife = {self.heat_decay_halflife}",
+            f'journal-dir = "{self.journal_dir}"',
+            f"journal-max-bytes = {self.journal_max_bytes}",
+            f'export-path = "{self.export_path}"',
+            f'export-url = "{self.export_url}"',
+            f"export-interval = {self.export_interval}",
+            f"export-queue = {self.export_queue}",
             "",
             "[cluster]",
             f"disabled = {'true' if self.cluster.disabled else 'false'}",

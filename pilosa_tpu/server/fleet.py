@@ -129,6 +129,55 @@ class FleetCollector:
             out.append((label, snap))
         return out
 
+    # -- workload heat (ISSUE 16) -------------------------------------------
+
+    def _pull_heat(self, uri: str) -> list:
+        """One member's gang-local heat list; same per-member failure
+        isolation (and fleet.scrapes accounting) as the metric pull."""
+        try:
+            out = self._get_client().fleet_heat(uri)
+            metrics.count(metrics.FLEET_SCRAPES, outcome="ok")
+            with self._mu:
+                self._pulls[uri] = {"ok": True, "error": "", "t": time.time()}
+            return out
+        except Exception as e:
+            metrics.count(metrics.FLEET_SCRAPES, outcome="error")
+            with self._mu:
+                self._pulls[uri] = {"ok": False, "error": str(e), "t": time.time()}
+            return []
+
+    def gang_heat(self) -> list:
+        """``[[label, heat-snapshot], ...]`` for this process and every
+        member registered here. Raw counters only (dim-agnostic): the
+        aggregating caller picks the ranking dimension."""
+        from pilosa_tpu.utils import heat
+
+        out = [[self.local_label(), heat.snapshot()]]
+        for m in self.members():
+            out.extend(self._pull_heat(m["uri"]))
+        return out
+
+    def collect_heat(self) -> list:
+        """Fleet-wide ``[(label, heat-snapshot), ...]`` — this gang plus
+        one pull per peer gang leader, deduped by instance label."""
+        pairs = list(self.gang_heat())
+        cluster = getattr(self.server, "cluster", None)
+        if cluster is not None:
+            for node in cluster._other_nodes():
+                pairs.extend(self._pull_heat(node.uri))
+        seen: set = set()
+        out = []
+        for pair in pairs:
+            try:
+                label, snap = pair[0], pair[1]
+            except (IndexError, TypeError):
+                continue
+            if label in seen or not isinstance(snap, dict):
+                continue
+            seen.add(label)
+            out.append((label, snap))
+        return out
+
     def debug(self) -> dict:
         with self._mu:
             pulls = {u: dict(p) for u, p in self._pulls.items()}

@@ -30,7 +30,7 @@ from pilosa_tpu.server.pipeline import (
 )
 from pilosa_tpu.parallel.multihost import GangUnavailable
 from pilosa_tpu.utils.errors import NotFoundError as ExecNotFound
-from pilosa_tpu.utils import events, metrics, privateproto, profiler, publicproto, slo, trace
+from pilosa_tpu.utils import events, heat, metrics, privateproto, profiler, publicproto, slo, trace
 from pilosa_tpu.utils.stats import NOP_STATS
 
 # conservative write detector for coalescing/batching eligibility: any
@@ -220,6 +220,10 @@ class Handler:
             Route("GET", r"/debug/traces", self.get_debug_traces),
             Route("GET", r"/debug/events", self.get_debug_events),
             Route("GET", r"/debug/fleet", self.get_debug_fleet),
+            # workload heat intelligence + forensics bundle (ISSUE 16)
+            Route("GET", r"/debug/heat", self.get_debug_heat),
+            Route("GET", r"/debug/bundle", self.get_debug_bundle),
+            Route("GET", r"/internal/fleet/heat", self.get_fleet_heat),
             # performance attribution (ISSUE 12): latency waterfalls,
             # continuous profiler + compile/HBM telemetry, SLO burn
             Route("GET", r"/debug/latency", self.get_debug_latency),
@@ -1080,6 +1084,114 @@ class Handler:
         if fleet is None:
             return {"snapshots": []}
         return {"snapshots": fleet.gang_snapshots()}
+
+    def get_debug_heat(self, req) -> dict:
+        """Workload heat ledger (utils/heat.py): per-(index, field,
+        shard) read/write/staging counters, decayed EWMA scores, and
+        placement-skew stats. Filters: ``?index=``, ``?dim=`` (ranking
+        dimension — ``heat`` or a raw counter), ``?top=<k>``.
+        ``?fleet=true`` on a fleet collector returns the MERGED view:
+        every reachable instance's cells summed, skew recomputed over
+        the whole fleet."""
+        q = req.query
+        dim = q.get("dim", ["heat"])[0]
+        index = q.get("index", [""])[0]
+        try:
+            top = int(q.get("top", ["10"])[0])
+        except ValueError:
+            raise APIError("invalid top: must be an integer", status=400)
+        try:
+            if q.get("fleet", ["false"])[0] == "true":
+                fleet = self._fleet()
+                if fleet is None:
+                    raise APIError(
+                        "fleet heat needs a fleet collector (server-attached "
+                        "handler); this process has none",
+                        status=400,
+                    )
+                pairs = fleet.collect_heat()
+                if index:
+                    pairs = [
+                        (
+                            label,
+                            {
+                                **snap,
+                                "cells": [
+                                    c
+                                    for c in snap.get("cells", [])
+                                    if c.get("index") == index
+                                ],
+                            },
+                        )
+                        for label, snap in pairs
+                    ]
+                out = heat.merge_fleet(pairs, dim=dim, top_k=top)
+                out["fleet"] = True
+                return out
+            return heat.LEDGER.snapshot(index=index, dim=dim, top_k=top)
+        except ValueError as e:
+            raise APIError(str(e), status=400)
+
+    def get_fleet_heat(self, req) -> dict:
+        """Gang-local heat snapshots: this process plus every member
+        registered with its collector — the heat-ledger leg of the
+        fleet telemetry plane."""
+        fleet = self._fleet()
+        if fleet is None:
+            return {"heat": [["", heat.LEDGER.snapshot()]]}
+        return {"heat": fleet.gang_heat()}
+
+    def get_debug_bundle(self, req):
+        """Incident forensics bundle: ONE deterministic tar (fixed
+        entry metadata, sorted names, blake2b-128 manifest — the
+        backup archive's idiom) capturing config, status, metrics,
+        recent traces, the events tail, the heat snapshot, and
+        governor/dispatch/fusion stats. ``pilosa_tpu debug-bundle``
+        streams it to a file."""
+        import hashlib
+        import io
+        import tarfile
+
+        srv = getattr(self.api, "server", None)
+        entries: dict = {}
+
+        def put_json(name: str, obj) -> None:
+            entries[name] = json.dumps(
+                obj, indent=2, sort_keys=True, default=str
+            ).encode()
+
+        if srv is not None and getattr(srv, "config", None) is not None:
+            entries["config.toml"] = srv.config.to_toml().encode()
+        put_json("status.json", self.api.status())
+        entries["metrics.txt"] = metrics.render_prometheus(
+            extra_snapshots=[self._expvar_snapshot()]
+        ).encode()
+        put_json("vars.json", self.get_debug_vars(req))
+        put_json("traces.json", {"traces": trace.TRACER.recent()})
+        put_json("events.json", {"events": events.snapshot(limit=500)})
+        put_json("heat.json", heat.LEDGER.snapshot())
+        put_json("dispatch.json", self.get_debug_dispatch(req))
+        put_json("fusion.json", self.get_debug_fusion(req))
+        put_json("chaos.json", self.get_debug_chaos(req))
+        manifest = {
+            "entries": {
+                n: hashlib.blake2b(b, digest_size=16).hexdigest()
+                for n, b in sorted(entries.items())
+            }
+        }
+        entries["MANIFEST.json"] = json.dumps(
+            manifest, indent=2, sort_keys=True
+        ).encode()
+        out = io.BytesIO()
+        with tarfile.open(fileobj=out, mode="w") as tw:
+            for name in sorted(entries):
+                blob = entries[name]
+                info = tarfile.TarInfo(name)
+                info.size = len(blob)
+                info.mode = 0o600
+                info.mtime = 0
+                tw.addfile(info, io.BytesIO(blob))
+        return RawResponse(out.getvalue(), "application/x-tar")
 
     def get_debug_pprof(self, req):
         """Live thread stack dump — the CPython analog of the reference's

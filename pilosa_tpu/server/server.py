@@ -26,10 +26,12 @@ from pilosa_tpu.utils.diagnostics import DiagnosticsCollector
 from pilosa_tpu.utils.logger import NOP_LOGGER, StandardLogger
 from pilosa_tpu.utils import (
     events,
+    heat,
     logger as logger_mod,
     metrics,
     profiler,
     slo,
+    telemetry_export,
     trace,
 )
 from pilosa_tpu.utils.gcnotify import GCNotifier
@@ -122,6 +124,31 @@ class Server:
             tracer.on_slow = _log_slow
         else:
             tracer.on_slow = None
+        # workload heat ledger knobs (process-global like the tracer)
+        heat.LEDGER.configure(
+            self.config.heat_enabled, self.config.heat_decay_halflife
+        )
+        # durable event journal backing: the ring becomes a
+        # write-through cache over segments in journal-dir (default
+        # <data-dir>/.events); 0 bytes keeps the ring-only journal
+        if self.config.journal_max_bytes > 0:
+            events.JOURNAL.open_backing(
+                self.config.journal_dir or os.path.join(data_dir, ".events"),
+                self.config.journal_max_bytes,
+            )
+        # telemetry export pipeline: with no sink configured this is
+        # None and the journal/tracer taps stay unset — the disabled
+        # hot path pays one is-not-None branch, no allocations
+        self.exporter = telemetry_export.build_exporter(
+            path=self.config.export_path,
+            url=self.config.export_url,
+            queue_max=self.config.export_queue,
+            interval=self.config.export_interval,
+            metrics_fn=metrics.snapshot,
+        )
+        if self.exporter is not None:
+            events.JOURNAL.on_record = self.exporter.tap_event
+            trace.TRACER.on_export = self.exporter.tap_span
         # only hook gc.callbacks when someone consumes the counter
         self.gc_notifier = GCNotifier() if self.stats is not NOP_STATS else None
         self.holder = Holder(
@@ -557,6 +584,8 @@ class Server:
 
         profiler.TELEMETRY.stager_probe = _stager_probe
         profiler.TELEMETRY.start()
+        if self.exporter is not None:
+            self.exporter.start()
         if self.config.profiler_hz > 0:
             profiler.SAMPLER.hz = self.config.profiler_hz
             profiler.SAMPLER.start()
@@ -1030,6 +1059,16 @@ class Server:
         # observer planes stop after the workers they observe
         profiler.SAMPLER.stop()
         profiler.TELEMETRY.stop()
+        if self.exporter is not None:
+            # detach the taps before the final flush so late producers
+            # can't race a closed queue, then flush-on-close (compare
+            # the bound method's receiver: ``x.m is x.m`` is False)
+            if getattr(events.JOURNAL.on_record, "__self__", None) is self.exporter:
+                events.JOURNAL.on_record = None
+            if getattr(trace.TRACER.on_export, "__self__", None) is self.exporter:
+                trace.TRACER.on_export = None
+            self.exporter.close()
+        events.JOURNAL.close_backing()
         self.stats.close()
         if self.httpd is not None:
             self.httpd.shutdown()

@@ -190,6 +190,15 @@ BUILD_INFO = "build_info"
 EVENTS_RECORDED = "events.recorded"
 FLEET_SCRAPES = "fleet.scrapes"
 TRACE_REMOTE_SPANS = "trace.remote_spans"
+# workload heat + durable journal + telemetry export (ISSUE 16)
+HEAT_CELLS = "heat.cells"
+JOURNAL_BYTES = "journal.bytes"
+JOURNAL_SEGMENTS = "journal.segments"
+JOURNAL_ERRORS = "journal.errors"
+EXPORT_ENQUEUED = "export.enqueued"
+EXPORT_DROPPED = "export.dropped"
+EXPORT_FLUSHES = "export.flushes"
+EXPORT_ERRORS = "export.errors"
 # performance attribution (ISSUE 12): always-on latency waterfalls,
 # device telemetry, continuous profiler, SLO burn-rate monitoring
 LATENCY_STAGE_SECONDS = "latency.stage_seconds"
@@ -580,6 +589,42 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter",
         "per-instance registry pulls attempted by the fleet telemetry "
         "collector (label: outcome = ok | error)",
+    ),
+    HEAT_CELLS: (
+        "gauge",
+        "live (index, field, shard) cells tracked by the workload heat ledger",
+    ),
+    JOURNAL_BYTES: (
+        "gauge",
+        "bytes resident across the durable event journal's on-disk segments",
+    ),
+    JOURNAL_SEGMENTS: (
+        "gauge",
+        "on-disk segment files backing the durable event journal",
+    ),
+    JOURNAL_ERRORS: (
+        "counter",
+        "durable-journal IO failures (recording falls back to ring-only; "
+        "label: op = append | open | prune)",
+    ),
+    EXPORT_ENQUEUED: (
+        "counter",
+        "telemetry records accepted by the export queue (label: stream = "
+        "events | spans | metrics)",
+    ),
+    EXPORT_DROPPED: (
+        "counter",
+        "telemetry records dropped on a full export queue — producers "
+        "never block (label: stream)",
+    ),
+    EXPORT_FLUSHES: (
+        "counter",
+        "export batches flushed to sinks (label: sink = jsonl | otlp)",
+    ),
+    EXPORT_ERRORS: (
+        "counter",
+        "export sink write failures; the batch is dropped, the pipeline "
+        "keeps running (label: sink)",
     ),
     TRACE_REMOTE_SPANS: (
         "counter",

@@ -375,6 +375,9 @@ class Tracer:
         self.sample_rate = sample_rate
         self.slow_threshold = 0.0  # seconds; >0 traces everything
         self.on_slow = None  # callable(dict) for traces over threshold
+        # export tap (telemetry_export): every completed root-span dict;
+        # None = disabled — the untraced hot path never reaches here
+        self.on_export = None
         self._ring: deque[dict] = deque(maxlen=ring_size)
         self._mu = threading.Lock()
         self.traces_recorded = 0
@@ -412,6 +415,12 @@ class Tracer:
         with self._mu:
             self._ring.append(d)
             self.traces_recorded += 1
+        cb = self.on_export
+        if cb is not None:
+            try:
+                cb(d)
+            except Exception:
+                pass  # an export hook must never fail the query
         if (
             self.slow_threshold > 0.0
             and span.duration is not None

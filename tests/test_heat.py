@@ -1,0 +1,306 @@
+"""Workload heat ledger (ISSUE 16): per-(index, field, shard) counter
+and EWMA accounting, skew statistics, fleet merge, the /debug/heat
+surface, and the CI-gated <=5% overhead contract for the executor read
+hook.
+
+Server-level pieces run against a real in-process server on :0 under
+JAX_PLATFORMS=cpu (the tier-1 environment)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.server import Config, Server
+from pilosa_tpu.utils import heat
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    heat.LEDGER.clear()
+    heat.LEDGER.configure(True, 300.0)
+    yield
+    heat.LEDGER.clear()
+    heat.LEDGER.configure(True, 300.0)
+
+
+@pytest.fixture()
+def server(tmp_path):
+    cfg = Config(
+        data_dir=str(tmp_path / "data"),
+        bind="127.0.0.1:0",
+        metric="expvar",
+        device_policy="always",
+        device_timeout=0,
+    )
+    s = Server(cfg)
+    s.open()
+    yield s
+    s.close()
+
+
+def req(server, method, path, body=None, raw=False):
+    url = server.uri + path
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    r = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(r) as resp:
+            payload = resp.read()
+            return resp.status, payload if raw else json.loads(payload or b"{}")
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, payload if raw else json.loads(payload or b"{}")
+
+
+# -- ledger accounting --------------------------------------------------------
+
+
+def test_counters_are_exact_integers():
+    led = heat.HeatLedger()
+    led.record_read("i", "f", 0, n=3)
+    led.record_read("i", "f", 0)
+    led.record_write("i", "f", 1, 7)
+    led.record_stage("i", "f", 0, 4096, hit=False)
+    led.record_stage("i", "f", 0, 0, hit=True)
+    led.record_wave("i", "", 0, n=2)
+    snap = led.snapshot()
+    by = {(c["field"], c["shard"]): c for c in snap["cells"]}
+    assert by[("f", 0)]["reads"] == 4
+    assert by[("f", 1)]["writes"] == 7
+    assert by[("f", 0)]["bytes_staged"] == 4096
+    assert by[("f", 0)]["stager_misses"] == 1
+    assert by[("f", 0)]["stager_hits"] == 1
+    assert by[("", 0)]["waves"] == 2
+
+
+def test_staging_does_not_move_the_ewma_score():
+    led = heat.HeatLedger()
+    led.record_stage("i", "f", 0, 1 << 20, hit=False)
+    led.record_wave("i", "f", 0)
+    (cell,) = led.snapshot()["cells"]
+    assert cell["heat"] == 0.0
+    led.record_read("i", "f", 0)
+    (cell,) = led.snapshot()["cells"]
+    assert cell["heat"] > 0.0
+
+
+def test_ewma_half_life_decay():
+    led = heat.HeatLedger(halflife=10.0)
+    led.record_read("i", "f", 0)
+    # rewind the cell's clock one half-life: the snapshot-time decay
+    # must halve the score without anyone touching the cell
+    cell = led._cells[("i", "f", 0)]
+    cell[1] -= 10.0
+    (c,) = led.snapshot()["cells"]
+    assert 0.45 < c["heat"] < 0.55
+    # the next touch decays first, then adds its weight
+    led.record_read("i", "f", 0)
+    (c,) = led.snapshot()["cells"]
+    assert 1.4 < c["heat"] < 1.6
+
+
+def test_disabled_ledger_records_nothing():
+    led = heat.HeatLedger()
+    led.configure(False, 300.0)
+    led.record_read("i", "f", 0)
+    led.record_write("i", "f", 0, 5)
+    led.record_stage("i", "f", 0, 100, hit=False)
+    led.record_wave("i", "f", 0)
+    assert led.snapshot()["cells"] == []
+    assert led.snapshot()["enabled"] is False
+
+
+def test_snapshot_index_filter_and_unknown_dim():
+    led = heat.HeatLedger()
+    led.record_read("a", "f", 0)
+    led.record_read("b", "f", 0)
+    snap = led.snapshot(index="a")
+    assert [c["index"] for c in snap["cells"]] == ["a"]
+    with pytest.raises(ValueError):
+        led.snapshot(dim="bogus")
+
+
+# -- skew statistics ----------------------------------------------------------
+
+
+def test_skew_oracle_exact_on_raw_counters():
+    led = heat.HeatLedger()
+    led.record_read("i", "f", 0, n=3)
+    led.record_read("i", "f", 1, n=1)
+    skew = led.snapshot(dim="reads")["skew"]
+    assert skew["shards"] == 2
+    assert skew["top"][0] == {"index": "i", "shard": 0, "reads": 3}
+    assert skew["top"][1] == {"index": "i", "shard": 1, "reads": 1}
+    # max / mean = 3 / 2 exactly
+    assert skew["imbalance_ratio"] == 1.5
+
+
+def test_skew_empty_and_top_k():
+    assert heat.compute_skew([], dim="reads") == {
+        "shards": 0,
+        "top": [],
+        "imbalance_ratio": 1.0,
+    }
+    cells = [
+        {"index": "i", "field": "f", "shard": s, "reads": s + 1} for s in range(5)
+    ]
+    skew = heat.compute_skew(cells, dim="reads", top_k=2)
+    assert skew["shards"] == 5 and len(skew["top"]) == 2
+    assert skew["top"][0]["shard"] == 4
+    with pytest.raises(ValueError):
+        heat.compute_skew(cells, dim="bogus")
+
+
+def test_skew_aggregates_fields_of_one_shard():
+    """Cells are per-(index, field, shard); skew is per-(index, shard) —
+    two fields of one shard pool their load."""
+    led = heat.HeatLedger()
+    led.record_read("i", "f", 0, n=2)
+    led.record_read("i", "g", 0, n=2)
+    led.record_read("i", "f", 1, n=1)
+    skew = led.snapshot(dim="reads")["skew"]
+    assert skew["top"][0] == {"index": "i", "shard": 0, "reads": 4}
+
+
+def test_merge_fleet_sums_instances():
+    a = heat.HeatLedger()
+    a.record_write("i", "f", 0, 4)
+    b = heat.HeatLedger()
+    b.record_write("i", "f", 0, 4)
+    b.record_write("i", "f", 1, 2)
+    merged = heat.merge_fleet(
+        [("rank0", a.snapshot()), ("rank1", b.snapshot())], dim="writes"
+    )
+    assert [i["instance"] for i in merged["instances"]] == ["rank0", "rank1"]
+    skew = merged["skew"]
+    assert skew["top"][0] == {"index": "i", "shard": 0, "writes": 8}
+    assert skew["top"][1] == {"index": "i", "shard": 1, "writes": 2}
+    assert skew["imbalance_ratio"] == 1.6
+
+
+# -- server surface -----------------------------------------------------------
+
+
+def test_debug_heat_records_reads_writes_and_staging(server):
+    req(server, "POST", "/index/ht", {})
+    req(server, "POST", "/index/ht/field/f", {})
+    req(server, "POST", "/index/ht/query", b"Set(1, f=1)")
+    # cache=false so the plan cache can't short-circuit the map legs
+    for _ in range(2):
+        st, body = req(server, "POST", "/index/ht/query?cache=false", b"Count(Row(f=1))")
+        assert st == 200 and body["results"] == [1]
+    st, snap = req(server, "GET", "/debug/heat?index=ht")
+    assert st == 200 and snap["enabled"] is True
+    shard0 = [c for c in snap["cells"] if c["shard"] == 0]
+    assert sum(c["reads"] for c in shard0) >= 2
+    assert sum(c["writes"] for c in shard0) >= 1
+    # device_policy=always: the first read staged the fragment (miss +
+    # bytes), the second hit the stager
+    assert sum(c["stager_misses"] for c in shard0) >= 1
+    assert sum(c["stager_hits"] for c in shard0) >= 1
+    assert sum(c["bytes_staged"] for c in shard0) > 0
+    assert snap["skew"]["shards"] >= 1
+
+
+def test_debug_heat_validates_dim_and_top(server):
+    st, body = req(server, "GET", "/debug/heat?dim=bogus")
+    assert st == 400
+    st, body = req(server, "GET", "/debug/heat?top=x")
+    assert st == 400
+
+
+def test_debug_heat_fleet_merges_local_instance(server):
+    req(server, "POST", "/index/hf", {})
+    req(server, "POST", "/index/hf/field/f", {})
+    req(server, "POST", "/index/hf/query", b"Set(1, f=1)")
+    st, merged = req(server, "GET", "/debug/heat?fleet=true&dim=writes&index=hf")
+    assert st == 200 and merged["fleet"] is True
+    assert [i["instance"] for i in merged["instances"]] == [server.uri]
+    assert all(c["index"] == "hf" for c in merged["cells"])
+    assert merged["skew"]["top"][0]["index"] == "hf"
+
+
+# -- docs drift guard ---------------------------------------------------------
+
+
+def test_docs_document_observability_knobs_with_current_defaults():
+    """docs/configuration.md names every heat/journal/export knob with
+    the default the code actually uses, and docs/administration.md
+    keeps the §Workload heat & durable journal section — both
+    directions of drift (the test_fusion.py knob-sync idiom)."""
+    import os
+
+    cfg = Config(data_dir="x")
+    root = os.path.join(os.path.dirname(__file__), "..", "docs")
+    with open(os.path.join(root, "configuration.md")) as f:
+        conf = f.read()
+    for knob, default in (
+        ("heat-enabled", "true" if cfg.heat_enabled else "false"),
+        ("heat-decay-halflife", str(cfg.heat_decay_halflife)),
+        ("journal-dir", f"`{cfg.journal_dir or chr(34) * 2}`"),
+        ("journal-max-bytes", str(cfg.journal_max_bytes)),
+        ("export-path", f"`{cfg.export_path or chr(34) * 2}`"),
+        ("export-url", f"`{cfg.export_url or chr(34) * 2}`"),
+        ("export-interval", str(cfg.export_interval)),
+        ("export-queue", str(cfg.export_queue)),
+    ):
+        assert f"| `{knob}` | {default} |" in conf, knob
+    with open(os.path.join(root, "administration.md")) as f:
+        admin = f.read()
+    assert "### Workload heat & durable journal" in admin
+    assert "/debug/heat" in admin and "/debug/bundle" in admin
+    assert "debug-bundle" in admin and "events --follow" in admin
+
+
+# -- overhead gate ------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_heat_overhead_gate(tmp_path):
+    """Executor micro with the heat ledger enabled stays within 5% of
+    disabled (interleaved rounds, min-of-rounds — the ISSUE 12
+    attribution-gate harness; the CI observability step runs this
+    explicitly, it is excluded from tier-1 as timing-sensitive)."""
+    cfg = Config(
+        data_dir=str(tmp_path / "data"),
+        bind="127.0.0.1:0",
+        metric="expvar",
+        device_policy="always",
+        device_timeout=0,
+    )
+    s = Server(cfg)
+    s.open()
+    try:
+        s.api.create_index("ov")
+        s.api.create_field("ov", "f", {})
+        s.api.query("ov", "Set(1, f=1)")
+        for _ in range(20):
+            s.api.query("ov", "Count(Row(f=1))")  # warm
+
+        def round_(hot: bool, iters=60) -> float:
+            heat.LEDGER.enabled = hot
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                s.executor.execute("ov", "Count(Row(f=1))")
+            return time.perf_counter() - t0
+
+        # interleave disabled/enabled rounds so a transient load spike
+        # hits both sides, and take the min of each — scheduling noise
+        # is strictly additive, so min is the honest per-iteration cost.
+        # CI runners are still noisy, so best of up to 3 attempts.
+        overhead = float("inf")
+        for _ in range(3):
+            base = instrumented = float("inf")
+            for _ in range(9):
+                base = min(base, round_(hot=False))
+                instrumented = min(instrumented, round_(hot=True))
+            overhead = min(overhead, instrumented / base - 1.0)
+            if overhead < 0.05:
+                break
+        assert overhead < 0.05, f"heat accounting overhead {overhead:.1%} >= 5%"
+    finally:
+        heat.LEDGER.enabled = True
+        s.close()

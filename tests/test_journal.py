@@ -1,0 +1,222 @@
+"""Durable event journal (ISSUE 16): segmented on-disk backing behind
+the process-global ring — crash-consistent framing (torn tails
+truncated at open), monotonic sequence numbers across restart, whole-
+segment retention, IO-failure demotion to ring-only, and the
+/debug/events paging that rides it.
+
+Server-level pieces run against a real in-process server on :0 under
+JAX_PLATFORMS=cpu (the tier-1 environment)."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.server import Config, Server
+from pilosa_tpu.utils import events, metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    events.JOURNAL.clear()
+    yield
+    events.JOURNAL.close_backing()
+    events.JOURNAL.clear()
+    events.JOURNAL.on_record = None
+
+
+def _segments(directory):
+    return sorted(
+        f for f in os.listdir(directory) if f.startswith("events-")
+    )
+
+
+# -- durable roundtrip --------------------------------------------------------
+
+
+def _open(tmp_path, **kw):
+    j = events.EventJournal()
+    j.open_backing(str(tmp_path), kw.pop("max_bytes", 1 << 20), **kw)
+    return j
+
+
+def test_roundtrip_and_monotonic_seq(tmp_path):
+    j = _open(tmp_path)
+    assert j.durable
+    for i in range(5):
+        j.record("gang.transition", frm="A", to="B", i=i)
+    assert j.record("gang.degrade")["seq"] == 6
+    j.close_backing()
+    assert not j.durable
+    # a NEW journal (fresh process) resumes from the durable tail
+    j2 = _open(tmp_path)
+    snap = j2.snapshot()
+    assert [e["seq"] for e in snap] == [1, 2, 3, 4, 5, 6]
+    assert snap[0]["kind"] == "gang.transition" and snap[0]["i"] == 0
+    # seq continues monotonically — never reused, never reset
+    assert j2.record("gang.reform")["seq"] == 7
+    j2.close_backing()
+
+
+def test_torn_tail_truncated_at_reopen(tmp_path):
+    j = _open(tmp_path)
+    for i in range(3):
+        j.record("ingest.wave", i=i)
+    j.close_backing()
+    (seg,) = _segments(tmp_path)
+    path = os.path.join(str(tmp_path), seg)
+    clean = os.path.getsize(path)
+    # simulate a SIGKILL mid-append: a frame header promising more
+    # bytes than were ever written
+    with open(path, "ab") as f:
+        f.write(b"\xff\xff\x00\x00garbage")
+    j2 = _open(tmp_path)
+    assert [e["seq"] for e in j2.snapshot()] == [1, 2, 3]
+    assert os.path.getsize(path) == clean  # tail gone from disk
+    # appends after recovery are clean and readable
+    j2.record("ingest.wave", i=3)
+    j2.close_backing()
+    j3 = _open(tmp_path)
+    assert [e["seq"] for e in j3.snapshot()] == [1, 2, 3, 4]
+    j3.close_backing()
+
+
+def test_corrupt_checksum_stops_the_scan(tmp_path):
+    j = _open(tmp_path)
+    for i in range(3):
+        j.record("ingest.wave", i=i)
+    j.close_backing()
+    (seg,) = _segments(tmp_path)
+    path = os.path.join(str(tmp_path), seg)
+    data = bytearray(open(path, "rb").read())
+    data[-1] ^= 0xFF  # flip one payload byte of the LAST record
+    with open(path, "wb") as f:
+        f.write(data)
+    j2 = _open(tmp_path)
+    assert [e["seq"] for e in j2.snapshot()] == [1, 2]
+    j2.close_backing()
+
+
+def test_retention_drops_whole_oldest_segments(tmp_path):
+    # roll at max(64 KiB, max_bytes/8); ~1 KiB records roll segments
+    # quickly enough to exercise pruning end-to-end
+    j = _open(tmp_path, max_bytes=128 << 10)
+    pad = "x" * 1024
+    for i in range(300):
+        j.record("ingest.wave", i=i, pad=pad)
+    segs = _segments(tmp_path)
+    assert len(segs) >= 2  # rolled at least once
+    total = sum(
+        os.path.getsize(os.path.join(str(tmp_path), s)) for s in segs
+    )
+    assert total <= (128 << 10) + j._roll_bytes()
+    j.close_backing()
+    # the oldest records are gone from disk, the newest survive
+    j2 = _open(tmp_path, max_bytes=128 << 10)
+    seqs = [e["seq"] for e in j2.snapshot()]
+    assert seqs and seqs[0] > 1 and seqs[-1] == 300
+    assert seqs == sorted(seqs)
+    j2.close_backing()
+
+
+def test_append_failure_demotes_to_ring_only(tmp_path):
+    j = _open(tmp_path)
+    j.record("ingest.wave", i=0)
+    before = sum(
+        v
+        for k, v in metrics.snapshot().items()
+        if k.startswith(metrics.JOURNAL_ERRORS)
+    )
+    j._seg_f.close()  # yank the handle out from under the journal
+    d = j.record("ingest.wave", i=1)  # must not raise
+    assert d["seq"] == 2
+    assert not j.durable  # demoted
+    assert [e["i"] for e in j.snapshot()] == [0, 1]  # ring kept both
+    after = sum(
+        v
+        for k, v in metrics.snapshot().items()
+        if k.startswith(metrics.JOURNAL_ERRORS)
+    )
+    assert after == before + 1
+
+
+def test_ring_entries_predating_the_backing_survive(tmp_path):
+    j = events.EventJournal()
+    j.record("gang.degrade")  # ring-only era
+    j.open_backing(str(tmp_path), 1 << 20)
+    j.record("gang.reform")
+    snap = j.snapshot()
+    assert [e["kind"] for e in snap] == ["gang.degrade", "gang.reform"]
+    assert [e["seq"] for e in snap] == [1, 2]
+    j.close_backing()
+
+
+def test_since_seq_pages_past_the_ring(tmp_path):
+    j = events.EventJournal(ring_size=8)
+    j.open_backing(str(tmp_path), 1 << 20)
+    for i in range(40):
+        j.record("ingest.wave", i=i)
+    # the ring only holds the last 8, but the disk merge pages back
+    assert [e["seq"] for e in j.snapshot(since_seq=10)] == list(range(11, 41))
+    assert len(j.snapshot(kind="ingest.wave")) == 40
+    j.close_backing()
+
+
+def test_open_backing_disabled_by_zero_budget(tmp_path):
+    j = events.EventJournal()
+    j.open_backing(str(tmp_path), 0)
+    assert not j.durable
+    j.record("gang.degrade")
+    assert _segments(tmp_path) == []
+
+
+# -- server wiring ------------------------------------------------------------
+
+
+def req(server, method, path, body=None):
+    url = server.uri + path
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    r = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(r) as resp:
+        return resp.status, json.loads(resp.read() or b"{}")
+
+
+def test_server_opens_backing_and_seq_survives_reboot(tmp_path):
+    cfg = Config(
+        data_dir=str(tmp_path / "data"),
+        bind="127.0.0.1:0",
+        metric="expvar",
+        device_policy="always",
+        device_timeout=0,
+    )
+    s = Server(cfg)
+    s.open()
+    try:
+        assert events.JOURNAL.durable
+        d = events.record("chaos.window", mode="install")
+        seq1 = d["seq"]
+        st, body = req(s, "GET", f"/debug/events?since={seq1 - 1}")
+        assert st == 200
+        assert any(e["seq"] == seq1 for e in body["events"])
+    finally:
+        s.close()
+    assert not events.JOURNAL.durable  # close detached the backing
+    # same data dir: the journal resumes past every durable record
+    s2 = Server(cfg)
+    s2.open()
+    try:
+        assert events.JOURNAL.durable
+        d2 = events.record("chaos.window", mode="clear")
+        assert d2["seq"] > seq1
+        st, body = req(s2, "GET", f"/debug/events?since={seq1}")
+        assert any(
+            e["seq"] == d2["seq"] and e["mode"] == "clear"
+            for e in body["events"]
+        )
+    finally:
+        s2.close()
+    # default journal dir rides under the data dir
+    assert _segments(str(tmp_path / "data" / ".events"))

@@ -1,0 +1,256 @@
+"""Telemetry export pipeline (ISSUE 16): the batching exporter's
+never-block backpressure contract, the JSONL and OTLP/HTTP sinks, the
+journal/tracer taps, and the server wiring (config-driven sinks, taps
+detached on close, disabled path leaves the taps as plain None).
+
+Server-level pieces run against a real in-process server on :0 under
+JAX_PLATFORMS=cpu (the tier-1 environment)."""
+
+import json
+import time
+
+import pytest
+
+from pilosa_tpu.server import Config, Server
+from pilosa_tpu.utils import events, metrics, telemetry_export, trace
+from pilosa_tpu.utils.telemetry_export import (
+    BatchingExporter,
+    JsonlFileSink,
+    OtlpHttpSink,
+    build_exporter,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_taps():
+    yield
+    events.JOURNAL.on_record = None
+    trace.TRACER.on_export = None
+    events.JOURNAL.clear()
+
+
+class ListSink:
+    name = "list"
+
+    def __init__(self):
+        self.batches = []
+
+    def write_batch(self, batch):
+        self.batches.append(batch)
+
+    def close(self):
+        pass
+
+
+class BoomSink:
+    name = "boom"
+
+    def write_batch(self, batch):
+        raise OSError("sink down")
+
+    def close(self):
+        pass
+
+
+def _metric(prefix: str) -> float:
+    return sum(
+        v for k, v in metrics.snapshot().items() if k.startswith(prefix)
+    )
+
+
+# -- backpressure -------------------------------------------------------------
+
+
+def test_full_queue_drops_and_counts_never_blocks():
+    ex = BatchingExporter([ListSink()], queue_max=4)
+    before = _metric(metrics.EXPORT_DROPPED)
+    t0 = time.perf_counter()
+    results = [ex.enqueue("events", {"i": i}) for i in range(10)]
+    elapsed = time.perf_counter() - t0
+    assert results == [True] * 4 + [False] * 6
+    assert ex.stats()["enqueued"] == 4 and ex.stats()["dropped"] == 6
+    assert _metric(metrics.EXPORT_DROPPED) == before + 6
+    # "never blocks" pinned coarsely: 10 enqueues against a full queue
+    # finish in interactive time, no waiting on any consumer
+    assert elapsed < 1.0
+    # a flush drains the queue and new records are accepted again
+    assert ex.flush() == 4
+    assert ex.enqueue("events", {"i": 10}) is True
+    ex.close()
+
+
+def test_flush_is_per_sink_isolated():
+    good = ListSink()
+    before = _metric(metrics.EXPORT_ERRORS)
+    ex = BatchingExporter([BoomSink(), good], queue_max=16)
+    ex.enqueue("events", {"i": 1})
+    assert ex.flush() == 1
+    # the failing sink dropped its batch and was counted; the good
+    # sink still shipped
+    assert _metric(metrics.EXPORT_ERRORS) == before + 1
+    assert len(good.batches) == 1
+    ex.close()
+
+
+def test_metrics_fn_sampled_per_flush():
+    sink = ListSink()
+    ex = BatchingExporter([sink], metrics_fn=lambda: {"up": 1.0})
+    ex.flush()
+    (batch,) = sink.batches
+    assert [r["stream"] for r in batch] == ["metrics"]
+    assert batch[0]["record"] == {"up": 1.0}
+    ex.close()
+
+
+# -- sinks --------------------------------------------------------------------
+
+
+def test_jsonl_sink_roundtrip_and_flush_on_close(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    ex = BatchingExporter([JsonlFileSink(path)], queue_max=16)
+    ex.enqueue("events", {"kind": "gang.degrade", "seq": 1})
+    ex.enqueue("spans", {"name": "query", "duration_ms": 2.5})
+    ex.close()  # flush-on-close, no background thread ever started
+    lines = [json.loads(l) for l in open(path)]
+    assert [l["stream"] for l in lines] == ["events", "spans"]
+    assert lines[0]["record"]["kind"] == "gang.degrade"
+    assert all("t" in l for l in lines)
+
+
+def test_otlp_sink_posts_traces_logs_and_metrics(monkeypatch):
+    posts = []
+
+    class _Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    def fake_urlopen(req, timeout=None):
+        posts.append((req.full_url, json.loads(req.data)))
+        return _Resp()
+
+    monkeypatch.setattr(
+        telemetry_export.urllib.request, "urlopen", fake_urlopen
+    )
+    sink = OtlpHttpSink("http://collector:4318/")
+    now = time.time()
+    sink.write_batch(
+        [
+            {
+                "stream": "spans",
+                "t": now,
+                "record": {
+                    "name": "query",
+                    "trace_id": "ab" * 16,
+                    "span_id": "cd" * 8,
+                    "duration_ms": 10.0,
+                    "meta": {"index": "i", "shards": 2, "ok": True},
+                },
+            },
+            {
+                "stream": "events",
+                "t": now,
+                "record": {"kind": "gang.degrade", "t": now, "seq": 7},
+            },
+            {
+                "stream": "metrics",
+                "t": now,
+                "record": {"uptime": 12.5, "name": "not-a-number"},
+            },
+        ]
+    )
+    by_path = {url.rsplit("/v1/", 1)[1]: body for url, body in posts}
+    assert set(by_path) == {"traces", "logs", "metrics"}
+    (span,) = by_path["traces"]["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert span["name"] == "query" and span["traceId"] == "ab" * 16
+    dur_ns = int(span["endTimeUnixNano"]) - int(span["startTimeUnixNano"])
+    assert abs(dur_ns - 10e6) < 1e4  # 10ms span, float-nano slack
+    (rec,) = by_path["logs"]["resourceLogs"][0]["scopeLogs"][0]["logRecords"]
+    assert rec["body"]["stringValue"] == "gang.degrade"
+    (gauge,) = by_path["metrics"]["resourceMetrics"][0]["scopeMetrics"][0][
+        "metrics"
+    ]
+    assert gauge["name"] == "uptime"
+    assert gauge["gauge"]["dataPoints"][0]["asDouble"] == 12.5
+
+
+def test_build_exporter_none_without_sinks(tmp_path):
+    assert build_exporter() is None
+    ex = build_exporter(path=str(tmp_path / "t.jsonl"))
+    assert [s.name for s in ex.sinks] == ["jsonl"]
+    ex.close()
+
+
+# -- taps ---------------------------------------------------------------------
+
+
+def test_journal_and_tracer_taps_feed_the_queue():
+    sink = ListSink()
+    ex = BatchingExporter([sink], queue_max=16)
+    events.JOURNAL.on_record = ex.tap_event
+    tr = trace.Tracer()
+    tr.on_export = ex.tap_span
+    events.record("gang.degrade", gang="A")
+    with tr.trace("query", force=True):
+        pass
+    ex.flush()
+    (batch,) = sink.batches
+    streams = [r["stream"] for r in batch]
+    assert "events" in streams and "spans" in streams
+    ev = next(r for r in batch if r["stream"] == "events")
+    assert ev["record"]["kind"] == "gang.degrade"
+    sp = next(r for r in batch if r["stream"] == "spans")
+    assert sp["record"]["name"] == "query"
+    ex.close()
+
+
+# -- server wiring ------------------------------------------------------------
+
+
+def _cfg(tmp_path, **kw):
+    return Config(
+        data_dir=str(tmp_path / "data"),
+        bind="127.0.0.1:0",
+        metric="expvar",
+        device_policy="always",
+        device_timeout=0,
+        **kw,
+    )
+
+
+def test_server_disabled_path_leaves_taps_none(tmp_path):
+    s = Server(_cfg(tmp_path))
+    s.open()
+    try:
+        # no export sink configured: no exporter object, and the hot
+        # paths see a plain None attribute — one branch, no allocation
+        assert s.exporter is None
+        assert events.JOURNAL.on_record is None
+        assert trace.TRACER.on_export is None
+    finally:
+        s.close()
+
+
+def test_server_exports_events_to_jsonl_and_detaches_on_close(tmp_path):
+    path = str(tmp_path / "out.jsonl")
+    s = Server(_cfg(tmp_path, export_path=path, export_interval=600.0))
+    s.open()
+    try:
+        assert s.exporter is not None
+        assert getattr(events.JOURNAL.on_record, "__self__", None) is s.exporter
+        assert getattr(trace.TRACER.on_export, "__self__", None) is s.exporter
+        events.record("chaos.window", mode="install")
+    finally:
+        s.close()
+    # close detached the taps, then flushed the queue into the sink
+    assert events.JOURNAL.on_record is None
+    assert trace.TRACER.on_export is None
+    lines = [json.loads(l) for l in open(path)]
+    assert any(
+        l["stream"] == "events" and l["record"]["kind"] == "chaos.window"
+        for l in lines
+    )
+    # every flush also samples a metrics snapshot
+    assert any(l["stream"] == "metrics" for l in lines)
