@@ -1596,3 +1596,40 @@ class Fragment:
     def bsi_planes(self, bit_depth: int) -> np.ndarray:
         """uint64[bit_depth+1, 16384] plane stack (plane bit_depth = not-null)."""
         return self.packed_rows(list(range(bit_depth + 1)))
+
+    def container_blocks(
+        self, row_ids: list[int]
+    ) -> tuple[list[tuple[int, int, int, np.ndarray]], int]:
+        """Container-level serialization of the given rows — the T1
+        (host-RAM compressed tier) block form and the compressed-upload
+        payload. Returns (entries, nbytes): entries is one
+        ``(row_index, slot, typ, payload)`` per nonempty container,
+        where ``row_index`` indexes into ``row_ids``, ``slot`` is the
+        container's position within its row (0..15), ``typ`` is the
+        roaring container type, and ``payload`` is a private copy of
+        its native form — uint16 positions (array), uint16 [start,
+        last] pairs (run), or packed uint64[1024] words (bitmap).
+        ``nbytes`` is the summed payload size, the T1 accounting unit.
+        """
+        from pilosa_tpu.roaring.bitmap import CONTAINER_ARRAY, CONTAINER_RUN
+
+        rids = np.asarray(row_ids, dtype=np.uint64)
+        keys, _, lo, hi = self._row_key_spans(rids)
+        store = self.storage.containers
+        entries: list[tuple[int, int, int, np.ndarray]] = []
+        nbytes = 0
+        for i, (l, h) in enumerate(zip(lo, hi)):
+            for k in keys[l:h]:
+                c = store.get(int(k))
+                if c is None or not c.n:
+                    continue
+                slot = int(k) % (SHARD_WIDTH >> 16)
+                if c.typ == CONTAINER_ARRAY:
+                    payload = np.array(c.array, dtype=np.uint16)
+                elif c.typ == CONTAINER_RUN:
+                    payload = np.array(c.runs, dtype=np.uint16).reshape(-1, 2)
+                else:
+                    payload = np.array(c.words(), dtype=np.uint64)
+                entries.append((i, slot, int(c.typ), payload))
+                nbytes += payload.nbytes
+        return entries, nbytes

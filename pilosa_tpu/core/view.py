@@ -106,7 +106,14 @@ class View:
                 self.fragments[shard] = frag
                 if shard > prev_max and self.broadcaster:
                     self.broadcaster(self.index, shard)
-            return frag
+        # open() discovery registers fragments UNOPENED (lazy startup);
+        # mutating one before its first open would hit the empty
+        # placeholder Bitmap — with no op-log attached — and the first
+        # ensure_open() would then replace storage with the mmapped
+        # file, silently discarding (acked!) writes. Open outside the
+        # view lock: fragment opens are slow (mmap + recovery scan) and
+        # ensure_open is a flag check once open.
+        return frag.ensure_open()
 
     def available_shards(self) -> list[int]:
         return sorted(self.fragments)

@@ -471,10 +471,23 @@ class DispatchEngine:
         while the launched wave computes, the stager's side thread
         uploads the NEXT waves' Row operands (staging overlapped with
         compute). Bounded, best-effort, and idempotent — the real
-        execution re-stages whatever this missed."""
+        execution re-stages whatever this missed.
+
+        With a plan-driven prefetcher wired (executor/tiering.py), the
+        queued items' plans go to the scheduler instead: it extracts
+        Row operands itself, promotes their blocks T1/T2 → T0, and
+        marks them for accuracy attribution — replacing the opaque
+        warm-thunk path."""
+        ex = self.executor
+        pf = getattr(ex, "prefetcher", None)
+        if pf is not None and pf.enabled:
+            with self._mu:
+                peek = list(self._q)[: pf.depth * self.max_wave]
+            if peek:
+                pf.schedule(peek)
+            return
         if self.stage_ahead_depth <= 0:
             return
-        ex = self.executor
         stage = getattr(ex.stager, "stage_ahead", None)
         if stage is None:
             return
@@ -537,6 +550,11 @@ class DispatchEngine:
                 "fusion": (
                     self.executor.fuser.stats()
                     if getattr(self.executor, "fuser", None) is not None
+                    else {"enabled": False}
+                ),
+                "prefetch": (
+                    self.executor.prefetcher.stats()
+                    if getattr(self.executor, "prefetcher", None) is not None
                     else {"enabled": False}
                 ),
             }

@@ -341,6 +341,8 @@ class Executor:
         dispatch_max_wave: int = 16,
         dispatch_max_inflight: int = 2,
         dispatch_stage_ahead: int = 1,
+        prefetch_enabled: Optional[bool] = None,
+        prefetch_depth: int = 2,
         fusion_enabled: Optional[bool] = None,
         fusion_max_calls: int = 64,
         plan_cache_device_bytes: Optional[int] = None,
@@ -447,6 +449,19 @@ class Executor:
             )
         else:
             self.dispatch_engine = None
+        # plan-driven prefetch scheduler (executor/tiering.py): the
+        # dispatch engine's wave builder hands it queued plans so the
+        # NEXT waves' Row blocks promote T1/T2 → T0 ahead of compute,
+        # with accuracy attribution. Replaces the thunk-based advisory
+        # warm when enabled; PILOSA_PREFETCH=0 reverts for A/B.
+        if prefetch_enabled is None:
+            prefetch_enabled = os.environ.get("PILOSA_PREFETCH", "1") != "0"
+        if prefetch_enabled and self.dispatch_engine is not None:
+            from pilosa_tpu.executor.tiering import PrefetchScheduler
+
+            self.prefetcher = PrefetchScheduler(self, depth=prefetch_depth)
+        else:
+            self.prefetcher = None
         # whole-query device fusion (fusion.py): multi-call read queries
         # — and the multi-call Queries the dispatch engine combines a
         # wave into — lower to ONE jitted program, intermediates stay in

@@ -87,13 +87,20 @@ def classify_device_error(exc: BaseException) -> Optional[str]:
 
 
 class _Tenant:
-    __slots__ = ("name", "share", "evict_fn", "tier", "used")
+    __slots__ = ("name", "share", "evict_fn", "tier", "domain", "used")
 
-    def __init__(self, name: str, share: int, evict_fn, tier: int) -> None:
+    def __init__(
+        self, name: str, share: int, evict_fn, tier: int, domain: str = "hbm"
+    ) -> None:
         self.name = name
         self.share = share
         self.evict_fn = evict_fn
         self.tier = tier
+        # "hbm" tenants hold device memory and count against the global
+        # budget; "host" tenants (the T1 container tier) ride the same
+        # ledger for visibility and stats but never trigger — or are
+        # swept by — device pressure relief (ISSUE 17)
+        self.domain = domain
         self.used = 0
 
 
@@ -133,16 +140,18 @@ class HbmGovernor:
         share_bytes: int = 0,
         evict_fn: Optional[Callable[[int], int]] = None,
         tier: int = 99,
+        domain: str = "hbm",
     ) -> None:
         with self._mu:
             t = self._tenants.get(name)
             if t is None:
-                t = _Tenant(name, int(share_bytes), evict_fn, tier)
+                t = _Tenant(name, int(share_bytes), evict_fn, tier, domain)
                 self._tenants[name] = t
             else:
                 t.share = int(share_bytes)
                 t.evict_fn = evict_fn
                 t.tier = tier
+                t.domain = domain
 
     # -- accounting -----------------------------------------------------------
 
@@ -153,7 +162,9 @@ class HbmGovernor:
     def _budget_locked(self) -> int:
         if self.budget_bytes > 0:
             return self.budget_bytes
-        return sum(t.share for t in self._tenants.values()) or (8 << 30)
+        return sum(
+            t.share for t in self._tenants.values() if t.domain == "hbm"
+        ) or (8 << 30)
 
     def used(self, name: Optional[str] = None) -> int:
         with self._mu:
@@ -165,7 +176,7 @@ class HbmGovernor:
     def headroom(self) -> int:
         with self._mu:
             return self._budget_locked() - sum(
-                t.used for t in self._tenants.values()
+                t.used for t in self._tenants.values() if t.domain == "hbm"
             )
 
     def over_budget(self) -> int:
@@ -239,7 +250,11 @@ class HbmGovernor:
         call ``release`` re-entrantly. Returns bytes freed."""
         with self._mu:
             tiers = sorted(
-                (t for t in self._tenants.values() if t.evict_fn is not None),
+                (
+                    t
+                    for t in self._tenants.values()
+                    if t.evict_fn is not None and t.domain == "hbm"
+                ),
                 key=lambda t: t.tier,
             )
         freed_total = 0
@@ -268,7 +283,11 @@ class HbmGovernor:
         everything it can before the single retry."""
         with self._mu:
             tiers = sorted(
-                (t for t in self._tenants.values() if t.evict_fn is not None),
+                (
+                    t
+                    for t in self._tenants.values()
+                    if t.evict_fn is not None and t.domain == "hbm"
+                ),
                 key=lambda t: t.tier,
             )
             budget = self._budget_locked()
@@ -310,9 +329,18 @@ class HbmGovernor:
         with self._mu:
             return {
                 "budget_bytes": self._budget_locked(),
-                "used_bytes": sum(t.used for t in self._tenants.values()),
+                "used_bytes": sum(
+                    t.used for t in self._tenants.values() if t.domain == "hbm"
+                ),
+                # "domain" only on off-device tenants (e.g. the tier1
+                # host cache) — device tenants keep the classic shape
                 "tenants": {
-                    t.name: {"used": t.used, "share": t.share, "tier": t.tier}
+                    t.name: {
+                        "used": t.used,
+                        "share": t.share,
+                        "tier": t.tier,
+                        **({"domain": t.domain} if t.domain != "hbm" else {}),
+                    }
                     for t in self._tenants.values()
                 },
             }

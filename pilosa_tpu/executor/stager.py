@@ -51,9 +51,15 @@ import numpy as np
 
 from pilosa_tpu import SHARD_WIDTH, ops
 from pilosa_tpu.analysis.locks import OrderedLock
-from pilosa_tpu.utils import heat, metrics, trace
+from pilosa_tpu.utils import events, heat, metrics, trace
 
 _W32 = SHARD_WIDTH // 32  # u32 words per staged row
+# compressed-upload ceiling: global bit coordinates are u32, so a block
+# can span at most 2^32 / SHARD_WIDTH staged rows before they wrap
+# (2048 rows × 2^20 bits = 2^31 — also keeps the expansion kernel's
+# i32 word indexes exact, with 0xFFFFFFFF position padding still
+# landing past every real word)
+_MAX_COMPRESSED_ROWS = (1 << 32) // SHARD_WIDTH // 2
 
 
 class _InFlight:
@@ -108,6 +114,8 @@ class DeviceStager:
         mesh=None,
         delta_enabled: bool = True,
         delta_max_ratio: float = 0.25,
+        tier1_max_bytes: int = 0,
+        compressed_min_ratio: float = 0.0,
     ) -> None:
         self.budget_bytes = budget_bytes
         self.device = device
@@ -146,6 +154,34 @@ class DeviceStager:
         self._ahead_mu = OrderedLock("stager.ahead_mu")
         self._ahead_cv = threading.Condition(self._ahead_mu)
         self._ahead_thread: Optional[threading.Thread] = None
+        # stage-ahead thunks that raised: counted (not swallowed blind),
+        # first occurrence per exception type journaled (ISSUE 17 s1)
+        self.ahead_errors = 0
+        self._ahead_err_seen: set = set()
+        # tiered staging (executor/tiering.py): T1 host container cache
+        # (0 = off, the bare-executor default) and the compressed-upload
+        # crossover — dense/payload ratios at or above it ship container
+        # payloads and expand on device (ops.expand_blocks) instead of
+        # uploading the dense block (0 = always upload dense)
+        self.compressed_min_ratio = float(compressed_min_ratio)
+        if tier1_max_bytes > 0:
+            from pilosa_tpu.executor.tiering import Tier1Cache
+
+            self.tier1 = Tier1Cache(tier1_max_bytes)
+        else:
+            self.tier1 = None
+        # prefetch accuracy (plan-driven prefetcher, tiering.py): keys
+        # staged speculatively, resolved to used on the first real hit
+        # or to evicted when LRU/governor pressure drops them untouched
+        self._prefetched: set = set()
+        self.prefetch_issued = 0
+        self.prefetch_used = 0
+        self.prefetch_evicted = 0
+        # keys dropped under capacity pressure: a later cold miss on one
+        # of these is a RE-ENTRY — bytes an earlier stage already paid
+        # to upload (stager.restaged_bytes). Bounded below; explicit
+        # clears/wedges forget it (those aren't capacity pressure).
+        self._evicted_keys: set = set()
 
     # -- internal --
 
@@ -170,6 +206,22 @@ class DeviceStager:
         for f in live:
             heat.LEDGER.record_stage(f.index, f.field, f.shard, per, hit)
 
+    def _note_evicted_locked(self, key: tuple) -> None:
+        """A cache entry left under pressure: if it was staged
+        speculatively and never hit, the prefetch was wasted — the
+        accuracy counters' denominator. The key is also remembered so a
+        later re-stage can be attributed to oversubscription
+        (stager.restaged_bytes). Caller holds _mu."""
+        if key in self._prefetched:
+            self._prefetched.discard(key)
+            self.prefetch_evicted += 1
+            metrics.count(metrics.PREFETCH_EVICTED)
+        if len(self._evicted_keys) >= 65536:
+            # pathological key churn: reset rather than grow without
+            # bound (loses re-entry attribution for the dropped keys)
+            self._evicted_keys.clear()
+        self._evicted_keys.add(key)
+
     def _get_or_build(
         self,
         key,
@@ -177,6 +229,7 @@ class DeviceStager:
         builder: Callable,
         delta_fn: Optional[Callable] = None,
         frag=None,
+        prefetch: bool = False,
     ):
         """Return the staged value for ``key``, fresh w.r.t. the
         caller-observed generation token ``gen``.
@@ -197,6 +250,12 @@ class DeviceStager:
                     self._cache.move_to_end(key)
                     self.hits += 1
                     metrics.count(metrics.STAGER_HITS)
+                    if not prefetch and key in self._prefetched:
+                        # a real query reached a speculatively staged
+                        # block — the prefetch paid off
+                        self._prefetched.discard(key)
+                        self.prefetch_used += 1
+                        metrics.count(metrics.PREFETCH_USED)
                     self._heat_stage(frag, 0, True)
                     return ent.value
                 epoch = self._epoch
@@ -269,6 +328,14 @@ class DeviceStager:
                         metrics.count(metrics.STAGER_RESTAGED_BYTES, nbytes)
                     with self._mu:
                         self.misses += 1
+                        reentry = stale is None and key in self._evicted_keys
+                        if reentry:
+                            self._evicted_keys.discard(key)
+                    if reentry:
+                        # capacity-eviction re-entry: an upload already
+                        # paid for once — the bytes tiering (T1 +
+                        # compressed upload) exists to cheapen
+                        metrics.count(metrics.STAGER_RESTAGED_BYTES, nbytes)
             except BaseException as e:
                 with self._mu:
                     # identity check mirrors the success path: an
@@ -296,6 +363,15 @@ class DeviceStager:
                         gov_return += old.nbytes
                     self._cache[key] = _Entry(value, nbytes, built_gen)
                     self._bytes += nbytes
+                    if prefetch:
+                        self._prefetched.add(key)
+                        self.prefetch_issued += 1
+                        metrics.count(metrics.PREFETCH_ISSUED)
+                    else:
+                        # a real rebuild at a previously-prefetched key
+                        # (delta/invalidation): the speculative copy is
+                        # gone, stop attributing this key
+                        self._prefetched.discard(key)
                     # evict LRU past the tenant share — and past the
                     # GLOBAL budget (over_budget already nets out the
                     # gov_return bytes released below)
@@ -303,9 +379,10 @@ class DeviceStager:
                         self._bytes > self.budget_bytes
                         or (gov is not None and gov.over_budget() > gov_return)
                     ) and len(self._cache) > 1:
-                        _, old_ent = self._cache.popitem(last=False)
+                        old_key, old_ent = self._cache.popitem(last=False)
                         self._bytes -= old_ent.nbytes
                         gov_return += old_ent.nbytes
+                        self._note_evicted_locked(old_key)
                     self._inflight.pop(key, None)
                     metrics.gauge(metrics.STAGER_BYTES, self._bytes)
                 else:
@@ -349,10 +426,151 @@ class DeviceStager:
             return put_sharded(self.mesh, w32)
         return jax.device_put(w32, self.device)
 
+    # -- tiered dense builds (executor/tiering.py) ---------------------------
+
+    def _tiering_on(self) -> bool:
+        return self.tier1 is not None or self.compressed_min_ratio > 0
+
+    def _container_entries(self, frag, row_ids):
+        """Container payloads for ``row_ids``, T1-first: a hit skips
+        the fragment walk entirely; a miss walks T2 (the mmapped
+        fragment) and offers the result to T1 with the walk's measured
+        cost — the admission model's "what a hit saves"."""
+        t1 = self.tier1
+        if t1 is not None:
+            entries = t1.get(frag, row_ids)
+            if entries is not None:
+                return entries
+        gen = frag.generation  # before the walk: content at least this fresh
+        t0 = time.monotonic()
+        entries, nbytes = frag.container_blocks(list(row_ids))
+        cost = time.monotonic() - t0
+        if t1 is not None:
+            t1.put(frag, row_ids, entries, nbytes, gen, cost)
+        return entries
+
+    def _dense_from_blocks(self, frag, row_ids, rows_total: int):
+        """Dense staged block for ``row_ids`` (zero-padded to
+        ``rows_total`` rows) built from container payloads instead of a
+        fragment word walk. Returns (flat device u32[rows_total * W],
+        dense_nbytes — the device-resident size the governor is
+        charged). When the dense/payload ratio clears
+        ``compressed_min_ratio`` the wire carries the payloads and
+        ops.expand_blocks rebuilds packed words on device; otherwise
+        the dense block is assembled on host and uploaded as before."""
+        entries = self._container_entries(frag, row_ids)
+        num_words = rows_total * _W32
+        dense_nbytes = num_words * 4
+        cbytes = sum(p.nbytes for _, _, _, p in entries)
+        if (
+            self.compressed_min_ratio > 0
+            and cbytes
+            # global bit coordinates must stay inside u32 (and word
+            # indexes inside the scatter kernel's i32 cast)
+            and rows_total <= _MAX_COMPRESSED_ROWS
+            and dense_nbytes >= self.compressed_min_ratio * cbytes
+        ):
+            return self._compressed_upload(entries, num_words), dense_nbytes
+        from pilosa_tpu.roaring.bitmap import (
+            CONTAINER_ARRAY,
+            CONTAINER_RUN,
+            Container,
+        )
+
+        words32 = np.zeros((rows_total, _W32), dtype="<u4")
+        for i, slot, typ, payload in entries:
+            if typ == CONTAINER_ARRAY:
+                w64 = Container.from_array(payload).words()
+            elif typ == CONTAINER_RUN:
+                w64 = Container.from_runs(payload).words()
+            else:
+                w64 = payload
+            lo = slot << 11  # 2048 u32 words per 2^16-bit container
+            words32[i, lo : lo + 2048] = np.ascontiguousarray(w64).view("<u4")
+        return jax.device_put(words32.reshape(-1), self.device), dense_nbytes
+
+    def _compressed_upload(self, entries, num_words: int):
+        """Ship container payloads and expand on device: every entry's
+        bits become coordinates in the block's flat bit space
+        (row_index * SHARD_WIDTH + slot * 2^16 + local) and the jit
+        scatter kernel (ops.packed.expand_blocks) ORs them into packed
+        words. Input shapes are pow2-bucketed to bound recompiles;
+        padding uses coordinates the kernel provably drops (positions
+        0xFFFFFFFF → out-of-range word; runs with start > end; dense
+        rows aimed at num_words)."""
+        from pilosa_tpu.executor.batcher import _next_pow2
+        from pilosa_tpu.roaring.bitmap import CONTAINER_ARRAY, CONTAINER_RUN
+
+        pos_l, rs_l, re_l, dense_l, dw_l = [], [], [], [], []
+        uploaded = 0
+        for i, slot, typ, payload in entries:
+            base = np.uint32(i * SHARD_WIDTH + (slot << 16))
+            if typ == CONTAINER_ARRAY:
+                pos_l.append(base + payload.astype(np.uint32))
+            elif typ == CONTAINER_RUN:
+                rs_l.append(base + payload[:, 0].astype(np.uint32))
+                re_l.append(base + payload[:, 1].astype(np.uint32))
+            else:
+                dense_l.append(np.ascontiguousarray(payload).view("<u4"))
+                dw_l.append(i * _W32 + (slot << 11))
+
+        def bucketed(parts, fill, dtype):
+            a = (
+                np.concatenate(parts).astype(dtype, copy=False)
+                if parts
+                else np.empty(0, dtype)
+            )
+            out = np.full(_next_pow2(max(a.size, 1)), fill, dtype)
+            out[: a.size] = a
+            return out
+
+        positions = bucketed(pos_l, 0xFFFFFFFF, np.uint32)
+        starts = bucketed(rs_l, 1, np.uint32)
+        ends = bucketed(re_l, 0, np.uint32)
+        d = len(dense_l)
+        dense = np.zeros((_next_pow2(max(d, 1)), 2048), dtype=np.uint32)
+        dword = np.full(dense.shape[0], num_words, dtype=np.int32)
+        for k, row in enumerate(dense_l):
+            dense[k] = row
+        if d:
+            dword[:d] = np.asarray(dw_l, dtype=np.int32)
+        dev = self.device
+        out = ops.expand_blocks(
+            jax.device_put(positions, dev),
+            jax.device_put(starts, dev),
+            jax.device_put(ends, dev),
+            jax.device_put(dense, dev),
+            jax.device_put(dword, dev),
+            num_words=num_words,
+        )
+        uploaded = (
+            positions.nbytes
+            + starts.nbytes
+            + ends.nbytes
+            + dense.nbytes
+            + dword.nbytes
+        )
+        metrics.count(metrics.TIERING_COMPRESSED_UPLOADS)
+        metrics.count(
+            metrics.TIERING_UPLOAD_BYTES_SAVED,
+            max(0, num_words * 4 - uploaded),
+        )
+        return out
+
     # -- delta helpers -------------------------------------------------------
 
-    def _fallback(self, reason: str) -> None:
-        metrics.count(metrics.STAGER_DELTA_FALLBACK, reason=reason)
+    def _fallback(self, reason: str, form: Optional[str] = None) -> None:
+        if form is None:
+            metrics.count(metrics.STAGER_DELTA_FALLBACK, reason=reason)
+            return
+        # sparse_form alone says "a block-sparse layout re-staged" but
+        # not WHICH — the form rides as a second label and on the
+        # current trace stage so a tail of full re-stages is
+        # attributable to the layout that caused it (ISSUE 17 s2)
+        metrics.count(metrics.STAGER_DELTA_FALLBACK, reason=reason, form=form)
+        sp = trace.current()
+        if sp is not None:
+            sp.annotate(fallback_form=form)
 
     def _deltas(self, frag, since_gen):
         """Fragment delta stream since ``since_gen`` split into row /
@@ -397,11 +615,16 @@ class DeviceStager:
 
     # -- staging entry points --
 
-    def row(self, frag, row_id: int):
-        """u32[W] for one row."""
+    def row(self, frag, row_id: int, prefetch: bool = False):
+        """u32[W] for one row. ``prefetch=True`` marks a speculative
+        build (plan-driven prefetcher, executor/tiering.py) for the
+        accuracy counters."""
 
         def build():
             gen = frag.generation
+            if self._tiering_on():
+                dev, nbytes = self._dense_from_blocks(frag, (row_id,), 1)
+                return dev, nbytes, gen
             words = frag.row_words(row_id)
             return self._to_device(words), words.nbytes, gen
 
@@ -421,6 +644,7 @@ class DeviceStager:
             build,
             delta,
             frag=frag,
+            prefetch=prefetch,
         )
 
     def _delta_for_slots(self, frag, slot_of: dict, n_rows_staged: int):
@@ -469,6 +693,9 @@ class DeviceStager:
 
         def build():
             gen = frag.generation
+            if self._tiering_on() and row_ids:
+                dev, nbytes = self._dense_from_blocks(frag, row_ids, nrows)
+                return dev.reshape(nrows, _W32), nbytes, gen
             words = frag.packed_rows(list(row_ids))
             if pad_pow2 and len(row_ids):
                 target = _next_pow2(words.shape[0])
@@ -522,15 +749,20 @@ class DeviceStager:
             self._key(frag, "sparse_rows", (row_ids,)),
             frag.generation,
             build,
-            self._sparse_fallback,
+            self._sparse_fallback_for("sparse_rows"),
             frag=frag,
         )
 
-    def _sparse_fallback(self, old, old_gen):
+    def _sparse_fallback_for(self, form: str):
         """Documented non-path: block-sparse forms always re-stage on a
-        generation mismatch (see sparse_rows)."""
-        self._fallback("sparse_form")
-        return None
+        generation mismatch (see sparse_rows). ``form`` names the
+        concrete layout so the fallback metric/trace say which one."""
+
+        def fallback(old, old_gen):
+            self._fallback("sparse_form", form=form)
+            return None
+
+        return fallback
 
     def matrix(self, frag):
         """(row_ids, u32[R, W]) for all non-empty rows."""
@@ -594,6 +826,11 @@ class DeviceStager:
 
         def build():
             gen = frag.generation
+            if self._tiering_on():
+                dev, nbytes = self._dense_from_blocks(
+                    frag, tuple(range(bit_depth + 1)), bit_depth + 1
+                )
+                return dev.reshape(bit_depth + 1, _W32), nbytes, gen
             words = frag.bsi_planes(bit_depth)
             return self._to_device(words), words.nbytes, gen
 
@@ -750,7 +987,7 @@ class DeviceStager:
             self._stack_key(frags, "sparse_stack", (chunk, ids_by_shard)),
             self._stack_gen(frags),
             build,
-            self._sparse_fallback,
+            self._sparse_fallback_for("sparse_stack"),
             frag=frags,
         )
 
@@ -815,7 +1052,7 @@ class DeviceStager:
             self._stack_key(frags, "sparse_rows_stack", (k, ids_by_shard)),
             self._stack_gen(frags),
             build,
-            self._sparse_fallback,
+            self._sparse_fallback_for("sparse_rows_stack"),
             frag=frags,
         )
 
@@ -880,8 +1117,22 @@ class DeviceStager:
                 thunk = self._ahead_q.popleft()
             try:
                 thunk()
-            except BaseException:
-                pass  # advisory: the query path stages for real
+            except BaseException as e:
+                # advisory — the query path stages for real — but NOT
+                # invisible: a prefetcher that always raises would
+                # otherwise look like one that never fires. Count every
+                # failure; journal the first per exception type so the
+                # event log has a sample without flooding.
+                self.ahead_errors += 1
+                metrics.count(metrics.STAGER_AHEAD_ERRORS)
+                reason = type(e).__name__
+                if reason not in self._ahead_err_seen:
+                    self._ahead_err_seen.add(reason)
+                    events.record(
+                        events.STAGER_AHEAD_ERROR,
+                        reason=reason,
+                        error=str(e)[:200],
+                    )
 
     def set_governor(self, governor) -> None:
         """Attach the process-wide HBM governor (executor/hbm.py): the
@@ -901,6 +1152,10 @@ class DeviceStager:
             current = self._bytes
         if current:
             governor.reserve("stager", current)
+        if self.tier1 is not None:
+            # host-domain tenant: visible in /debug/hbm, outside the
+            # device budget (executor/hbm.py domains)
+            self.tier1.set_governor(governor)
 
     def _evict_cold(self, need: int) -> int:
         """Governor relief tier: drop cold (LRU) staged blocks until
@@ -911,9 +1166,10 @@ class DeviceStager:
         freed = 0
         with self._mu:
             while freed < need and len(self._cache) > 1:
-                _, ent = self._cache.popitem(last=False)
+                k, ent = self._cache.popitem(last=False)
                 self._bytes -= ent.nbytes
                 freed += ent.nbytes
+                self._note_evicted_locked(k)
             if freed:
                 metrics.gauge(metrics.STAGER_BYTES, self._bytes)
         if freed and self.governor is not None:
@@ -928,8 +1184,17 @@ class DeviceStager:
             # value to current waiters through the _InFlight object, but
             # nothing stale survives here if one errors after clear().
             self._inflight.clear()
+            # explicit clears aren't cache pressure — forget prefetch
+            # attribution without charging the accuracy counters, and
+            # re-entry attribution with it
+            self._prefetched.clear()
+            self._evicted_keys.clear()
         if self.governor is not None:
             self.governor.reset("stager")
+        if self.tier1 is not None:
+            # fragment identities may be recycled after a clear (holder
+            # restore paths) — host payloads keyed by id() must go too
+            self.tier1.clear()
 
     def reset_after_wedge(self) -> None:
         """Recover from a device wedge (called by the health gate on
@@ -944,6 +1209,8 @@ class DeviceStager:
             self._cache.clear()
             self._bytes = 0
             self._epoch += 1  # zombie builders must not repopulate
+            self._prefetched.clear()  # a wedge isn't cache pressure
+            self._evicted_keys.clear()
             stale, self._inflight = self._inflight, {}
         # the ledger must forget the dead runtime's arrays with us —
         # the epoch fence extends to the governor (ISSUE 14)
@@ -962,3 +1229,8 @@ class DeviceStager:
         from the current holder state. Same mechanics as a device
         wedge: epoch bump fences zombie builders."""
         self.reset_after_wedge()
+        if self.tier1 is not None:
+            # re-synced host fragments invalidate T1 payloads too (a
+            # device wedge alone does not — those stay warm for the
+            # recovery restage)
+            self.tier1.clear()

@@ -287,6 +287,69 @@ def device_put_rows(words64_rows: np.ndarray, device=None) -> jax.Array:
     return jax.device_put(w32, device)
 
 
+# -- on-device roaring expansion (tiered staging, ISSUE 17) ------------------
+#
+# Cold blocks cross PCIe at roaring size instead of packed-word size:
+# the host uploads the raw container coordinates (array positions, RLE
+# run endpoints, dense bitmap words) and ONE fused scatter program
+# expands them to packed u32 words on device. Coordinates are global
+# bit offsets into the output (row_index * SHARD_WIDTH + slot * 2^16 +
+# local), so one dispatch serves a whole stacked block. All
+# contributions are bitwise-disjoint (containers own disjoint word
+# ranges; positions/runs within a container are unique/disjoint), so
+# scatter-add IS bitwise-or — exact, and add lowers to the cheap
+# combiner everywhere. Padding convention (ops/delta.py pad_updates):
+# positions pad with 0xFFFFFFFF and word indexes pad with num_words,
+# both of which land out of bounds and drop under mode="drop".
+
+_FULL32 = 0xFFFFFFFF
+
+
+@functools.partial(jax.jit, static_argnames=("num_words",))
+def expand_blocks(positions, run_starts, run_ends, dense, dense_word, num_words: int):
+    """Expand compressed roaring buffers to packed words on device.
+
+    positions: u32[P] global bit offsets of array-container bits (pad
+    0xFFFFFFFF); run_starts/run_ends: u32[N] inclusive global bit
+    endpoints of RLE runs (pad with starts > ends); dense: u32[D, 2048]
+    raw bitmap-container words with dense_word: i32[D] global word
+    offsets (pad num_words). Returns u32[num_words]; callers reshape to
+    (rows, WORDS_PER_ROW). num_words must stay below 2^27 so the
+    0xFFFFFFFF position pad is out of bounds after >> 5 (67M words for
+    the 2047-row i32 coordinate guard — callers clamp).
+    """
+    words = jnp.zeros((num_words,), jnp.uint32)
+    # array containers: one bit per position
+    widx = (positions >> 5).astype(jnp.int32)
+    mask = jnp.uint32(1) << (positions & 31)
+    words = words.at[widx].add(mask, mode="drop")
+    # RLE runs, decomposed: partial head/tail word masks scattered by
+    # index, full interior words via a +1/-1 diff array + cumsum
+    valid = run_starts <= run_ends
+    ws = (run_starts >> 5).astype(jnp.int32)
+    we = (run_ends >> 5).astype(jnp.int32)
+    sbit = run_starts & 31
+    ebit = run_ends & 31
+    same = ws == we
+    head = jnp.uint32(_FULL32) << sbit
+    tail = jnp.uint32(_FULL32) >> (31 - ebit)
+    oob = jnp.int32(num_words)
+    words = words.at[jnp.where(valid, ws, oob)].add(
+        head & jnp.where(same, tail, jnp.uint32(_FULL32)), mode="drop"
+    )
+    words = words.at[jnp.where(valid & ~same, we, oob)].add(tail, mode="drop")
+    interior = valid & (we > ws + 1)
+    diff = jnp.zeros((num_words + 1,), jnp.int32)
+    pad = jnp.int32(num_words + 1)
+    diff = diff.at[jnp.where(interior, ws + 1, pad)].add(1, mode="drop")
+    diff = diff.at[jnp.where(interior, we, pad)].add(-1, mode="drop")
+    cover = jnp.cumsum(diff)[:num_words] > 0
+    words = words | jnp.where(cover, jnp.uint32(_FULL32), jnp.uint32(0))
+    # dense bitmap containers: raw word blocks at their word offsets
+    didx = dense_word[:, None] + jnp.arange(dense.shape[1], dtype=jnp.int32)[None, :]
+    return words.at[didx].add(dense, mode="drop")
+
+
 # -- dispatch-engine support ------------------------------------------------
 
 

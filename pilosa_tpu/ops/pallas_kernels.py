@@ -124,6 +124,60 @@ def intersection_counts_matrix_batch_pallas(srcs, mat, *, interpret: bool = Fals
     )(srcs, mat)
 
 
+def _expand_runs_kernel(starts_ref, ends_ref, out_ref):
+    # One (1, TILE_W) word tile per grid step; every run clamps its
+    # [start, end] bit interval against each word's 32-bit span and
+    # ORs in the overlap mask. Runs are few (RLE containers cap at
+    # 2048 intervals) while words are many, so the run loop stays
+    # sequential and the word axis rides the VPU lanes.
+    i = pl.program_id(0)
+    full = jnp.uint32(0xFFFFFFFF)
+    wid = i * TILE_W + jax.lax.broadcasted_iota(jnp.int32, (1, TILE_W), 1)
+    word_lo = wid * 32
+    word_hi = word_lo + 31
+    starts = starts_ref[:]
+    ends = ends_ref[:]
+
+    def body(k, acc):
+        lo = jnp.maximum(starts[0, k], word_lo)
+        hi = jnp.minimum(ends[0, k], word_hi)
+        sb = jnp.clip(lo - word_lo, 0, 31).astype(jnp.uint32)
+        eb = jnp.clip(hi - word_lo, 0, 31).astype(jnp.uint32)
+        m = (full << sb) & (full >> (31 - eb))
+        return acc | jnp.where(lo <= hi, m, jnp.uint32(0))
+
+    out_ref[:] = jax.lax.fori_loop(
+        0, starts.shape[1], body, jnp.zeros((1, TILE_W), jnp.uint32)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_words", "interpret"))
+def expand_runs_pallas(run_starts, run_ends, num_words: int, *, interpret: bool = False):
+    """On-device roaring RLE expansion: i32[N] inclusive global bit
+    endpoints -> packed u32[num_words] (array-container positions ride
+    along as width-1 runs). num_words must be a multiple of TILE_W (a
+    row is 32768 words, so stacked rows always are); pad the run list
+    with start > end — an empty interval contributes nothing. The jit
+    scatter fallback (ops.packed.expand_blocks) covers CPU/interpret
+    mode and dense bitmap containers."""
+    n = run_starts.shape[0]
+    grid = (num_words // TILE_W,)
+    out = pl.pallas_call(
+        _expand_runs_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, num_words), jnp.uint32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, TILE_W), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(run_starts.reshape(1, n), run_ends.reshape(1, n))
+    return out[0]
+
+
 def pad_for_pallas(mat):
     """Pad rows to TILE_R and words to TILE_W multiples."""
     import numpy as np

@@ -95,6 +95,31 @@ def subtree_fields(c: Call) -> Optional[frozenset]:
     return None  # writes / unknown calls
 
 
+def extract_row_operands(calls) -> list[tuple[str, int]]:
+    """(field, row_id) for every plain Row leaf under ``calls`` — the
+    plan-driven prefetcher's staging list (executor/tiering.py). Only
+    leaves the stager can promote as a standard-view row block qualify;
+    malformed or range-style Rows are skipped, never raised."""
+    out: list[tuple[str, int]] = []
+
+    def walk(c: Call) -> None:
+        if c.name == "Row" and not c.children:
+            try:
+                field = c.field_arg()
+                row_id, ok = c.uint_arg(field)
+            except (ValueError, TypeError):
+                return
+            if ok:
+                out.append((field, int(row_id)))
+            return
+        for ch in c.children:
+            walk(ch)
+
+    for c in calls:
+        walk(c)
+    return out
+
+
 def generation_vector(holder, index: str, fields, shards) -> tuple:
     """((field, view, shard, generation), ...) for every EXISTING
     fragment of the referenced fields over the shard set. A write bumps

@@ -233,6 +233,24 @@ class Config:
     dispatch_max_inflight: int = 2
     # how many waves ahead the stager prefetches operand rows (0 = off)
     dispatch_stage_ahead: int = 1
+    # tiered block staging (executor/tiering.py): host-RAM byte budget
+    # for T1, the compressed roaring-container tier between device LRU
+    # (T0) and the mmapped fragment (T2). A T0 miss that hits T1 skips
+    # the fragment walk; admission is cost-modeled (heat × rebuild cost
+    # per byte). 0 disables the tier.
+    tier1_max_bytes: int = 256 << 20
+    # plan-driven speculative prefetch: the dispatch engine hands queued
+    # waves' plans to a scheduler that promotes their Row blocks
+    # T1/T2 → T0 ahead of compute, with used-vs-evicted accuracy
+    # accounting (replaces the thunk-based advisory warm)
+    prefetch_enabled: bool = True
+    # how many waves ahead the prefetcher looks in the dispatch queue
+    prefetch_depth: int = 2
+    # compressed-upload crossover: when a block's dense bytes are at
+    # least this multiple of its container payload bytes, the payloads
+    # cross the wire and a device kernel expands them to packed words
+    # (ops.expand_blocks); 0 always uploads dense
+    compressed_upload_min_ratio: float = 4.0
     # plan result cache (plan/cache.py): generation-stamped cross-request
     # result cache between parsing and execution. Entries are keyed by
     # canonical plan hash + shard set and validated against fragment
@@ -416,6 +434,10 @@ class Config:
             f"dispatch-max-wave = {self.dispatch_max_wave}",
             f"dispatch-max-inflight = {self.dispatch_max_inflight}",
             f"dispatch-stage-ahead = {self.dispatch_stage_ahead}",
+            f"tier1-max-bytes = {self.tier1_max_bytes}",
+            f"prefetch-enabled = {'true' if self.prefetch_enabled else 'false'}",
+            f"prefetch-depth = {self.prefetch_depth}",
+            f"compressed-upload-min-ratio = {self.compressed_upload_min_ratio}",
             f"plan-cache-enabled = {'true' if self.plan_cache_enabled else 'false'}",
             f"plan-cache-max-bytes = {self.plan_cache_max_bytes}",
             f"plan-cache-min-cost = {self.plan_cache_min_cost}",

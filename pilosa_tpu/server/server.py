@@ -184,6 +184,8 @@ class Server:
             mesh=self.mesh,
             delta_enabled=self.config.stager_delta_enabled,
             delta_max_ratio=self.config.stager_delta_max_ratio,
+            tier1_max_bytes=self.config.tier1_max_bytes,
+            compressed_min_ratio=self.config.compressed_upload_min_ratio,
         )
         # the delta log capacity rides on the fragment class (fragments
         # are created deep inside the holder tree; a process-wide
@@ -249,6 +251,8 @@ class Server:
             dispatch_max_wave=self.config.dispatch_max_wave,
             dispatch_max_inflight=self.config.dispatch_max_inflight,
             dispatch_stage_ahead=self.config.dispatch_stage_ahead,
+            prefetch_enabled=self.config.prefetch_enabled,
+            prefetch_depth=self.config.prefetch_depth,
             fusion_enabled=self.config.fusion_enabled,
             fusion_max_calls=self.config.fusion_max_calls,
             plan_cache_device_bytes=self.config.plan_cache_device_bytes,
@@ -475,6 +479,8 @@ class Server:
             mesh=mesh,
             delta_enabled=self.config.stager_delta_enabled,
             delta_max_ratio=self.config.stager_delta_max_ratio,
+            tier1_max_bytes=self.config.tier1_max_bytes,
+            compressed_min_ratio=self.config.compressed_upload_min_ratio,
         )
         ex = self.executor
         if self.multihost is None or not self.multihost.federated:

@@ -71,6 +71,9 @@ SCRUB_REPAIR = "scrub.repair"
 SCRUB_UNRECOVERABLE = "scrub.unrecoverable"
 ANTI_ENTROPY_ERROR = "antientropy.error"
 RESTORE_REFUSED = "restore.refused"
+# tiered block staging (ISSUE 17): the stage-ahead loop's first error
+# per reason — the loop itself survives and counts every error
+STAGER_AHEAD_ERROR = "stager.ahead_error"
 
 # kind → one-line description; the docs/administration.md event-kind
 # catalog is sync-tested against this registry both directions, so a
@@ -97,6 +100,7 @@ EVENT_KINDS: dict = {
     SCRUB_UNRECOVERABLE: "corrupt fragment has no healthy replica to repair from",
     ANTI_ENTROPY_ERROR: "anti-entropy sweep failed against a replica",
     RESTORE_REFUSED: "backup archive failed checksum verification; restore refused",
+    STAGER_AHEAD_ERROR: "a stage-ahead prefetch thunk raised (first per reason)",
 }
 
 

@@ -157,6 +157,19 @@ class HeatLedger:
             return c[_HEAT]
         return c[_HEAT] * 0.5 ** (dt / self.halflife)
 
+    def score(self, index: str, field: str, shard: int) -> float:
+        """Decayed EWMA heat of one cell, 0.0 when untracked — the T1
+        admission cost model reads this on every candidate, so it is
+        one dict probe + one decay under the lock."""
+        if not self.enabled:
+            return 0.0
+        now = time.monotonic()
+        with self._mu:
+            c = self._cells.get((index, field, shard))
+            if c is None:
+                return 0.0
+            return self._decayed(c, now)
+
     def snapshot(
         self, index: str = "", dim: str = "heat", top_k: int = 10
     ) -> dict:

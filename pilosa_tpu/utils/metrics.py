@@ -102,6 +102,21 @@ STAGER_RESTAGED_BYTES = "stager.restaged_bytes"
 STAGER_DELTA_APPLIED = "stager.delta_applied"
 STAGER_DELTA_FALLBACK = "stager.delta_fallback"
 STAGER_DELTA_APPLY_SECONDS = "stager.delta_apply_seconds"
+STAGER_AHEAD_ERRORS = "stager.ahead_errors"
+# tiered block staging (ISSUE 17, executor/tiering.py): the host-RAM
+# compressed tier (T1), compressed-upload-then-expand, and the
+# plan-driven prefetcher's accuracy counters
+TIER1_HITS = "tiering.tier1_hits"
+TIER1_MISSES = "tiering.tier1_misses"
+TIER1_BYTES = "tiering.tier1_bytes"
+TIER1_ADMITTED = "tiering.tier1_admitted"
+TIER1_REJECTED = "tiering.tier1_rejected"
+TIER1_EVICTED = "tiering.tier1_evicted"
+TIERING_COMPRESSED_UPLOADS = "tiering.compressed_uploads"
+TIERING_UPLOAD_BYTES_SAVED = "tiering.upload_bytes_saved"
+PREFETCH_ISSUED = "tiering.prefetch_issued"
+PREFETCH_USED = "tiering.prefetch_used"
+PREFETCH_EVICTED = "tiering.prefetch_evicted"
 # TopN rank/LRU caches
 CACHE_HITS = "cache.hits"
 CACHE_MISSES = "cache.misses"
@@ -285,8 +300,9 @@ METRICS: dict[str, tuple[str, str]] = {
     STAGER_BYTES: ("gauge", "bytes resident in the HBM staging cache"),
     STAGER_RESTAGED_BYTES: (
         "counter",
-        "bytes rebuilt + re-uploaded on invalidation misses — the cost "
-        "delta staging exists to avoid",
+        "bytes rebuilt + re-uploaded that an earlier stage already paid "
+        "for: invalidation misses (the cost delta staging avoids) and "
+        "capacity-eviction re-entries (the cost tiering cheapens)",
     ),
     STAGER_DELTA_APPLIED: (
         "counter",
@@ -296,11 +312,73 @@ METRICS: dict[str, tuple[str, str]] = {
     STAGER_DELTA_FALLBACK: (
         "counter",
         "generation-mismatched blocks that fell back to a full re-stage "
-        "(label: reason = log | ratio | shape | sparse_form | multihost)",
+        "(label: reason = log | ratio | shape | sparse_form | multihost; "
+        "sparse_form also carries label: form = the concrete block-"
+        "sparse form that has no delta path)",
     ),
     STAGER_DELTA_APPLY_SECONDS: (
         "summary",
         "host mask coalesce + device scatter time per delta apply",
+    ),
+    STAGER_AHEAD_ERRORS: (
+        "counter",
+        "prefetch thunks that raised inside the stage-ahead loop (the "
+        "loop survives; first error per reason also journals "
+        "stager.ahead_error)",
+    ),
+    TIER1_HITS: (
+        "counter",
+        "T0 misses served from the host-RAM compressed tier (T1) "
+        "instead of a fragment walk",
+    ),
+    TIER1_MISSES: (
+        "counter",
+        "T0 misses that also missed T1 and rebuilt from the mmapped "
+        "fragment (T2)",
+    ),
+    TIER1_BYTES: (
+        "gauge",
+        "serialized roaring-container bytes resident in the host-RAM "
+        "compressed tier (T1)",
+    ),
+    TIER1_ADMITTED: (
+        "counter",
+        "blocks admitted into T1 by the cost-model (bytes x rebuild-cost "
+        "vs EWMA heat) admission policy",
+    ),
+    TIER1_REJECTED: (
+        "counter",
+        "blocks the T1 admission policy refused (evicting hotter "
+        "entries would cost more than the candidate is worth)",
+    ),
+    TIER1_EVICTED: (
+        "counter",
+        "T1 entries evicted (LRU byte pressure or generation staleness)",
+    ),
+    TIERING_COMPRESSED_UPLOADS: (
+        "counter",
+        "staged blocks uploaded as compressed roaring containers and "
+        "expanded to packed words on device (ratio cleared "
+        "compressed-upload-min-ratio)",
+    ),
+    TIERING_UPLOAD_BYTES_SAVED: (
+        "counter",
+        "PCIe bytes saved by compressed uploads: packed-word size minus "
+        "the compressed buffers actually transferred",
+    ),
+    PREFETCH_ISSUED: (
+        "counter",
+        "blocks the plan-driven prefetcher staged ahead of compute "
+        "(next-wave operands promoted from T1/T2)",
+    ),
+    PREFETCH_USED: (
+        "counter",
+        "prefetched blocks later hit by a real query before eviction — "
+        "the prefetch-accuracy numerator",
+    ),
+    PREFETCH_EVICTED: (
+        "counter",
+        "prefetched blocks evicted unused — wasted prefetch bandwidth",
     ),
     CACHE_HITS: ("counter", "TopN rank/LRU cache hits"),
     CACHE_MISSES: ("counter", "TopN rank/LRU cache misses"),

@@ -364,6 +364,22 @@ class TestJitPurity:
         src = "def k(x):\n    return time.time()\n"
         assert run_rule(src, "jit-purity") == []
 
+    def test_expansion_kernels_lint_clean(self):
+        """The compressed-upload expansion kernels (ops.packed
+        expand_blocks jit scatter, ops.pallas_kernels expand_runs_pallas)
+        stay jit-pure — no wall-clock, host RNG, metrics, or locks
+        inside the traced bodies."""
+        import os
+
+        root = os.path.join(
+            os.path.dirname(__file__), "..", "pilosa_tpu", "ops"
+        )
+        for rel in ("packed.py", "pallas_kernels.py"):
+            with open(os.path.join(root, rel)) as fp:
+                src = fp.read()
+            fs = run_rule(src, "jit-purity", relpath=f"pilosa_tpu/ops/{rel}")
+            assert fs == [], "\n".join(f.format() for f in fs)
+
 
 # -- donation-safety ---------------------------------------------------------
 

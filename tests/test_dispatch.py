@@ -608,15 +608,18 @@ class TestServerSurface:
 
 class TestStageAhead:
     def test_stage_ahead_warms_queued_rows(self, holder):
-        """The stage-ahead hook fires at wave launch for items still
-        queued behind the wave; warming is advisory (errors swallowed,
-        execution correct regardless)."""
+        """The legacy thunk-based stage-ahead hook fires at wave launch
+        for items still queued behind the wave; warming is advisory
+        (errors swallowed, execution correct regardless). The
+        plan-driven prefetcher (the default) is covered by
+        test_plan_driven_prefetcher_stages_queued_operands."""
         seed_mixed(holder)
         # max_wave=1 so each launch leaves the rest of the backlog
         # queued — that leftover is what the peek prefetches
         ex = Executor(
             holder, device_policy="always", dispatch_enabled=True,
             dispatch_max_inflight=1, dispatch_max_wave=1,
+            prefetch_enabled=False,
         )
         orig = ex._execute
         gate = threading.Event()
@@ -655,6 +658,65 @@ class TestStageAhead:
             while not warmed and time.monotonic() < deadline:
                 time.sleep(0.01)
             assert warmed  # the async stage-ahead hook really ran
+            oracle = Executor(
+                holder, device_policy="never", dispatch_enabled=False
+            )
+            for i in range(3):
+                assert res[i] == oracle.execute("i", f"Count(Row(f={i + 3}))")
+        finally:
+            gate.set()
+            ex.close()
+
+    def test_plan_driven_prefetcher_stages_queued_operands(self, holder):
+        """With the prefetcher enabled (the default), wave launch hands
+        queued items' PLANS to the scheduler, which stages exactly the
+        operand rows they name — observable as prefetch_issued on the
+        stager and scheduled on the prefetcher; results stay
+        bit-identical to the CPU oracle."""
+        seed_mixed(holder)
+        ex = Executor(
+            holder, device_policy="always", dispatch_enabled=True,
+            dispatch_max_inflight=1, dispatch_max_wave=1,
+            prefetch_enabled=True,
+        )
+        assert ex.prefetcher is not None and ex.prefetcher.enabled
+        orig = ex._execute
+        gate = threading.Event()
+        first = threading.Event()
+
+        def gated(index, query, shards=None, opt=None):
+            if not first.is_set():
+                first.set()
+                assert gate.wait(10), "test gate never released"
+            return orig(index, query, shards, opt)
+
+        ex._execute = gated
+        try:
+            blocker = threading.Thread(
+                target=lambda: ex.execute("i", "Count(Row(f=0))")
+            )
+            blocker.start()
+            assert first.wait(10)
+            res = {}
+
+            def client(i):
+                res[i] = ex.execute("i", f"Count(Row(f={i + 3}))")
+
+            ts = [threading.Thread(target=client, args=(i,)) for i in range(3)]
+            for t in ts:
+                t.start()
+            _wait_queued(ex.dispatch_engine, 3)
+            gate.set()
+            for t in ts:
+                t.join()
+            blocker.join()
+            deadline = time.monotonic() + 2.0
+            while ex.prefetcher.scheduled == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert ex.prefetcher.scheduled > 0
+            st = ex.dispatch_engine.stats()
+            assert st["prefetch"]["enabled"] is True
+            assert st["prefetch"]["scheduled"] == ex.prefetcher.scheduled
             oracle = Executor(
                 holder, device_policy="never", dispatch_enabled=False
             )

@@ -468,3 +468,55 @@ def test_small_import_block_pairs_rides_wave_path(holder):
     assert frag.row(0).columns().tolist() == [2, 3, 4, 5, 6]
     # and the delta log stayed continuous (no reset): provable deltas
     assert frag.deltas_since(gen0) is not None
+
+
+def test_wave_after_reopen_lands_in_mmapped_fragment(tmp_path):
+    """A write wave against a freshly reopened holder must open the
+    discovered fragment before mutating it. Holder.open registers
+    on-disk fragments lazily (unopened); a wave applied to the
+    unopened placeholder would report every re-set bit as changed,
+    append nothing to the op log, and lose the whole wave when the
+    first read's ensure_open() swapped in the mmapped storage."""
+    d = str(tmp_path / "d")
+    h = Holder(d)
+    h.open()
+    idx = h.create_index("i")
+    fld = idx.create_field("f")
+    v = fld.create_view_if_not_exists(VIEW_STANDARD)
+    rows = [r for r in range(6) for _ in range(2)]
+    cols = [c for r in range(6) for c in (r * 7 + 1, SHARD_WIDTH + r * 11 + 3)]
+    for shard in (0, 1):
+        sel = [i for i, c in enumerate(cols) if c // SHARD_WIDTH == shard]
+        v.create_fragment_if_not_exists(shard).apply_bit_batch(
+            [rows[i] for i in sel], [cols[i] for i in sel]
+        )
+    h.close()
+
+    h2 = Holder(d)
+    h2.open()
+    v2 = h2.field("i", "f").view(VIEW_STANDARD)
+    rows2 = [r for r in range(12) for _ in range(2)]
+    cols2 = [c for r in range(12) for c in (r * 7 + 1, SHARD_WIDTH + r * 11 + 3)]
+    changed = 0
+    for shard in (0, 1):
+        sel = [i for i, c in enumerate(cols2) if c // SHARD_WIDTH == shard]
+        changed += v2.create_fragment_if_not_exists(shard).apply_bit_batch(
+            [rows2[i] for i in sel], [cols2[i] for i in sel]
+        )
+    # rows 0-5 are already on disk: only rows 6-11 (2 bits each) change
+    assert changed == 12
+    for shard in (0, 1):
+        frag = v2.fragment(shard)
+        for r in range(12):
+            assert frag.row(r).count() == 1, f"shard {shard} row {r}"
+    h2.close()
+
+    # and the new rows were op-logged: they survive another restart
+    h3 = Holder(d)
+    h3.open()
+    v3 = h3.field("i", "f").view(VIEW_STANDARD)
+    for shard in (0, 1):
+        frag = v3.fragment(shard)
+        for r in range(12):
+            assert frag.row(r).count() == 1, f"restart: shard {shard} row {r}"
+    h3.close()

@@ -1,0 +1,430 @@
+"""Tiered block staging (ISSUE 17): the host-RAM compressed tier (T1)
+between the stager's device LRU (T0) and the mmapped fragment (T2),
+plan-driven prefetch accuracy accounting, and the compressed-upload →
+on-device-expansion path.
+
+The load-bearing claims pinned here:
+
+  * T1 admission/eviction byte accounting is exact, the cost-model
+    admission really rejects candidates colder than the LRU head, and
+    stale generations revalidate through the fragment delta log.
+  * The compressed-upload expansion kernels (ops.packed.expand_blocks,
+    ops.pallas_kernels.expand_runs_pallas) are bit-identical to the
+    host dense build for array, RLE, and bitmap containers.
+  * A hot set ~3x the stager budget serves bit-identically to the CPU
+    oracle while T1 absorbs the re-entry cost (the oversubscription
+    gauntlet).
+  * A raising stage-ahead thunk neither kills the prefetch loop nor
+    disappears: counted + journaled once per reason (ISSUE 17 s1).
+  * docs/configuration.md documents the tiering knobs with the defaults
+    the code actually uses (the test_fusion.py knob-sync idiom).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SHARD_WIDTH, ops
+from pilosa_tpu.core import FieldOptions, Holder, VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD
+from pilosa_tpu.core.field import FIELD_TYPE_INT
+from pilosa_tpu.executor import DeviceStager, Executor
+from pilosa_tpu.executor.hbm import HbmGovernor
+from pilosa_tpu.executor.tiering import Tier1Cache
+from pilosa_tpu.utils import events, metrics
+
+W32 = SHARD_WIDTH // 32
+ROW_BYTES = W32 * 4
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    yield h
+    h.close()
+
+
+def _seed_fragment(holder, rows=8, bits_per_row=50, seed=7, name="ti"):
+    idx = holder.create_index(name)
+    f = idx.create_field("f")
+    rng = np.random.default_rng(seed)
+    rids, cids = [], []
+    for r in range(rows):
+        rids += [r] * bits_per_row
+        cids += rng.integers(0, SHARD_WIDTH, size=bits_per_row).tolist()
+    f.import_bits(rids, cids)
+    return idx, f, holder.fragment(name, "f", VIEW_STANDARD, 0)
+
+
+# -- Tier1Cache unit behavior -------------------------------------------------
+
+
+class _FakeFrag:
+    """Just enough fragment surface for Tier1Cache: identity cell for
+    the heat lookup, a generation, and a delta log."""
+
+    def __init__(self):
+        self.index, self.field, self.shard = "t1", "f", 0
+        self.generation = 1
+        # None = log can't prove continuity; else (pos, is_set, gen)
+        self.deltas = None
+
+    def deltas_since(self, gen):
+        return self.deltas
+
+
+class TestTier1Cache:
+    def test_admission_eviction_byte_accounting(self):
+        t1 = Tier1Cache(300)
+        frag = _FakeFrag()
+        t1.put(frag, (0,), ["A"], nbytes=100, gen=1, cost=1.0)
+        t1.put(frag, (1,), ["B"], nbytes=100, gen=1, cost=1.0)
+        # C is worth more per byte than the LRU head (A): 2/150 > 1/100
+        assert t1.put(frag, (2,), ["C"], nbytes=150, gen=1, cost=2.0)
+        st = t1.stats()
+        assert st["entries"] == 2 and st["bytes"] == 250
+        assert st["admitted"] == 3 and st["evicted"] == 1
+        assert t1.get(frag, (0,)) is None  # A evicted LRU
+        assert t1.get(frag, (1,)) == ["B"]
+        assert t1.get(frag, (2,)) == ["C"]
+        st = t1.stats()
+        assert st["hits"] == 2 and st["misses"] == 1
+
+    def test_admission_rejects_colder_than_lru_head(self):
+        t1 = Tier1Cache(150)
+        frag = _FakeFrag()
+        assert t1.put(frag, (0,), ["hot"], nbytes=100, gen=1, cost=10.0)
+        # zero rebuild cost: evicting the 0.1-value head for it would
+        # trade retained seconds-per-byte for nothing
+        assert not t1.put(frag, (1,), ["cold"], nbytes=100, gen=1, cost=0.0)
+        st = t1.stats()
+        assert st["rejected"] == 1 and st["evicted"] == 0
+        assert st["entries"] == 1 and st["bytes"] == 100
+        assert t1.get(frag, (0,)) == ["hot"]  # undisturbed
+
+    def test_oversized_and_empty_candidates_rejected(self):
+        t1 = Tier1Cache(100)
+        frag = _FakeFrag()
+        assert not t1.put(frag, (0,), ["x"], nbytes=101, gen=1, cost=1.0)
+        assert not t1.put(frag, (1,), ["y"], nbytes=0, gen=1, cost=1.0)
+        assert t1.stats()["rejected"] == 2 and t1.stats()["bytes"] == 0
+
+    def test_stale_generation_revalidates_through_delta_log(self):
+        t1 = Tier1Cache(1000)
+        frag = _FakeFrag()
+        t1.put(frag, (0, 1), ["payload"], nbytes=100, gen=1, cost=1.0)
+        # log truncated → evict
+        frag.generation = 2
+        frag.deltas = None
+        assert t1.get(frag, (0, 1)) is None
+        assert t1.stats()["evicted"] == 1 and t1.stats()["bytes"] == 0
+        # deltas that miss every cached row leave the payloads exact:
+        # generation refreshed, subsequent gets are cheap hits
+        t1.put(frag, (0, 1), ["payload"], nbytes=100, gen=2, cost=1.0)
+        frag.generation = 3
+        frag.deltas = (
+            np.array([5 * SHARD_WIDTH + 10], np.uint64),  # row 5: not cached
+            np.array([True]),
+            3,
+        )
+        assert t1.get(frag, (0, 1)) == ["payload"]
+        frag.deltas = AssertionError  # must not be consulted again
+        assert t1.get(frag, (0, 1)) == ["payload"]
+        # a delta landing in a cached row evicts
+        frag.generation = 4
+        frag.deltas = (
+            np.array([1 * SHARD_WIDTH + 7], np.uint64),  # row 1: cached
+            np.array([True]),
+            4,
+        )
+        assert t1.get(frag, (0, 1)) is None
+        assert t1.stats()["evicted"] == 2 and t1.stats()["bytes"] == 0
+
+    def test_governor_mirror_is_host_domain(self):
+        gov = HbmGovernor(budget_bytes=1000)
+        t1 = Tier1Cache(500)
+        t1.set_governor(gov)
+        frag = _FakeFrag()
+        t1.put(frag, (0,), ["x"], nbytes=200, gen=1, cost=1.0)
+        st = gov.stats()
+        ten = st["tenants"]["tier1"]
+        assert ten["domain"] == "host" and ten["used"] == 200
+        # host tenants are ledger-visible but never count against the
+        # device budget or its relief sweeps
+        assert st["used_bytes"] == 0
+        assert gov.headroom() == 1000
+        t1.clear()
+        assert gov.stats()["tenants"]["tier1"]["used"] == 0
+
+
+# -- prefetch accuracy accounting --------------------------------------------
+
+
+class TestPrefetchAccuracy:
+    def test_prefetched_then_hit_counts_used(self, holder):
+        _, _, frag = _seed_fragment(holder)
+        st = DeviceStager()
+        st.row(frag, 0, prefetch=True)
+        assert st.prefetch_issued == 1 and st.prefetch_used == 0
+        st.row(frag, 0)  # a real query reaches the speculative block
+        assert st.prefetch_used == 1 and st.prefetch_evicted == 0
+        st.row(frag, 0)  # later hits no longer re-attribute
+        assert st.prefetch_used == 1
+
+    def test_prefetched_then_evicted_counts_wasted(self, holder):
+        _, _, frag = _seed_fragment(holder)
+        st = DeviceStager(budget_bytes=ROW_BYTES)  # one-row budget
+        st.row(frag, 0, prefetch=True)
+        st.row(frag, 1)  # over budget → LRU drops the speculative row
+        assert st.prefetch_evicted == 1 and st.prefetch_used == 0
+        st.row(frag, 0)  # rebuilt for real: no double attribution
+        assert st.prefetch_evicted == 1 and st.prefetch_used == 0
+
+    def test_capacity_reentry_counts_restaged_bytes(self, holder):
+        """A cold miss on a key previously dropped under capacity
+        pressure is a re-entry: the re-uploaded bytes land in
+        stager.restaged_bytes (first stages and plain misses do not)."""
+
+        def restaged():
+            snap = metrics.snapshot()
+            return sum(
+                v
+                for k, v in snap.items()
+                if not isinstance(v, dict)
+                and k.startswith(metrics.STAGER_RESTAGED_BYTES)
+            )
+
+        _, _, frag = _seed_fragment(holder)
+        st = DeviceStager(budget_bytes=ROW_BYTES)  # one-row budget
+        base = restaged()
+        st.row(frag, 0)  # first stage: not a re-entry
+        st.row(frag, 1)  # evicts row 0; itself a first stage
+        assert restaged() == base
+        st.row(frag, 0)  # re-entry of the evicted row
+        assert restaged() == base + ROW_BYTES
+        st.row(frag, 0)  # resident hit: no further accounting
+        assert restaged() == base + ROW_BYTES
+
+
+# -- on-device expansion vs host dense build ---------------------------------
+
+
+def _ref_set_bits(ref, positions):
+    for p in positions:
+        ref[p >> 5] |= np.uint32(1) << np.uint32(p & 31)
+
+
+class TestExpansionKernels:
+    def test_expand_blocks_all_container_types(self):
+        """Hand-built array/RLE/bitmap payloads with kernel-dropped
+        padding expand bit-identically to a numpy reference."""
+        rows, num_words = 4, 4 * W32
+        ref = np.zeros(num_words, np.uint32)
+        rng = np.random.default_rng(3)
+        # array containers: row 0 slot 0, row 2 slot 3
+        pos = np.concatenate(
+            [
+                0 * SHARD_WIDTH + rng.choice(65536, 37, replace=False),
+                2 * SHARD_WIDTH + 3 * 65536 + rng.choice(65536, 11, replace=False),
+            ]
+        ).astype(np.uint32)
+        _ref_set_bits(ref, pos.tolist())
+        # RLE runs: same-word, word-crossing, interior-covering, width-1
+        runs = [
+            (1 * SHARD_WIDTH + 10, 1 * SHARD_WIDTH + 20),
+            (1 * SHARD_WIDTH + 1000, 1 * SHARD_WIDTH + 1100),
+            (3 * SHARD_WIDTH + 0, 3 * SHARD_WIDTH + 70000),
+            (0 * SHARD_WIDTH + 131071, 0 * SHARD_WIDTH + 131071),
+        ]
+        for s, e in runs:
+            _ref_set_bits(ref, range(s, e + 1))
+        starts = np.array([s for s, _ in runs], np.uint32)
+        ends = np.array([e for _, e in runs], np.uint32)
+        # dense bitmap container: row 2 slot 1
+        dense = rng.integers(0, 1 << 32, size=(1, 2048), dtype=np.uint32)
+        dword = np.array([2 * W32 + (1 << 11)], np.int32)
+        ref[dword[0] : dword[0] + 2048] |= dense[0]
+        # padding the kernel must provably drop
+        pos = np.concatenate([pos, np.full(3, 0xFFFFFFFF, np.uint32)])
+        starts = np.concatenate([starts, np.array([1, 1], np.uint32)])
+        ends = np.concatenate([ends, np.array([0, 0], np.uint32)])
+        dense = np.concatenate([dense, np.zeros((1, 2048), np.uint32)])
+        dword = np.concatenate([dword, np.array([num_words], np.int32)])
+        got = np.asarray(
+            ops.expand_blocks(pos, starts, ends, dense, dword, num_words=num_words)
+        )
+        np.testing.assert_array_equal(got, ref)
+
+    def test_expand_runs_pallas_matches_reference(self):
+        from pilosa_tpu.ops.pallas_kernels import expand_runs_pallas
+
+        num_words = 2 * W32
+        ref = np.zeros(num_words, np.uint32)
+        runs = [(5, 9), (31, 33), (40000, 41000), (SHARD_WIDTH + 7, SHARD_WIDTH + 7)]
+        for s, e in runs:
+            _ref_set_bits(ref, range(s, e + 1))
+        starts = np.array([s for s, _ in runs] + [1, 1], np.int32)
+        ends = np.array([e for _, e in runs] + [0, 0], np.int32)
+        got = np.asarray(
+            expand_runs_pallas(starts, ends, num_words=num_words, interpret=True)
+        )
+        np.testing.assert_array_equal(got, ref)
+
+    def test_stager_compressed_path_bit_identical(self, holder):
+        """Tiered stager (T1 + compressed upload forced on) vs the
+        untiered host dense build, across row/rows/planes forms and a
+        post-write rebuild."""
+        idx, f, frag = _seed_fragment(holder, rows=6, bits_per_row=300)
+        # a dense run + a bitmap-heavy row alongside the sparse ones
+        f.import_bits([6] * 4001, list(range(5000, 9001)))
+        rng = np.random.default_rng(11)
+        heavy = rng.choice(65536, 5000, replace=False) + 2 * 65536
+        f.import_bits([7] * 5000, heavy.tolist())
+        tiered = DeviceStager(tier1_max_bytes=32 << 20, compressed_min_ratio=1e-9)
+        plain = DeviceStager()
+        for r in range(8):
+            np.testing.assert_array_equal(
+                np.asarray(tiered.row(frag, r)), np.asarray(plain.row(frag, r))
+            )
+        ids = tuple(range(8))
+        np.testing.assert_array_equal(
+            np.asarray(tiered.rows(frag, ids, pad_pow2=True)),
+            np.asarray(plain.rows(frag, ids, pad_pow2=True)),
+        )
+        assert tiered.tier1.stats()["admitted"] > 0
+        # BSI planes form
+        v = idx.create_field(
+            "v", FieldOptions(type=FIELD_TYPE_INT, min=0, max=4000)
+        )
+        v.import_values([5, 9, 700, 9000], [17, 2000, 3999, 1])
+        vfrag = holder.fragment("ti", "v", VIEW_BSI_GROUP_PREFIX + "v", 0)
+        depth = v.bsi_group("v").bit_depth()
+        np.testing.assert_array_equal(
+            np.asarray(tiered.planes(vfrag, depth)),
+            np.asarray(plain.planes(vfrag, depth)),
+        )
+        # a write invalidates T1 exactly; the rebuild stays identical
+        f.set_bit(3, 424242)
+        np.testing.assert_array_equal(
+            np.asarray(tiered.row(frag, 3)),
+            frag.row_words(3).view("<u4"),
+        )
+
+
+# -- the oversubscription gauntlet -------------------------------------------
+
+
+class TestOversubscriptionGauntlet:
+    def test_hot_set_3x_budget_bit_identical(self, holder):
+        """A hot set ~3x the T0 budget, two passes + a mid-gauntlet
+        write: every answer bit-identical to the CPU oracle, T0 stays
+        inside its budget, and the second pass re-enters through T1."""
+        n_rows = 18
+        _, f, frag = _seed_fragment(
+            holder, rows=n_rows, bits_per_row=60, name="og"
+        )
+        budget = 6 * ROW_BYTES  # hot set is 3x this
+        stager = DeviceStager(
+            budget_bytes=budget,
+            tier1_max_bytes=64 << 20,
+            compressed_min_ratio=1.5,
+        )
+        ex = Executor(holder, device_policy="always", stager=stager)
+        oracle = Executor(holder, device_policy="never", dispatch_enabled=False)
+        try:
+            queries = [f"Count(Row(f={k}))" for k in range(n_rows)] + [
+                "Count(Intersect(Row(f=1), Row(f=2)))",
+                "Count(Union(Row(f=3), Row(f=17)))",
+            ]
+            for q in queries:
+                assert ex.execute("og", q) == oracle.execute("og", q)
+            f.set_bit(3, 123456)  # invalidates T1/T0 for row 3 exactly
+            for q in queries:
+                assert ex.execute("og", q) == oracle.execute("og", q)
+            assert stager._bytes <= budget
+            # cycle the whole hot set through the row form twice: T0
+            # holds 6 of 18 rows, so the second lap's re-entries MUST
+            # come through T1 — and stay bit-identical to the fragment
+            for _ in range(2):
+                for r in range(n_rows):
+                    np.testing.assert_array_equal(
+                        np.asarray(stager.row(frag, r)),
+                        frag.row_words(r).view("<u4"),
+                    )
+            assert stager._bytes <= budget
+            st = stager.tier1.stats()
+            assert st["admitted"] > 0
+            assert st["hits"] > 0, f"hot set never re-entered via T1: {st}"
+        finally:
+            ex.close()
+            oracle.close()
+
+
+# -- stage-ahead error accounting (ISSUE 17 s1) ------------------------------
+
+
+class TestStageAheadErrors:
+    def test_raising_thunk_counted_journaled_loop_survives(self, holder):
+        st = DeviceStager()
+
+        def boom():
+            raise ValueError("prefetch thunk exploded")
+
+        before = len(events.snapshot(kind=events.STAGER_AHEAD_ERROR))
+        st.stage_ahead(boom)
+        deadline = time.monotonic() + 5.0
+        while st.ahead_errors < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert st.ahead_errors == 1
+        recs = events.snapshot(kind=events.STAGER_AHEAD_ERROR)
+        assert len(recs) == before + 1
+        assert recs[-1]["reason"] == "ValueError"
+        assert "exploded" in recs[-1]["error"]
+        # same reason again: counted, NOT re-journaled
+        st.stage_ahead(boom)
+        deadline = time.monotonic() + 5.0
+        while st.ahead_errors < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert st.ahead_errors == 2
+        assert len(events.snapshot(kind=events.STAGER_AHEAD_ERROR)) == before + 1
+        # the loop survived: a healthy thunk still runs
+        done = threading.Event()
+        st.stage_ahead(done.set)
+        assert done.wait(5.0), "stage-ahead loop died after a raising thunk"
+
+
+# -- docs drift guard ---------------------------------------------------------
+
+
+def test_docs_document_tiering_knobs_with_current_defaults():
+    """docs/configuration.md names every tiering knob with the default
+    the code actually uses (the test_fusion.py knob-sync idiom)."""
+    from pilosa_tpu.server import Config
+
+    cfg = Config(data_dir="x")
+    root = os.path.join(os.path.dirname(__file__), "..", "docs")
+    with open(os.path.join(root, "configuration.md")) as fp:
+        conf = fp.read()
+    for knob, default in (
+        ("tier1-max-bytes", str(cfg.tier1_max_bytes)),
+        ("prefetch-enabled", "true" if cfg.prefetch_enabled else "false"),
+        ("prefetch-depth", str(cfg.prefetch_depth)),
+        (
+            "compressed-upload-min-ratio",
+            str(cfg.compressed_upload_min_ratio),
+        ),
+    ):
+        assert f"| `{knob}` | {default} |" in conf, (
+            f"configuration.md row for {knob} missing or default drifted "
+            f"(expected {default})"
+        )
+    assert "tier1-max-bytes = " in cfg.to_toml()
+    for name in (
+        metrics.TIER1_HITS,
+        metrics.TIERING_COMPRESSED_UPLOADS,
+        metrics.PREFETCH_ISSUED,
+    ):
+        assert name in metrics.METRICS
