@@ -1300,6 +1300,168 @@ def _tiering_oversub_probe(budget_s: float) -> dict:
     return out
 
 
+def _dashboard_mix_probe(budget_s: float) -> dict:
+    """Interactive latency under an analytics panel load (ISSUE 18):
+    the same fixed-concurrency TopN/Count interactive loop measured
+    alone (analytics-off arm) and with a GroupBy dashboard panel loop
+    running alongside (analytics-on arm). The analytic panels execute
+    as fused segmented reductions in their own launches, so the
+    headline is the interactive p50 ratio between the arms (the
+    acceptance bar is < 1.10 — panels must not burn interactive p50)
+    plus fused launches per panel (the one-launch-per-panel proof
+    under concurrency). Chip-independent (the contrast is isolation,
+    not kernel speed)."""
+    import shutil as _shutil
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pilosa_tpu import SHARD_WIDTH
+    from pilosa_tpu.core import FieldOptions, Holder
+    from pilosa_tpu.core.field import FIELD_TYPE_INT
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.utils import metrics as _metrics
+
+    NSHARDS, BITS = 2, 1500
+    SEG_ROWS, DEV_ROWS = 6, 4
+
+    def msum(snap, name):
+        return sum(
+            v
+            for k, v in snap.items()
+            if not isinstance(v, dict) and k.startswith(name)
+        )
+
+    tmp = tempfile.mkdtemp(prefix="pilosa_dashmix_")
+    out = {
+        "note": (
+            "4 interactive clients (TopN/Count mix, think time, sub-"
+            "saturation) measured alone vs with one GroupBy(seg x dev, "
+            "Sum) panel loop alongside on the same executor; "
+            "interactive_p50_ratio = with-panels / without (< 1.10 = "
+            "panels don't burn interactive p50), fused_launches_per_"
+            "panel proves each panel stays one segmented-reduction "
+            "launch under concurrency"
+        ),
+        "shards": NSHARDS,
+        "panel_groups": SEG_ROWS * DEV_ROWS,
+    }
+    h = Holder(tmp)
+    h.open()
+    try:
+        idx = h.create_index("dm")
+        seg = idx.create_field("seg")
+        dev = idx.create_field("dev")
+        val = idx.create_field(
+            "v", FieldOptions(type=FIELD_TYPE_INT, min=0, max=1000)
+        )
+        rng = np.random.default_rng(37)
+        ncols = NSHARDS * SHARD_WIDTH
+        rows, cols = [], []
+        for r_ in range(SEG_ROWS):
+            rows += [r_] * BITS
+            cols += rng.integers(0, ncols, size=BITS).tolist()
+        seg.import_bits(rows, cols)
+        rows, cols = [], []
+        for r_ in range(DEV_ROWS):
+            rows += [r_] * BITS
+            cols += rng.integers(0, ncols, size=BITS).tolist()
+        dev.import_bits(rows, cols)
+        vcols = rng.choice(ncols, size=4000, replace=False).tolist()
+        val.import_values(vcols, rng.integers(0, 1000, size=4000).tolist())
+
+        interactive = [f"Count(Row(seg={k}))" for k in range(SEG_ROWS)] + [
+            "TopN(seg, n=4)",
+            "TopN(dev, n=3)",
+            f"Count(Intersect(Row(seg=1), Row(dev=2)))",
+        ]
+        panel = "GroupBy(Rows(seg), Rows(dev), Sum(field=v))"
+
+        ex = Executor(h, device_policy="always", fusion_enabled=True)
+        try:
+            for q in interactive:  # warm the compile caches
+                ex.execute("dm", q)
+            ex.execute("dm", panel)
+
+            def arm(with_panels: bool, seconds: float):
+                snap0 = _metrics.snapshot()
+                lats: list = []
+                mu = threading.Lock()
+                stop = time.perf_counter() + seconds
+                panels = [0]
+
+                def client(cid):
+                    mine, i = [], cid * 3
+                    while time.perf_counter() < stop:
+                        q = interactive[i % len(interactive)]
+                        i += 1
+                        t0 = time.perf_counter()
+                        ex.execute("dm", q)
+                        mine.append(time.perf_counter() - t0)
+                        # think time keeps the interactive side below
+                        # saturation so p50 measures service +
+                        # panel interference, not queue depth
+                        time.sleep(0.006)
+                    with mu:
+                        lats.extend(mine)
+
+                def panel_loop():
+                    while time.perf_counter() < stop:
+                        ex.execute("dm", panel)
+                        panels[0] += 1
+                        # dashboard refresh cadence (~4 Hz): panels are
+                        # periodic redraws, not a saturating loop — the
+                        # contrast measured is fused-launch interference
+                        # on interactive traffic, not core starvation
+                        time.sleep(0.25)
+
+                with ThreadPoolExecutor(max_workers=5) as pool:
+                    futs = [pool.submit(client, c) for c in range(4)]
+                    if with_panels:
+                        futs.append(pool.submit(panel_loop))
+                    for f in futs:
+                        f.result()
+                snap1 = _metrics.snapshot()
+                lat = np.sort(np.asarray(lats))
+
+                def pct(a, p):
+                    return round(
+                        float(a[min(len(a) - 1, int(p * len(a)))]) * 1e3, 3
+                    )
+
+                res = {
+                    "interactive_queries": len(lat),
+                    "interactive_p50_ms": pct(lat, 0.50),
+                    "interactive_p95_ms": pct(lat, 0.95),
+                    "panels": panels[0],
+                }
+                if with_panels and panels[0]:
+                    launches = msum(
+                        snap1, _metrics.FUSION_GROUPBY_LAUNCHES
+                    ) - msum(snap0, _metrics.FUSION_GROUPBY_LAUNCHES)
+                    res["fused_launches_per_panel"] = round(
+                        launches / panels[0], 3
+                    )
+                return res
+
+            seg_s = max(2.0, min(8.0, budget_s / 2.5))
+            arm(True, min(2.0, seg_s))  # throwaway: thread-pool +
+            # allocator steady state, so the off arm isn't flattered
+            # by a cold first lap
+            out["analytics_off"] = arm(False, seg_s)
+            out["analytics_on"] = arm(True, seg_s)
+            p_off = out["analytics_off"]["interactive_p50_ms"]
+            p_on = out["analytics_on"]["interactive_p50_ms"]
+            out["interactive_p50_ratio"] = (
+                round(p_on / p_off, 3) if p_off else None
+            )
+        finally:
+            ex.close()
+    finally:
+        h.close()
+        _shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def _plan_cache_probe(budget_s: float) -> dict:
     """Plan result cache under Zipf-repeated traffic (ISSUE 4): a
     TopN/Intersect query mix drawn from a Zipf distribution (the
@@ -1768,6 +1930,40 @@ def main():
             except Exception as e:
                 print(
                     f"tiering probe failed: {type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
+
+    # ---- dashboard-mix probe (ISSUE 18): interactive TopN/Count p50
+    # with a fused GroupBy panel loop alongside vs analytics off, plus
+    # fused launches per panel under concurrency.
+    if os.environ.get("PILOSA_BENCH_ANALYTICS", "1") != "0":
+        rem = child_budget - (time.monotonic() - _T_PROC_START)
+        if rem > 50:
+            try:
+                result["dashboard_mix"] = _dashboard_mix_probe(
+                    min(22.0, rem - 28)
+                )
+                try:
+                    with open(
+                        os.path.join(_REPO_DIR, "ANALYTICS_r18.json"), "w"
+                    ) as f:
+                        json.dump(
+                            {
+                                "ts": time.time(),
+                                "platform": result.get("platform"),
+                                **result["dashboard_mix"],
+                            },
+                            f,
+                            indent=1,
+                        )
+                except OSError as e:
+                    print(
+                        f"could not write ANALYTICS_r18.json: {e}",
+                        file=sys.stderr,
+                    )
+            except Exception as e:
+                print(
+                    f"dashboard-mix probe failed: {type(e).__name__}: {e}",
                     file=sys.stderr,
                 )
 

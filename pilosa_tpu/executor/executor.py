@@ -34,7 +34,8 @@ from pilosa_tpu import SHARD_WIDTH, ops
 from pilosa_tpu.core import Row, TopOptions, VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD
 from pilosa_tpu.core.cache import sort_pairs
 from pilosa_tpu.core.cache import pairs_arrays as cache_pairs_arrays
-from pilosa_tpu.core.fragment import DEFAULT_MIN_THRESHOLD
+from pilosa_tpu.core.fragment import DEFAULT_MIN_THRESHOLD, FragmentQuarantinedError
+from pilosa_tpu.executor import analytics
 from pilosa_tpu.core.timequantum import TIME_FORMAT, views_by_time_range
 from pilosa_tpu.executor.batcher import BatchedScorer
 from pilosa_tpu.executor.devicehealth import DeviceDown
@@ -347,6 +348,7 @@ class Executor:
         fusion_max_calls: int = 64,
         plan_cache_device_bytes: Optional[int] = None,
         governor: Optional[HbmGovernor] = None,
+        analytics_max_groups: Optional[int] = None,
     ) -> None:
         self.holder = holder
         self.cluster = cluster  # None = single-node
@@ -365,6 +367,13 @@ class Executor:
         self.device_policy = device_policy
         self.translate_store = translate_store
         self.max_writes_per_request = max_writes_per_request
+        # GroupBy cross-product bound: a panel larger than this fails
+        # loudly before K row stacks are staged into HBM
+        self.analytics_max_groups = (
+            int(analytics_max_groups)
+            if analytics_max_groups is not None
+            else analytics.DEFAULT_MAX_GROUPS
+        )
         # coalesces concurrent TopN scoring against the same staged
         # matrix into one batched kernel launch (see batcher.py)
         self.scorer = BatchedScorer()
@@ -680,7 +689,12 @@ class Executor:
         fused: dict[int, Any] = {}
         if (
             self.fuser is not None
-            and len(calls) > 1
+            # a single analytic call is itself a K-way panel — worth a
+            # fused launch even without a second call to share it with
+            and (
+                len(calls) > 1
+                or any(c.name in analytics.ANALYTIC_CALLS for c in calls)
+            )
             and query.write_call_n() == 0
             and not opt.serial
             and shards
@@ -979,6 +993,14 @@ class Executor:
             return None
         if name == "TopN":
             return self._execute_topn(index, c, shards, opt)
+        if name == "GroupBy":
+            return self._execute_groupby(index, c, shards, opt)
+        if name == "Distinct":
+            return self._execute_distinct(index, c, shards, opt)
+        if name == "Percentile":
+            return self._execute_percentile(index, c, shards, opt)
+        if name == "Rows":
+            raise ValueError("Rows() can only be used inside GroupBy()")
         return self._execute_bitmap_call(index, c, shards, opt)
 
     # -- map/reduce seam -----------------------------------------------------
@@ -1051,6 +1073,18 @@ class Executor:
         rec = heat.LEDGER.record_read
         for s in shards:
             rec(index, field, s)
+
+    def _analytics_heat_legs(self, index, fields, shards) -> None:
+        """Analytic segmented-reduction launches bypass ``_map_reduce``'s
+        per-shard loop AND touch several fields per launch (dimension
+        rows + aggregate planes), so their legs record here: one read
+        per (field, shard), same accounting as the serial path."""
+        if not heat.LEDGER.enabled or not shards:
+            return
+        rec = heat.LEDGER.record_read
+        for f in fields:
+            for s in shards:
+                rec(index, f, s)
 
     # -- bitmap calls ---------------------------------------------------------
 
@@ -1285,6 +1319,8 @@ class Executor:
             # shard-stacked device lowering — the CPU roaring union was
             # the only path that ever ran (VERDICT §6).
             total += self._time_range_containers(index, c, shard)
+        elif c.name in ("GroupBy", "Distinct", "Percentile", "Rows"):
+            total += self._analytics_containers(index, c, shard)
         for child in c.children:
             total += self._touched_containers(index, child, shard)
         return total
@@ -1316,6 +1352,43 @@ class Executor:
             frag = self.holder.fragment(index, field_name, view, shard)
             if frag is not None:
                 total += frag.sparse_block_count([row_id])
+        return total
+
+    def _analytics_containers(self, index, c: Call, shard: int) -> int:
+        """Touched-container estimate for the analytic calls. A Rows()
+        dimension reads every listed (or discovered) row; Distinct /
+        Percentile / a GroupBy Sum aggregate read the field's full BSI
+        plane set. Filter subtrees and nested Rows() dimensions are
+        counted by the caller's child recursion."""
+        total = 0
+        if c.name == "Rows":
+            fname, ok = c.string_arg("_field")
+            if ok and fname:
+                frag = self.holder.fragment(index, fname, VIEW_STANDARD, shard)
+                if frag is not None:
+                    ids, has_ids = c.uint_slice_arg("ids")
+                    total += frag.sparse_block_count(
+                        list(ids) if has_ids else frag.row_ids()
+                    )
+            return total
+        fname = ""
+        if c.name in ("Distinct", "Percentile"):
+            fname, _ = c.string_arg("field")
+        elif c.name == "GroupBy":
+            for child in c.children:
+                if child.name == "Sum" and not child.children:
+                    fname, _ = child.string_arg("field")
+                    break
+        if fname:
+            f = self.holder.field(index, fname)
+            bsig = f.bsi_group(fname) if f is not None else None
+            frag = self.holder.fragment(
+                index, fname, VIEW_BSI_GROUP_PREFIX + fname, shard
+            )
+            if frag is not None and bsig is not None:
+                total += frag.sparse_block_count(
+                    list(range(bsig.bit_depth() + 1))
+                )
         return total
 
     def _cached_words(self, c: Call, shard: int):
@@ -1969,6 +2042,277 @@ class Executor:
         if result is None or result.count == 0:
             return ValCount()
         return result
+
+    # -- device-resident analytics (ISSUE 18) --------------------------------
+    #
+    # GroupBy / Distinct / Percentile execute shard-batched as segmented
+    # device reductions (one jitted launch per panel, intermediates
+    # never leaving HBM) with the same degrade ladder as Count/Sum/TopN:
+    # batched device -> per-shard CPU oracle via _map_reduce (which the
+    # cluster layer federates). A FragmentQuarantinedError raised while
+    # STAGING a batch degrades that launch to the classic path, where
+    # the quarantined shard's leg surfaces the clean 503 instead of
+    # poisoning the whole fused launch.
+
+    def _execute_groupby(self, index, c: Call, shards, opt) -> list[dict]:
+        plan = analytics.parse_groupby(c)
+        metrics.count(metrics.ANALYTICS_QUERIES, call="GroupBy")
+        dims = analytics.resolve_dims(
+            self.holder, index, plan, shards, self.analytics_max_groups
+        )
+        merged = None
+        if (
+            self._local_batchable(opt)
+            and shards
+            and self.mesh is None  # group stacks flatten the shard axis
+            and all(ids for _, ids in dims)
+            and self._use_device_batched(index, c, shards)
+        ):
+            try:
+                with trace.child(metrics.STAGE_DEVICE_BATCH, call="GroupBy"):
+                    merged = self._groupby_device_batched(
+                        index, plan, dims, shards
+                    )
+                fields = [f for f, _ in dims] + (
+                    [plan.agg_field] if plan.agg_field else []
+                )
+                self._analytics_heat_legs(index, fields, shards)
+            except _NotDeviceable:
+                merged = None
+            except FragmentQuarantinedError:
+                metrics.count(metrics.ANALYTICS_DEGRADED_LEGS, call="GroupBy")
+                merged = None
+        if merged is None:
+
+            def map_fn(shard):
+                return analytics.groupby_shard(self, index, plan, dims, shard)
+
+            merged = self._map_reduce(
+                index,
+                shards,
+                c,
+                opt,
+                map_fn,
+                analytics.merge_group_lists,
+                zero_factory=list,
+            )
+        if opt.remote:
+            # un-finalized wire list: the coordinator merges remote legs
+            # first, then orders + applies limit exactly once
+            return merged or []
+        return analytics.finalize_groups(plan, merged or [])
+
+    def _groupby_device_batched(self, index, plan, dims, shards) -> list[dict]:
+        """One segmented-reduction launch for the whole panel: stack each
+        dimension's rows, cross-product AND on device, popcount the K
+        group bitmaps (and their BSI plane intersections for Sum)."""
+        import jax.numpy as jnp
+
+        wf = len(shards) * _W32
+        dim_stacks = []
+        for field, ids in dims:
+            frags = tuple(
+                self.holder.fragment(index, field, VIEW_STANDARD, s)
+                for s in shards
+            )
+            rows = [self.stager.row_stack(frags, rid) for rid in ids]
+            dim_stacks.append(jnp.stack(rows).reshape(len(ids), wf))
+        if plan.filter is not None:
+            filt = jnp.asarray(
+                self._device_bitmap_stack(index, plan.filter, shards)
+            ).reshape(wf)
+        else:
+            filt = None
+        k = 1
+        for _, ids in dims:
+            k *= len(ids)
+        metrics.count(metrics.FUSION_GROUPBY_LAUNCHES)
+        metrics.observe(metrics.FUSION_GROUPBY_GROUPS, k)
+        if plan.agg_field is None:
+            counts = _fetch(ops.groupby_counts(tuple(dim_stacks), filt))
+            return analytics.emit_device_groups(dims, counts)
+        f = self.holder.field(index, plan.agg_field)
+        bsig = f.bsi_group(plan.agg_field) if f is not None else None
+        if bsig is None:
+            raise NotFoundError(f"bsiGroup not found: {plan.agg_field}")
+        depth = bsig.bit_depth()
+        afrags = tuple(
+            self.holder.fragment(
+                index, plan.agg_field, VIEW_BSI_GROUP_PREFIX + plan.agg_field, s
+            )
+            for s in shards
+        )
+        if not any(afrags):
+            counts = _fetch(ops.groupby_counts(tuple(dim_stacks), filt))
+            return analytics.emit_device_groups(
+                dims, counts, sums=[0] * int(counts.shape[0])
+            )
+        planes = jnp.transpose(
+            self.stager.planes_stack(afrags, depth), (1, 0, 2)
+        ).reshape(depth + 1, wf)
+        counts, plane_counts = ops.groupby_sum_reduce(
+            tuple(dim_stacks), filt, planes
+        )
+        sums = analytics.assemble_sums(_fetch(plane_counts), depth, bsig.min)
+        return analytics.emit_device_groups(dims, _fetch(counts), sums=sums)
+
+    def _execute_distinct(self, index, c: Call, shards, opt) -> list[int]:
+        field, ok = c.string_arg("field")
+        if not ok or not field:
+            raise ValueError("Distinct(): field required")
+        if len(c.children) > 1:
+            raise ValueError("Distinct() only accepts a single bitmap input")
+        metrics.count(metrics.ANALYTICS_QUERIES, call="Distinct")
+        f = self.holder.field(index, field)
+        bsig = f.bsi_group(field) if f is not None else None
+        if bsig is None:
+            raise NotFoundError(f"bsiGroup not found: {field}")
+        if (
+            self._local_batchable(opt)
+            and shards
+            and self.mesh is None
+            and bsig.bit_depth() <= analytics.DISTINCT_DEVICE_MAX_DEPTH
+            and self._use_device_batched(index, c, shards)
+        ):
+            try:
+                with trace.child(metrics.STAGE_DEVICE_BATCH, call="Distinct"):
+                    vals = self._distinct_device_batched(index, c, shards, bsig)
+                self._analytics_heat_legs(index, [field], shards)
+                return vals
+            except _NotDeviceable:
+                pass
+            except FragmentQuarantinedError:
+                metrics.count(metrics.ANALYTICS_DEGRADED_LEGS, call="Distinct")
+
+        def map_fn(shard):
+            return analytics.distinct_shard(self, index, c, field, shard)
+
+        result = self._map_reduce(
+            index,
+            shards,
+            c,
+            opt,
+            map_fn,
+            analytics.merge_distinct_lists,
+            zero_factory=list,
+        )
+        return result or []
+
+    def _distinct_device_batched(self, index, c: Call, shards, bsig) -> list[int]:
+        """OR-reduce the per-shard value presence into one 2^depth
+        bitmap on device; the host decodes set positions to values."""
+        field, _ = c.string_arg("field")
+        depth = bsig.bit_depth()
+        frags = tuple(
+            self.holder.fragment(index, field, VIEW_BSI_GROUP_PREFIX + field, s)
+            for s in shards
+        )
+        if not any(frags):
+            return []
+        if len(c.children) == 1:
+            filt = self._device_bitmap_stack(index, c.children[0], shards)
+            has_filter = True
+        else:
+            filt = np.zeros((len(shards), _W32), dtype=np.uint32)
+            has_filter = False
+        planes = self.stager.planes_stack(frags, depth)
+        words = _fetch(
+            ops.bsi_distinct_presence(
+                planes, filt, bit_depth=depth, has_filter=has_filter
+            )
+        )
+        return analytics.decode_presence_words(words, bsig.min)
+
+    def _execute_percentile(self, index, c: Call, shards, opt) -> ValCount:
+        field, nth_bp = analytics.parse_percentile(c)
+        metrics.count(metrics.ANALYTICS_QUERIES, call="Percentile")
+        f = self.holder.field(index, field)
+        bsig = f.bsi_group(field) if f is not None else None
+        if bsig is None:
+            raise NotFoundError(f"bsiGroup not found: {field}")
+        if (
+            self._local_batchable(opt)
+            and shards
+            and self.mesh is None
+            and self._use_device_batched(index, c, shards)
+        ):
+            try:
+                with trace.child(metrics.STAGE_DEVICE_BATCH, call="Percentile"):
+                    vc = self._percentile_device_batched(
+                        index, c, shards, bsig, nth_bp
+                    )
+                self._analytics_heat_legs(index, [field], shards)
+                return vc
+            except _NotDeviceable:
+                pass
+            except FragmentQuarantinedError:
+                metrics.count(
+                    metrics.ANALYTICS_DEGRADED_LEGS, call="Percentile"
+                )
+        return self._percentile_by_counting(
+            index, c, shards, opt, field, bsig, nth_bp
+        )
+
+    def _percentile_device_batched(
+        self, index, c: Call, shards, bsig, nth_bp: int
+    ) -> ValCount:
+        """Bit-sliced binary search over the BSI planes, entirely on
+        device: one launch, one fetch of (depth bits, count)."""
+        field, _ = c.string_arg("field")
+        depth = bsig.bit_depth()
+        frags = tuple(
+            self.holder.fragment(index, field, VIEW_BSI_GROUP_PREFIX + field, s)
+            for s in shards
+        )
+        if not any(frags):
+            return ValCount()
+        if len(c.children) == 1:
+            filt = self._device_bitmap_stack(index, c.children[0], shards)
+            has_filter = True
+        else:
+            filt = np.zeros((len(shards), _W32), dtype=np.uint32)
+            has_filter = False
+        planes = self.stager.planes_stack(frags, depth)
+        bits, count = ops.bsi_percentile_batched(
+            planes, filt, np.int32(nth_bp), bit_depth=depth, has_filter=has_filter
+        )
+        count = int(count)
+        if count == 0:
+            return ValCount()
+        val = sum(1 << i for i, b in enumerate(_fetch(bits)) if b)
+        return ValCount(val + bsig.min, count)
+
+    def _percentile_by_counting(
+        self, index, c: Call, shards, opt, field, bsig, nth_bp: int
+    ) -> ValCount:
+        """Classic leg: O(depth) counting binary search over the value
+        domain built from synthesized Count(Range(...)) calls — each
+        Count federates (and device-routes) through its own path, so
+        this leg is cluster-correct without a new merge type, and it is
+        the CPU oracle the device descent must match bit-for-bit."""
+
+        def count_where(cond: Condition) -> int:
+            child: Call = Call("Range", {field: cond})
+            if len(c.children) == 1:
+                child = Call(
+                    "Intersect", children=[c.children[0].clone(), child]
+                )
+            return self._execute_count(
+                index, Call("Count", children=[child]), shards, opt
+            )
+
+        n = count_where(Condition(NEQ, None))
+        if n == 0:
+            return ValCount()
+        k = analytics.nearest_rank(nth_bp, n)
+        lo, hi = bsig.min, bsig.max
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if count_where(Condition("<=", mid)) >= k:
+                hi = mid
+            else:
+                lo = mid + 1
+        return ValCount(lo, n)
 
     # -- TopN (reference executeTopN two-pass, executor.go:521-585) ----------
 

@@ -50,7 +50,7 @@ from pilosa_tpu.utils import chaos, metrics, trace
 # module lazily, inside Executor.__init__, so there is no cycle): the
 # fuser reuses the executor's lowering helpers and kernels verbatim —
 # that shared code is the bit-identity argument.
-from pilosa_tpu.executor import executor as _ex
+from pilosa_tpu.executor import analytics, executor as _ex
 from pilosa_tpu.executor.executor import (
     FIRST_CHUNK,
     ValCount,
@@ -60,23 +60,30 @@ from pilosa_tpu.executor.executor import (
 )
 from pilosa_tpu import ops
 from pilosa_tpu.core import VIEW_BSI_GROUP_PREFIX, VIEW_STANDARD
+from pilosa_tpu.core.fragment import FragmentQuarantinedError
 
 # call names the fuser can lower; everything else is residual
-_FUSABLE = ("Count", "Sum", "TopN")
+_ANALYTIC = analytics.ANALYTIC_CALLS
+_FUSABLE = ("Count", "Sum", "TopN") + _ANALYTIC
 
 
 class _Unit:
     """One lowered call: a static descriptor (part of the program key),
     the device input arrays consumed at the descriptor's flat offset,
-    and a host finisher mapping the fetched output to the call result."""
+    and a host finisher mapping the fetched output to the call result.
+    ``extra_bytes`` charges transients the input sum cannot see (the
+    GroupBy [K, S·W] cross-product stack) to the HBM admission check."""
 
-    __slots__ = ("call_index", "desc", "inputs", "finish")
+    __slots__ = ("call_index", "desc", "inputs", "finish", "extra_bytes")
 
-    def __init__(self, call_index: int, desc, inputs, finish) -> None:
+    def __init__(
+        self, call_index: int, desc, inputs, finish, extra_bytes: int = 0
+    ) -> None:
         self.call_index = call_index
         self.desc = desc
         self.inputs = inputs
         self.finish = finish
+        self.extra_bytes = extra_bytes
 
 
 class QueryFuser:
@@ -136,7 +143,11 @@ class QueryFuser:
         candidates = [
             (i, c) for i, c in enumerate(calls) if c.name in _FUSABLE
         ]
-        if len(candidates) < 2:
+        if len(candidates) < 2 and not any(
+            c.name in _ANALYTIC for _, c in candidates
+        ):
+            # an analytic call is itself a K-way panel — one fused
+            # launch replaces K point queries, so it fuses alone
             self._bypass("too_few_calls")
             return None
         if ex.device_policy != "always":
@@ -209,8 +220,15 @@ class QueryFuser:
             # calls served by the fused launch never enter _map_reduce;
             # account their per-shard read legs here (cache hits above
             # short-circuit before the classic path records, so they
-            # stay unrecorded on both routes)
-            ex._heat_read_legs(index, bycall[i], shards)
+            # stay unrecorded on both routes). Analytic calls attribute
+            # to the fields they actually read (dimension rows +
+            # aggregate planes), not the first non-underscore arg key.
+            if bycall[i].name in _ANALYTIC:
+                ex._analytics_heat_legs(
+                    index, analytics.heat_fields(bycall[i]), shards
+                )
+            else:
+                ex._heat_read_legs(index, bycall[i], shards)
             info = cacheinfo.get(i)
             if info is not None and pc is not None:
                 key, genvec, epoch0 = info
@@ -220,14 +238,28 @@ class QueryFuser:
     def _lower_and_launch(self, index, lower, shards, opt) -> list[tuple]:
         ex = self.ex
         units: list[_Unit] = []
+        bycall = dict(lower)
         for i, c in lower:
             try:
                 if c.name == "Count":
                     u = self._lower_count(index, i, c, shards)
                 elif c.name == "Sum":
                     u = self._lower_sum(index, i, c, shards)
+                elif c.name == "GroupBy":
+                    u = self._lower_groupby(index, i, c, shards)
+                elif c.name == "Distinct":
+                    u = self._lower_distinct(index, i, c, shards)
+                elif c.name == "Percentile":
+                    u = self._lower_percentile(index, i, c, shards)
                 else:
                     u = self._lower_topn(index, i, c, shards, opt)
+            except FragmentQuarantinedError:
+                # quarantined fragment staged into the batch: degrade
+                # THIS call to the classic path (which surfaces the
+                # clean 503) instead of poisoning the fused launch
+                if c.name in _ANALYTIC:
+                    metrics.count(metrics.ANALYTICS_DEGRADED_LEGS, call=c.name)
+                u = None
             except Exception:
                 # malformed args / missing fields / _NotDeviceable: the
                 # classic path owns producing the (identical) error
@@ -236,9 +268,13 @@ class QueryFuser:
                 units.append(u)
         launch = [u for u in units if u.desc is not None]
         zero_only = [u for u in units if u.desc is None]
-        if len(launch) < 2:
+        if len(launch) < 2 and not any(
+            bycall[u.call_index].name in _ANALYTIC for u in launch
+        ):
             # a single device call gains nothing over the per-call
-            # batched path; keep classic routing (and its telemetry)
+            # batched path; keep classic routing (and its telemetry).
+            # A lone analytic panel DOES launch — it already replaces K
+            # point queries.
             self._bypass("too_few_fusable")
             return [(u.call_index, u.finish(None), 0.0) for u in zero_only]
         served = self._launch_units(launch)
@@ -264,8 +300,11 @@ class QueryFuser:
         # transient-peak estimate: inputs live in HBM for the whole
         # program and XLA holds roughly another copy in intermediates
         # (the fold chain rewrites in place but fetch buffers, padding
-        # and fusion temporaries are real) — 2× summed input bytes
-        est = 2 * sum(int(getattr(a, "nbytes", 0)) for a in flat)
+        # and fusion temporaries are real) — 2× summed input bytes,
+        # plus per-unit declared transients (GroupBy's [K, S·W] stack)
+        est = 2 * sum(int(getattr(a, "nbytes", 0)) for a in flat) + sum(
+            u.extra_bytes for u in launch
+        )
         gov = getattr(ex, "governor", None)
         if gov is not None and est > 0 and not gov.admit(est):
             if len(launch) >= 2 and depth < 4:
@@ -294,6 +333,13 @@ class QueryFuser:
         metrics.count(metrics.FUSION_FUSED_LAUNCHES)
         metrics.observe(metrics.FUSION_FUSED_CALLS_PER_LAUNCH, len(launch))
         metrics.count(metrics.FUSION_BYTES_RETURNED, nbytes)
+        for d in descs:
+            if d[0] in ("groupby_count", "groupby_sum"):
+                metrics.count(metrics.FUSION_GROUPBY_LAUNCHES)
+                k = 1
+                for r in d[1]:
+                    k *= r
+                metrics.observe(metrics.FUSION_GROUPBY_GROUPS, k)
         cost = dt / max(len(launch), 1)
         return [
             (u.call_index, u.finish(fetched[k]), cost)
@@ -347,6 +393,160 @@ class QueryFuser:
             return ValCount(vsum + vcount * bsig.min, vcount)
 
         return _Unit(i, ("sum", depth, has_filter), (planes, filt), finish)
+
+    def _lower_groupby(self, index, i, c, shards) -> Optional[_Unit]:
+        """Whole GroupBy panel as one segmented-reduction unit: every
+        dimension's rows stack once, the cross-product AND + popcount
+        (and BSI plane intersections for a Sum aggregate) trace into the
+        fused program, and only the K-vector (or [K, depth+1] counts
+        matrix) crosses back to host."""
+        import jax.numpy as jnp
+
+        ex = self.ex
+        plan = analytics.parse_groupby(c)
+        dims = analytics.resolve_dims(
+            ex.holder, index, plan, shards, ex.analytics_max_groups
+        )
+        if not all(ids for _, ids in dims):
+            return _Unit(i, None, (), lambda _res: [])
+        wf = len(shards) * _ex._W32
+        inputs: list = []
+        k = 1
+        for field, ids in dims:
+            frags = tuple(
+                ex.holder.fragment(index, field, VIEW_STANDARD, s)
+                for s in shards
+            )
+            rows = [ex.stager.row_stack(frags, rid) for rid in ids]
+            inputs.append(jnp.stack(rows).reshape(len(ids), wf))
+            k *= len(ids)
+        has_filter = plan.filter is not None
+        if has_filter:
+            inputs.append(
+                jnp.asarray(
+                    ex._device_bitmap_stack(index, plan.filter, shards)
+                ).reshape(wf)
+            )
+        rcounts = tuple(len(ids) for _, ids in dims)
+        extra = k * wf * 4  # the [K, S·W] cross-product transient
+        if plan.agg_field is None:
+
+            def finish(counts):
+                metrics.count(metrics.ANALYTICS_QUERIES, call="GroupBy")
+                return analytics.finalize_groups(
+                    plan, analytics.emit_device_groups(dims, counts)
+                )
+
+            return _Unit(
+                i,
+                ("groupby_count", rcounts, has_filter),
+                tuple(inputs),
+                finish,
+                extra_bytes=extra,
+            )
+        f = ex.holder.field(index, plan.agg_field)
+        bsig = f.bsi_group(plan.agg_field) if f is not None else None
+        if bsig is None:
+            return None  # classic path owns the error
+        depth = bsig.bit_depth()
+        afrags = tuple(
+            ex.holder.fragment(
+                index, plan.agg_field, VIEW_BSI_GROUP_PREFIX + plan.agg_field, s
+            )
+            for s in shards
+        )
+        if not any(afrags):
+            return None  # no value fragments: classic path emits sum=0
+        inputs.append(
+            jnp.transpose(
+                ex.stager.planes_stack(afrags, depth), (1, 0, 2)
+            ).reshape(depth + 1, wf)
+        )
+
+        def finish(out):
+            metrics.count(metrics.ANALYTICS_QUERIES, call="GroupBy")
+            sums = analytics.assemble_sums(out[:, 1:], depth, bsig.min)
+            return analytics.finalize_groups(
+                plan,
+                analytics.emit_device_groups(dims, out[:, 0], sums=sums),
+            )
+
+        return _Unit(
+            i,
+            ("groupby_sum", rcounts, has_filter, depth),
+            tuple(inputs),
+            finish,
+            extra_bytes=extra,
+        )
+
+    def _lower_distinct(self, index, i, c, shards) -> Optional[_Unit]:
+        ex = self.ex
+        field, ok = c.string_arg("field")
+        if not ok or not field or len(c.children) > 1:
+            return None
+        f = ex.holder.field(index, field)
+        bsig = f.bsi_group(field) if f is not None else None
+        if bsig is None:
+            return None
+        depth = bsig.bit_depth()
+        if depth > analytics.DISTINCT_DEVICE_MAX_DEPTH:
+            return None  # presence domain too large — classic walk wins
+        frags = tuple(
+            ex.holder.fragment(index, field, VIEW_BSI_GROUP_PREFIX + field, s)
+            for s in shards
+        )
+        if not any(frags):
+            return _Unit(i, None, (), lambda _res: [])
+        if len(c.children) == 1:
+            filt = ex._device_bitmap_stack(index, c.children[0], shards)
+            has_filter = True
+        else:
+            filt = np.zeros((len(shards), _ex._W32), dtype=np.uint32)
+            has_filter = False
+        planes = ex.stager.planes_stack(frags, depth)
+
+        def finish(words):
+            metrics.count(metrics.ANALYTICS_QUERIES, call="Distinct")
+            return analytics.decode_presence_words(words, bsig.min)
+
+        return _Unit(i, ("distinct", depth, has_filter), (planes, filt), finish)
+
+    def _lower_percentile(self, index, i, c, shards) -> Optional[_Unit]:
+        ex = self.ex
+        field, nth_bp = analytics.parse_percentile(c)
+        f = ex.holder.field(index, field)
+        bsig = f.bsi_group(field) if f is not None else None
+        if bsig is None:
+            return None
+        depth = bsig.bit_depth()
+        frags = tuple(
+            ex.holder.fragment(index, field, VIEW_BSI_GROUP_PREFIX + field, s)
+            for s in shards
+        )
+        if not any(frags):
+            return _Unit(i, None, (), lambda _res: ValCount())
+        if len(c.children) == 1:
+            filt = ex._device_bitmap_stack(index, c.children[0], shards)
+            has_filter = True
+        else:
+            filt = np.zeros((len(shards), _ex._W32), dtype=np.uint32)
+            has_filter = False
+        planes = ex.stager.planes_stack(frags, depth)
+        # nth rides as a TRACED i32 input so every percentile of the
+        # same (depth, filter) shape shares one compiled program
+        nth = np.asarray(nth_bp, dtype=np.int32)
+
+        def finish(out):
+            metrics.count(metrics.ANALYTICS_QUERIES, call="Percentile")
+            count = int(out[depth])
+            if count == 0:
+                return ValCount()
+            val = sum(1 << j for j in range(depth) if int(out[j]))
+            return ValCount(val + bsig.min, count)
+
+        return _Unit(
+            i, ("percentile", depth, has_filter), (planes, filt, nth), finish
+        )
 
     def _lower_topn(self, index, i, c, shards, opt) -> Optional[_Unit]:
         ex = self.ex
@@ -469,6 +669,49 @@ def _build_program(descs: tuple):
                 outs.append(
                     ops.bsi_plane_counts_batched(
                         planes, filt, bit_depth=depth, has_filter=has_filter
+                    )
+                )
+            elif kind in ("groupby_count", "groupby_sum"):
+                import jax.numpy as jnp
+
+                rcounts, has_filter = d[1], d[2]
+                nd = len(rcounts)
+                dims = tuple(flat[off : off + nd])
+                off += nd
+                filt = None
+                if has_filter:
+                    filt = flat[off]
+                    off += 1
+                if kind == "groupby_count":
+                    outs.append(ops.groupby_counts(dims, filt))
+                else:
+                    planes = flat[off]
+                    off += 1
+                    counts, pc = ops.groupby_sum_reduce(dims, filt, planes)
+                    # one output per unit: [K, depth+2] with the group
+                    # popcounts in column 0, plane counts after
+                    outs.append(jnp.concatenate([counts[:, None], pc], axis=1))
+            elif kind == "distinct":
+                depth, has_filter = d[1], d[2]
+                planes, filt = flat[off], flat[off + 1]
+                off += 2
+                outs.append(
+                    ops.bsi_distinct_presence(
+                        planes, filt, bit_depth=depth, has_filter=has_filter
+                    )
+                )
+            elif kind == "percentile":
+                import jax.numpy as jnp
+
+                depth, has_filter = d[1], d[2]
+                planes, filt, nth = flat[off : off + 3]
+                off += 3
+                bits, count = ops.bsi_percentile_batched(
+                    planes, filt, nth, bit_depth=depth, has_filter=has_filter
+                )
+                outs.append(
+                    jnp.concatenate(
+                        [bits.astype(jnp.int32), count[None].astype(jnp.int32)]
                     )
                 )
             else:  # topn head-chunk scoring

@@ -910,8 +910,12 @@ class DeviceStager:
 
         return delta
 
-    def row_stack(self, frags, row_id: int):
-        """u32[S, W]: one row across S fragments (None → zeros)."""
+    def row_stack(self, frags, row_id: int, prefetch: bool = False):
+        """u32[S, W]: one row across S fragments (None → zeros).
+        ``prefetch=True`` marks a speculative build (plan-driven
+        prefetcher, executor/tiering.py) for the accuracy counters —
+        batched and fused execution read rows through this stacked
+        form, so the prefetcher warms the same key."""
 
         def build():
             gens = self._stack_gen(frags)
@@ -930,6 +934,7 @@ class DeviceStager:
             build,
             delta,
             frag=frags,
+            prefetch=prefetch,
         )
 
     def sparse_rows_stacked(

@@ -275,20 +275,37 @@ class PrefetchScheduler:
                     if idx is None:
                         continue
                     shards = range(idx.max_shard() + 1)
+                shards = tuple(shards)
                 for field, row_id in operands:
+                    frags = []
                     for shard in shards:
                         key = (it.index, field, row_id, shard)
-                        if key in seen:
-                            continue
-                        seen.add(key)
                         frag = ex.holder.fragment(
                             it.index, field, VIEW_STANDARD, shard
                         )
-                        if frag is None:
+                        frags.append(frag)
+                        if key in seen or frag is None:
                             continue
+                        seen.add(key)
                         stager.stage_ahead(
                             lambda f=frag, r=row_id: stager.row(
                                 f, r, prefetch=True
+                            )
+                        )
+                        n += 1
+                    # batched and fused execution (GroupBy dims, fused
+                    # Count trees) read rows as one [S, W] stack keyed
+                    # by the whole fragment tuple — warm that key too,
+                    # or the speculative copies never attribute as used
+                    skey = (it.index, field, row_id, "stack", shards)
+                    if skey not in seen and any(
+                        f is not None for f in frags
+                    ):
+                        seen.add(skey)
+                        ft = tuple(frags)
+                        stager.stage_ahead(
+                            lambda fs=ft, r=row_id: stager.row_stack(
+                                fs, r, prefetch=True
                             )
                         )
                         n += 1

@@ -213,3 +213,98 @@ def bsi_plane_counts_batched(planes, filter_rows, *, bit_depth: int, has_filter:
         block = planes
     pc = jax.lax.population_count(block)
     return jnp.sum(pc.astype(jnp.int32), axis=(0, 2))
+
+
+# -- device-resident analytics (GroupBy / Distinct / Percentile) -------------
+
+
+@functools.partial(jax.jit, static_argnames=("bit_depth", "has_filter"))
+def bsi_percentile_batched(planes, filter_rows, nth_bp, *, bit_depth: int, has_filter: bool):
+    """Shard-batched nearest-rank percentile as a bit-sliced binary
+    search over the value planes (one launch for the whole shard set).
+
+    planes: u32[S, D+1, W]; nth_bp: traced i32 percentile in BASIS
+    POINTS (95.5% → 9550) so the target rank k = ceil(nth·n/100) is
+    exact integer arithmetic — never at the mercy of f32 rounding. The
+    descent walks planes high→low: if ≥k considered columns have bit i
+    clear, the k-th smallest has bit i clear and the zeros subset is
+    kept; otherwise bit i is set and k drops by the zeros count.
+
+    Returns (bits: bool[bit_depth], count: i32) with bits[i] = bit i of
+    the k-th smallest stored value; count is the considered-column
+    total (count == 0 means no value exists — bits are garbage then and
+    the host must answer empty).
+    """
+    consider = planes[:, -1, :]
+    if has_filter:
+        consider = jnp.bitwise_and(consider, filter_rows)
+    count = jnp.sum(jax.lax.population_count(consider).astype(jnp.int32))
+    # k = ceil(nth_bp * count / 10000) without i32 overflow: split count
+    # into q·10000 + r so both partial products stay far below 2^31.
+    q = count // 10000
+    r = count % 10000
+    k = nth_bp * q + (nth_bp * r + 9999) // 10000
+    k = jnp.clip(k, 1, jnp.maximum(count, 1))
+    bits = []
+    for i in reversed(range(bit_depth)):
+        plane = planes[:, i, :]
+        zeros = jnp.bitwise_and(consider, jnp.bitwise_not(plane))
+        c = jnp.sum(jax.lax.population_count(zeros).astype(jnp.int32))
+        pred = k <= c
+        bits.append(jnp.logical_not(pred))
+        consider = jnp.where(pred, zeros, jnp.bitwise_and(consider, plane))
+        k = jnp.where(pred, k, k - c)
+    bits_arr = jnp.stack(bits[::-1]) if bits else jnp.zeros(0, bool)
+    return bits_arr, count
+
+
+@functools.partial(jax.jit, static_argnames=("bit_depth", "has_filter"))
+def bsi_distinct_presence(planes, filter_rows, *, bit_depth: int, has_filter: bool):
+    """Distinct(field) as an OR-reduction over BSI planes with
+    on-device id extraction: planes u32[S, D+1, W] → packed u32
+    presence words over the value domain [0, 2^bit_depth).
+
+    Per shard, each existing (and filtered) column's stored value is
+    reassembled from its plane bits and scattered into a presence
+    bitmap; shards OR-reduce in a fori_loop so the transient stays one
+    shard wide. The result is itself a packed bitmap — the host decodes
+    set positions to sorted values (pos + bsig.min) and cross-gang
+    merges are plain ORs. Callers gate bit_depth (the presence bitmap
+    is 2^bit_depth bits) before choosing this path.
+    """
+    nshards = planes.shape[0]
+    ncols = planes.shape[2] * 32
+    domain = 1 << bit_depth
+    nwords = max((domain + 31) // 32, 1)
+    bitpos = jnp.arange(32, dtype=jnp.uint32)
+
+    def unpack(words):  # u32[W] -> bool[W*32], bit p at index p
+        return (
+            (words[:, None] >> bitpos[None, :]) & jnp.uint32(1)
+        ).astype(jnp.bool_).reshape(-1)
+
+    def shard_presence(sp, filt):
+        exists = sp[-1]
+        if has_filter:
+            exists = jnp.bitwise_and(exists, filt)
+        vals = jnp.zeros((ncols,), jnp.int32)
+        for i in range(bit_depth):
+            vals = vals | (unpack(sp[i]).astype(jnp.int32) << i)
+        # absent columns index out of bounds and drop from the scatter
+        idx = jnp.where(unpack(exists), vals, jnp.int32(domain))
+        return jnp.zeros((domain,), jnp.bool_).at[idx].set(True, mode="drop")
+
+    pres = jax.lax.fori_loop(
+        0,
+        nshards,
+        lambda s, acc: acc | shard_presence(planes[s], filter_rows[s]),
+        jnp.zeros((domain,), jnp.bool_),
+    )
+    total = nwords * 32
+    if total != domain:
+        pres = jnp.pad(pres, (0, total - domain))
+    return jnp.sum(
+        pres.reshape(nwords, 32).astype(jnp.uint32) << bitpos[None, :],
+        axis=1,
+        dtype=jnp.uint32,
+    )

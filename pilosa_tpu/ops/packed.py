@@ -254,6 +254,74 @@ def intersection_counts_matrix_batch_list(srcs, mat) -> jax.Array:
     return intersection_counts_matrix_batch(jnp.stack(srcs), mat)
 
 
+# -- GroupBy segmented reductions (device-resident analytics) ----------------
+#
+# A dashboard GroupBy panel is the cross product of its dimensions' row
+# bitmaps. Instead of K = ΠR_d point queries (K launches, K plan-cache
+# probes, K transports), the per-dimension row stacks are staged once
+# and ONE fused program materialises the K group bitmaps in HBM and
+# segment-reduces them: popcount per group for Count aggregates, per
+# (group, plane) intersection popcounts for Sum aggregates. Group order
+# is product order (first dimension slowest), so the host maps counts
+# back to row-id tuples by pure arithmetic. The [K, Wf] group transient
+# never leaves HBM — callers charge it to the HBM admission governor.
+
+
+@jax.jit
+def combine_groups(dims, filt):
+    """Cross-product AND of per-dimension row stacks.
+
+    dims: tuple of u32[R_d, Wf] (rows of one dimension, words flattened
+    across the shard batch); filt: u32[Wf] or None, ANDed into every
+    group. Returns u32[ΠR_d, Wf] in product order.
+    """
+    acc = dims[0]
+    if filt is not None:
+        acc = jnp.bitwise_and(acc, filt[None, :])
+    for d in dims[1:]:
+        acc = jnp.bitwise_and(acc[:, None, :], d[None, :, :])
+        acc = acc.reshape(-1, acc.shape[-1])
+    return acc
+
+
+@jax.jit
+def groupby_counts(dims, filt):
+    """Count-aggregate GroupBy: per-group popcounts i32[ΠR_d] in one
+    dispatch (cross product + segmented popcount fused by XLA)."""
+    return count_bits_rows(combine_groups(dims, filt))
+
+
+@jax.jit
+def groupby_plane_counts(groups, planes):
+    """Sum-aggregate inner reduction: groups u32[K, Wf] × planes
+    u32[P, Wf] → i32[K, P] per-(group, plane) intersection popcounts.
+    lax.map over the few planes bounds the transient to one [K, Wf]
+    popcount buffer (the group matrix is the big axis). The Pallas
+    version (ops.pallas_kernels.groupby_plane_counts_pallas) tiles the
+    same reduction for real TPU."""
+    res = jax.lax.map(
+        lambda p: jnp.sum(
+            jax.lax.population_count(jnp.bitwise_and(groups, p[None, :])).astype(
+                jnp.int32
+            ),
+            axis=-1,
+        ),
+        planes,
+    )
+    return res.T
+
+
+@jax.jit
+def groupby_sum_reduce(dims, filt, planes):
+    """Fused Sum-aggregate GroupBy: one dispatch yielding
+    (counts i32[K], plane_counts i32[K, P]). counts[k] is the group's
+    column count; plane_counts[k, i] feeds the host's arbitrary-
+    precision Σ counts<<i sum assembly (plane P-1 is the not-null row,
+    giving the group's non-null value count)."""
+    groups = combine_groups(dims, filt)
+    return count_bits_rows(groups), groupby_plane_counts(groups, planes)
+
+
 # -- fold a stack of rows with one op ---------------------------------------
 
 

@@ -124,6 +124,66 @@ def intersection_counts_matrix_batch_pallas(srcs, mat, *, interpret: bool = Fals
     )(srcs, mat)
 
 
+def _groupby_planes_kernel(p_static, planes_ref, groups_ref, out_ref):
+    # Grid (K/TILE_R, W/TILE_W), j innermost: each (TILE_R, TILE_W)
+    # group block is fetched from HBM once per (i, j) and reused for
+    # all P bit planes pinned in VMEM — the segmented-reduce shape of a
+    # GroupBy panel (segment = (plane, group) pair). out is (P, TILE_R)
+    # at index (0, i): constant across consecutive j steps, the safe
+    # revisit/accumulate pattern.
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    grp = groups_ref[:]  # (TILE_R, TILE_W)
+    acc = []
+    for p in range(p_static):  # static unroll; P = bit_depth+1 stays small
+        block = jnp.bitwise_and(grp, planes_ref[p, :][None, :])
+        acc.append(
+            jnp.sum(jax.lax.population_count(block).astype(jnp.int32), axis=1)
+        )
+    out_ref[:] += jnp.stack(acc, axis=0)  # (P, TILE_R)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def groupby_plane_counts_pallas(planes, groups, *, interpret: bool = False):
+    """Segmented GroupBy×BSI reduction: planes u32[P, W], groups
+    u32[K, W] -> i32[P, K].
+
+    K (the panel's cross-product size) is the streaming axis; the few
+    bit planes stay resident in VMEM for the whole scan, so each group
+    block crosses HBM exactly once regardless of bit depth. K must be a
+    multiple of TILE_R and W of TILE_W (pad_for_pallas; zero-padded
+    groups score 0 everywhere and are sliced off by the caller). The
+    jit fallback is ops.packed.groupby_plane_counts (note the
+    transposed [K, P] output there).
+    """
+    p, w = planes.shape
+    if p > 512:
+        # the kernel unrolls the plane loop; bit depth is ≤ 64 in
+        # practice but guard the Mosaic compile-time cliff anyway
+        raise ValueError(f"plane batch too large for kernel unroll: {p} > 512")
+    k, _ = groups.shape
+    grid = (k // TILE_R, w // TILE_W)
+    return pl.pallas_call(
+        functools.partial(_groupby_planes_kernel, p),
+        out_shape=jax.ShapeDtypeStruct((p, k), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((p, TILE_W), lambda i, j: (0, j), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (TILE_R, TILE_W), lambda i, j: (i, j), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (p, TILE_R), lambda i, j: (0, i), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(planes, groups)
+
+
 def _expand_runs_kernel(starts_ref, ends_ref, out_ref):
     # One (1, TILE_W) word tile per grid step; every run clamps its
     # [start, end] bit interval against each word's 32-bit span and

@@ -898,6 +898,12 @@ class Cluster:
                 return Row(*raw.get("columns", []))
             if "value" in raw and "count" in raw:
                 return ValCount(raw["value"], raw["count"])
+        if c.name == "GroupBy":
+            # un-finalized wire group list ([{group, count[, sum]}, ...]);
+            # the coordinator merges legs then ranks/limits once
+            return list(raw) if isinstance(raw, list) else raw
+        if c.name == "Distinct":
+            return [int(v) for v in raw] if isinstance(raw, list) else raw
         if isinstance(raw, list):
             return pairs_to_tuples(raw)
         return raw

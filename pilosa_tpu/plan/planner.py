@@ -90,6 +90,34 @@ def subtree_fields(c: Call) -> Optional[frozenset]:
                     return None
                 fields |= f
             return frozenset(fields)
+        if name == "Rows":
+            field, ok = c.string_arg("_field")
+            if not ok:
+                return None
+            return frozenset([field])
+        if name == "GroupBy":
+            # dims (Rows), aggregate (bare Sum) and filter are all
+            # children — their union covers every fragment read
+            if not c.children:
+                return None
+            fields = set()
+            for ch in c.children:
+                f = subtree_fields(ch)
+                if f is None:
+                    return None
+                fields |= f
+            return frozenset(fields)
+        if name in ("Distinct", "Percentile"):
+            field, ok = c.string_arg("field")
+            if not ok:
+                return None
+            fields = {field}
+            for ch in c.children:
+                f = subtree_fields(ch)
+                if f is None:
+                    return None
+                fields |= f
+            return frozenset(fields)
     except (ValueError, TypeError):
         return None
     return None  # writes / unknown calls
@@ -111,6 +139,19 @@ def extract_row_operands(calls) -> list[tuple[str, int]]:
                 return
             if ok:
                 out.append((field, int(row_id)))
+            return
+        if c.name == "Rows":
+            # GroupBy dimension with explicit ids — each id is a
+            # standard-view row block the stager can promote ahead of
+            # the segmented-reduction launch. Discovered dims (no ids=)
+            # are unknowable before execution; skip them.
+            try:
+                field, ok = c.string_arg("_field")
+                ids, has_ids = c.uint_slice_arg("ids")
+            except (ValueError, TypeError):
+                return
+            if ok and has_ids:
+                out.extend((field, int(r)) for r in ids)
             return
         for ch in c.children:
             walk(ch)

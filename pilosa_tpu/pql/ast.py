@@ -149,6 +149,7 @@ class Call:
             "SetColumnAttrs",
             "SetRowAttrs",
             "TopN",
+            "Rows",
             "Range",
         )
         parts: list[str] = []

@@ -96,6 +96,7 @@ class Parser:
             "SetColumnAttrs": self._set_column_attrs_call,
             "Clear": self._clear_call,
             "TopN": self._topn_call,
+            "Rows": self._rows_call,
             "Range": self._range_call,
         }.get(ident)
         if special is not None:
@@ -197,6 +198,18 @@ class Parser:
         call.args["_field"] = field
         if self._comma():
             self._allargs(call)
+        return call
+
+    def _rows_call(self) -> Call:
+        # Rows(field[, ids=[...]]) — a GroupBy dimension; same positional
+        # field grammar as TopN
+        call = Call("Rows")
+        field = self._match(_FIELD_RE)
+        if field is None:
+            raise ParseError("Rows() requires a field")
+        call.args["_field"] = field
+        if self._comma():
+            self._args(call)
         return call
 
     def _range_call(self) -> Call:

@@ -272,6 +272,18 @@ class Config:
     # distinct call mix compiles its own fused program; bounding the
     # mix bounds compile-cache growth)
     fusion_max_calls: int = 64
+    # device-resident analytics (executor/analytics.py): cap on the
+    # cross-product group count K of one GroupBy panel — a panel whose
+    # dims multiply past this fails with a clear error instead of
+    # allocating an unbounded [K, shards·words] device transient
+    analytics_max_groups: int = 10000
+    # default per-request deadline (seconds) for analytic queries
+    # (GroupBy / Distinct / Percentile) when the client sends neither a
+    # `timeout` param nor an X-Request-Deadline header — they run in
+    # the BULK pipeline class with its own SLO objective, so they get
+    # their own budget instead of pipeline-default-timeout (0 =
+    # unbounded, same convention)
+    analytics_timeout: float = 10.0
     # HBM byte budget for the device-resident plan cache: __cached
     # subtree bitmap stacks pinned on device so repeated subtrees stop
     # re-uploading. 0 disables (host plan cache still works)
@@ -443,6 +455,8 @@ class Config:
             f"plan-cache-min-cost = {self.plan_cache_min_cost}",
             f"fusion-enabled = {'true' if self.fusion_enabled else 'false'}",
             f"fusion-max-calls = {self.fusion_max_calls}",
+            f"analytics-max-groups = {self.analytics_max_groups}",
+            f"analytics-timeout = {self.analytics_timeout}",
             f"plan-cache-device-bytes = {self.plan_cache_device_bytes}",
             f"hbm-budget-bytes = {self.hbm_budget_bytes}",
             f'device-faults = "{self.device_faults}"',

@@ -22,6 +22,7 @@ from pilosa_tpu.executor import ValCount
 from pilosa_tpu.server import deadline as deadline_mod
 from pilosa_tpu.server.api import API, APIError
 from pilosa_tpu.server.deadline import DeadlineExceeded
+from pilosa_tpu.server import pipeline as pipeline_mod
 from pilosa_tpu.server.pipeline import (
     CLASS_BULK,
     CLASS_INTERACTIVE,
@@ -99,6 +100,7 @@ class Handler:
         long_query_time: float = 0.0,
         pipeline=None,
         default_timeout: float = 0.0,
+        analytics_timeout: float = 0.0,
         ingest=None,
     ) -> None:
         self.api = api
@@ -109,6 +111,9 @@ class Handler:
         # (bare handlers in tests, pipeline-enabled = false)
         self.pipeline = pipeline
         self.default_timeout = default_timeout
+        # default deadline for analytic bulk queries when the client
+        # sends none (config analytics-timeout; 0 = use default_timeout)
+        self.analytics_timeout = analytics_timeout
         # durable ingest queue (server/ingest.py); None = waves apply
         # synchronously through the bulk class (ingest-enabled = false)
         self.ingest = ingest
@@ -285,18 +290,23 @@ class Handler:
         # request a leg of a distributed trace (api.query adopts the
         # id); malformed headers parse to None and never fail the query
         trace_ctx = trace.parse_traceparent(req.headers.get("traceparent"))
-        dl = deadline_mod.from_request(req.headers, q, self.default_timeout)
-        # pipeline classification: remote legs of distributed queries
-        # are internal traffic (their own queue — a user-query flood
-        # must not shed the cluster data plane); everything else is
-        # interactive. Read-only queries coalesce (singleflight) by
-        # CANONICAL plan signature (plan/canon.py) — argument-order-
+        # pipeline classification (pipeline.classify_query): remote legs
+        # are internal traffic; analytic bulk queries (GroupBy /
+        # Distinct / Percentile) run in the BULK class with their own
+        # default deadline budget (analytics-timeout), so a panel burst
+        # burns the bulk SLO instead of interactive p50; everything
+        # else is interactive. Read-only queries coalesce (singleflight)
+        # by CANONICAL plan signature (plan/canon.py) — argument-order-
         # permuted duplicates like Intersect(Row(a),Row(b)) vs
         # Intersect(Row(b),Row(a)) share one execution; unparseable
         # text falls back to the raw bytes so syntax errors still 400
         # individually. Plain whole-index reads additionally gang into
         # combined cross-request executions.
-        cls = CLASS_INTERNAL if remote else CLASS_INTERACTIVE
+        cls = pipeline_mod.classify_query(body, remote)
+        default_t = self.default_timeout
+        if cls == CLASS_BULK and self.analytics_timeout > 0:
+            default_t = self.analytics_timeout
+        dl = deadline_mod.from_request(req.headers, q, default_t)
         signature = None
         batch = None
         # waterfall requests skip cross-request coalescing/batching like

@@ -52,6 +52,7 @@ expiries — docs/administration.md §Metric reference) and in the
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from collections import deque
@@ -66,6 +67,26 @@ CLASS_INTERACTIVE = "interactive"
 CLASS_BULK = "bulk"
 CLASS_INTERNAL = "internal"
 CLASSES = (CLASS_INTERACTIVE, CLASS_BULK, CLASS_INTERNAL)
+
+# analytic bulk-query detector (executor/analytics.py): like the write
+# detector in the HTTP layer, a false positive from a quoted key only
+# reroutes the request to a stricter class, never breaks it
+_ANALYTIC_CALL_RE = re.compile(r"\b(?:GroupBy|Distinct|Percentile)\s*\(")
+
+
+def classify_query(body: str, remote: bool) -> str:
+    """Pipeline class for one /query body. Remote legs of distributed
+    queries are internal traffic (their own queue — a user-query flood
+    must not shed the cluster data plane). Analytic bulk queries
+    (GroupBy / Distinct / Percentile) route to the BULK class: a
+    dashboard's panel burst then queues behind the bulk workers and
+    burns the bulk SLO budget instead of interactive p50. Everything
+    else is interactive."""
+    if remote:
+        return CLASS_INTERNAL
+    if body and _ANALYTIC_CALL_RE.search(body):
+        return CLASS_BULK
+    return CLASS_INTERACTIVE
 
 
 class Overloaded(Exception):

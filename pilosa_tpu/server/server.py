@@ -257,6 +257,7 @@ class Server:
             fusion_max_calls=self.config.fusion_max_calls,
             plan_cache_device_bytes=self.config.plan_cache_device_bytes,
             governor=HbmGovernor(budget_bytes=self.config.hbm_budget_bytes),
+            analytics_max_groups=self.config.analytics_max_groups,
         )
         self.api = API(self.holder, self.executor, cluster=cluster, server=self)
         # federation (parallel/federation.py): epoch adopted from the
@@ -385,6 +386,7 @@ class Server:
             long_query_time=self.config.cluster.long_query_time,
             pipeline=self.pipeline,
             default_timeout=self.config.pipeline_default_timeout,
+            analytics_timeout=self.config.analytics_timeout,
             ingest=self.ingest,
         )
         self.diagnostics = DiagnosticsCollector(

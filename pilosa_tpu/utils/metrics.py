@@ -186,6 +186,12 @@ FUSION_FUSED_CALLS_PER_LAUNCH = "fusion.fused_calls_per_launch"
 FUSION_BYTES_RETURNED = "fusion.bytes_returned"
 FUSION_BYPASSES = "fusion.bypasses"
 FUSION_ADMISSION_SPLITS = "fusion.admission_splits"
+# device-resident analytics (executor/analytics.py, ISSUE 18): GroupBy
+# panels lowered as segmented reductions, Distinct / Percentile BSI scans
+FUSION_GROUPBY_LAUNCHES = "fusion.groupby_launches"
+FUSION_GROUPBY_GROUPS = "fusion.groupby_groups"
+ANALYTICS_QUERIES = "analytics.queries"
+ANALYTICS_DEGRADED_LEGS = "analytics.degraded_legs"
 # device-resident plan cache (plan/cache.py DevicePlanCache)
 PLANCACHE_DEVICE_HITS = "plancache.device_hits"
 PLANCACHE_DEVICE_EVICTIONS = "plancache.device_evictions"
@@ -621,6 +627,27 @@ METRICS: dict[str, tuple[str, str]] = {
         "fused launches split into smaller programs (or partially "
         "routed to the classic path) because the estimated transient "
         "peak exceeded governor HBM headroom",
+    ),
+    FUSION_GROUPBY_LAUNCHES: (
+        "counter",
+        "GroupBy panels answered by one segmented-reduction device "
+        "launch (the K point queries a panel would have cost collapse "
+        "to a single jitted program)",
+    ),
+    FUSION_GROUPBY_GROUPS: (
+        "summary",
+        "cross-product group count (K) per segmented GroupBy launch",
+    ),
+    ANALYTICS_QUERIES: (
+        "counter",
+        "analytic bulk queries executed (label: call = "
+        "GroupBy/Distinct/Percentile)",
+    ),
+    ANALYTICS_DEGRADED_LEGS: (
+        "counter",
+        "analytic device launches degraded to the classic per-shard "
+        "path (quarantined fragment inside the batch, staging failure); "
+        "the classic leg then surfaces the clean error or result",
     ),
     PLANCACHE_DEVICE_HITS: (
         "counter",

@@ -1,0 +1,690 @@
+"""Device-resident analytics (ISSUE 18): GroupBy / Distinct / Percentile.
+
+Property tests against a pure-Python oracle built from the raw imported
+data (never from the executor), bit-identity across the classic CPU
+path, the shard-batched device path, and the fused segmented-reduction
+path; plus the satellite regressions — exactly-one-fused-launch per
+panel, heat-ledger attribution at the batched launch sites, plan-driven
+prefetch of explicit GroupBy dims, quarantine's clean 503 through the
+degrade ladder, bulk-class routing, and docs drift both directions.
+
+Runs under JAX_PLATFORMS=cpu (the tier-1 environment)."""
+
+import itertools
+import json
+import os
+import time
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SHARD_WIDTH
+from pilosa_tpu.core import FieldOptions, Holder
+from pilosa_tpu.core.field import FIELD_TYPE_INT, FIELD_TYPE_TIME
+from pilosa_tpu.core.fragment import FragmentQuarantinedError
+from pilosa_tpu.executor import Executor, ValCount, analytics
+from pilosa_tpu.pql import parse
+from pilosa_tpu.utils import heat, metrics, slo
+
+
+@pytest.fixture()
+def holder():
+    h = Holder()  # in-memory
+    h.open()
+    return h
+
+
+def _counter(name: str) -> float:
+    """Sum a counter family across labels from the global registry."""
+    return sum(
+        v
+        for k, v in metrics.REGISTRY.snapshot().items()
+        if isinstance(v, (int, float)) and str(k).split(";")[0] == name
+    )
+
+
+# -- oracle model -------------------------------------------------------------
+#
+# seed() imports random data and returns a shadow model maintained
+# independently of the index: field -> row -> set(global column ids),
+# plus the BSI value map. The oracle functions below compute every
+# analytic result from that model alone.
+
+
+def seed(holder, rng, name="i", nshards=3, ncols=3000, nseg=5, ndev=4,
+         vmin=-50, vmax=900, val_frac=0.85):
+    idx = holder.create_index(name)
+    seg = idx.create_field("seg")
+    dev = idx.create_field("dev")
+    val = idx.create_field(
+        "v", FieldOptions(type=FIELD_TYPE_INT, min=vmin, max=vmax)
+    )
+    cols = rng.choice(nshards * SHARD_WIDTH, size=ncols, replace=False)
+    segrows = rng.integers(0, nseg, size=ncols)
+    devrows = rng.integers(0, ndev, size=ncols)
+    seg.import_bits(segrows.tolist(), cols.tolist())
+    dev.import_bits(devrows.tolist(), cols.tolist())
+    mask = rng.random(ncols) < val_frac
+    vcols = cols[mask]
+    vals = rng.integers(vmin, vmax + 1, size=len(vcols))
+    val.import_values(vcols.tolist(), vals.tolist())
+    model = {"seg": {}, "dev": {}, "vals": dict(zip(vcols.tolist(), vals.tolist()))}
+    for r, c in zip(segrows.tolist(), cols.tolist()):
+        model["seg"].setdefault(int(r), set()).add(int(c))
+    for r, c in zip(devrows.tolist(), cols.tolist()):
+        model["dev"].setdefault(int(r), set()).add(int(c))
+    return model
+
+
+def oracle_groupby(model, dims, filt=None, agg=False, limit=None):
+    """dims: [(field, [row ids in final order])]. ``count`` is the size
+    of the dim-row intersection (∩ filter); ``sum`` totals only columns
+    holding a value (nulls count toward ``count``, never ``sum``)."""
+    out = []
+    for key in itertools.product(*[ids for _, ids in dims]):
+        colsets = [model[f].get(r, set()) for (f, _), r in zip(dims, key)]
+        cols = set.intersection(*colsets) if colsets else set()
+        if filt is not None:
+            cols &= filt
+        if not cols:
+            continue
+        entry = {
+            "group": [
+                {"field": f, "rowID": int(r)}
+                for (f, _), r in zip(dims, key)
+            ],
+            "count": len(cols),
+        }
+        if agg:
+            entry["sum"] = sum(
+                model["vals"][c] for c in cols if c in model["vals"]
+            )
+        out.append(entry)
+    return out[:limit] if limit else out
+
+
+def oracle_distinct(model, filt=None):
+    items = model["vals"].items()
+    return sorted(
+        {v for c, v in items if filt is None or c in filt}
+    )
+
+
+def oracle_percentile(model, nth_bp, filt=None):
+    vals = sorted(
+        v for c, v in model["vals"].items() if filt is None or c in filt
+    )
+    if not vals:
+        return None
+    k = analytics.nearest_rank(nth_bp, len(vals))
+    return ValCount(vals[k - 1], len(vals))
+
+
+def executors(holder):
+    """(classic CPU, shard-batched device, fused device) — the gauntlet."""
+    return (
+        Executor(holder, device_policy="never"),
+        Executor(holder, device_policy="always", fusion_enabled=False),
+        Executor(holder, device_policy="always", fusion_enabled=True),
+    )
+
+
+# -- PQL surface --------------------------------------------------------------
+
+
+class TestParsing:
+    @pytest.mark.parametrize("q", [
+        "GroupBy(Rows(seg))",
+        "GroupBy(Rows(seg), Rows(dev, ids=[0,2]), Sum(field=v), limit=5)",
+        "GroupBy(Rows(seg), Row(dev=1), limit=3)",
+        "Distinct(field=v)",
+        "Distinct(Row(seg=2), field=v)",
+        "Percentile(field=v, nth=99.9)",
+        "Percentile(Row(seg=2), field=v, nth=50)",
+    ])
+    def test_roundtrip(self, q):
+        query = parse(q)
+        assert str(parse(str(query))) == str(query)
+
+    def test_rows_outside_groupby_rejected(self, holder):
+        holder.create_index("i").create_field("seg")
+        e = Executor(holder, device_policy="never")
+        with pytest.raises(ValueError, match="GroupBy"):
+            e.execute("i", "Rows(seg)")
+
+    @pytest.mark.parametrize("q,msg", [
+        ("GroupBy(Row(seg=1))", "Rows"),
+        ("Percentile(field=v)", "nth"),
+        ("Percentile(field=v, nth=101)", "0, 100"),
+        ("Percentile(field=v, nth=12.345)", "decimal"),
+        ("Percentile(field=v, nth=-1)", "0, 100"),
+    ])
+    def test_validation_errors(self, holder, q, msg):
+        idx = holder.create_index("i")
+        idx.create_field("seg")
+        idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT, min=0, max=10))
+        e = Executor(holder, device_policy="never")
+        with pytest.raises(ValueError, match=msg):
+            e.execute("i", q)
+
+
+# -- oracle gauntlet: classic == batched == fused == oracle -------------------
+
+
+class TestOracleGauntlet:
+    @pytest.mark.parametrize("seed_n", [7, 19, 42])
+    def test_groupby_cross_product(self, holder, seed_n):
+        model = seed(holder, np.random.default_rng(seed_n))
+        dims = [
+            ("seg", sorted(model["seg"])),
+            ("dev", sorted(model["dev"])),
+        ]
+        want = oracle_groupby(model, dims, agg=True)
+        q = "GroupBy(Rows(seg), Rows(dev), Sum(field=v))"
+        for e in executors(holder):
+            (got,) = e.execute("i", q)
+            assert got == want
+
+    @pytest.mark.parametrize("seed_n", [7, 42])
+    def test_groupby_filter_and_limit(self, holder, seed_n):
+        model = seed(holder, np.random.default_rng(seed_n))
+        filt = model["seg"].get(2, set())
+        want = oracle_groupby(
+            model, [("dev", sorted(model["dev"]))], filt=filt, limit=3
+        )
+        for e in executors(holder):
+            (got,) = e.execute("i", "GroupBy(Rows(dev), Row(seg=2), limit=3)")
+            assert got == want
+
+    def test_groupby_explicit_ids_keep_given_order(self, holder):
+        model = seed(holder, np.random.default_rng(3))
+        # out-of-order explicit ids + one id with no row: the absent id
+        # yields only zero-count groups, which are dropped everywhere
+        want = oracle_groupby(
+            model, [("dev", [2, 0, 99]), ("seg", sorted(model["seg"]))],
+            agg=True,
+        )
+        assert all(g["group"][0]["rowID"] != 99 for g in want)
+        q = "GroupBy(Rows(dev, ids=[2,0,99]), Rows(seg), Sum(field=v))"
+        for e in executors(holder):
+            (got,) = e.execute("i", q)
+            assert got == want
+
+    @pytest.mark.parametrize("seed_n", [7, 42])
+    def test_distinct(self, holder, seed_n):
+        model = seed(holder, np.random.default_rng(seed_n))
+        for e in executors(holder):
+            (got,) = e.execute("i", "Distinct(field=v)")
+            assert got == oracle_distinct(model)
+            (got,) = e.execute("i", "Distinct(Row(seg=1), field=v)")
+            assert got == oracle_distinct(model, filt=model["seg"].get(1, set()))
+
+    @pytest.mark.parametrize("nth", [0, 0.01, 25, 50, 90, 99.99, 100])
+    def test_percentile(self, holder, nth):
+        model = seed(holder, np.random.default_rng(11))
+        nth_bp = int(round(nth * 100))
+        want = oracle_percentile(model, nth_bp)
+        for e in executors(holder):
+            (got,) = e.execute("i", f"Percentile(field=v, nth={nth})")
+            assert got == want
+        wantf = oracle_percentile(model, nth_bp, filt=model["seg"].get(2, set()))
+        for e in executors(holder):
+            (got,) = e.execute("i", f"Percentile(Row(seg=2), field=v, nth={nth})")
+            assert got == wantf
+
+    def test_empty_index(self, holder):
+        idx = holder.create_index("i")
+        idx.create_field("seg")
+        idx.create_field("v", FieldOptions(type=FIELD_TYPE_INT, min=0, max=100))
+        for e in executors(holder):
+            (g,) = e.execute("i", "GroupBy(Rows(seg))")
+            assert g == []
+            (d,) = e.execute("i", "Distinct(field=v)")
+            assert d == []
+            (p,) = e.execute("i", "Percentile(field=v, nth=50)")
+            assert p.count == 0
+
+    def test_time_quantum_filter(self, holder):
+        """GroupBy filtered by a time-quantum Range: the filter subtree
+        fans out through quantum views identically on every path."""
+        idx = holder.create_index("i")
+        seg = idx.create_field("seg")
+        idx.create_field("t", FieldOptions(type=FIELD_TYPE_TIME, time_quantum="YMD"))
+        e0 = Executor(holder, device_policy="never")
+        model = {"seg": {}, "vals": {}}
+        rng = np.random.default_rng(5)
+        for col in range(0, 2 * SHARD_WIDTH, 9173):
+            r = int(rng.integers(0, 3))
+            seg.set_bit(r, col)
+            model["seg"].setdefault(r, set()).add(col)
+            day = 1 + (col % 4)  # days 1-4; filter below spans 1-2
+            e0.execute("i", f"Set({col}, t=1, 2010-01-0{day}T00:00)")
+        filt_q = "Range(t=1, 2010-01-01T00:00, 2010-01-03T00:00)"
+        (frow,) = e0.execute("i", filt_q)
+        filt = set(frow.columns().tolist())
+        assert filt  # the window is populated
+        want = oracle_groupby(
+            model, [("seg", sorted(model["seg"]))], filt=filt
+        )
+        for e in executors(holder):
+            (got,) = e.execute("i", f"GroupBy(Rows(seg), {filt_q})")
+            assert got == want
+
+    def test_freshness_under_mid_run_ingest(self, holder):
+        """An ingest wave between two panel executions must be visible:
+        generation bumps invalidate staged blocks and cached plans."""
+        rng = np.random.default_rng(23)
+        model = seed(holder, rng, ncols=1500)
+        e_cpu, e_dev, e_fused = executors(holder)
+        q = "GroupBy(Rows(seg), Rows(dev), Sum(field=v))"
+        for e in (e_dev, e_fused):
+            (warm,) = e.execute("i", q)  # stage + compile
+            assert warm == oracle_groupby(
+                model,
+                [("seg", sorted(model["seg"])), ("dev", sorted(model["dev"]))],
+                agg=True,
+            )
+        # mid-run wave: new columns land in both dims and the BSI field
+        idx = holder.index("i")
+        newcols = [SHARD_WIDTH + 77, 2 * SHARD_WIDTH + 991, 1234567]
+        idx.field("seg").import_bits([0, 1, 2], newcols)
+        idx.field("dev").import_bits([1, 1, 3], newcols)
+        idx.field("v").import_values(newcols, [500, -50, 900])
+        for r, c in zip([0, 1, 2], newcols):
+            model["seg"].setdefault(r, set()).add(c)
+        for r, c in zip([1, 1, 3], newcols):
+            model["dev"].setdefault(r, set()).add(c)
+        model["vals"].update(dict(zip(newcols, [500, -50, 900])))
+        want = oracle_groupby(
+            model,
+            [("seg", sorted(model["seg"])), ("dev", sorted(model["dev"]))],
+            agg=True,
+        )
+        for e in (e_cpu, e_dev, e_fused):
+            (got,) = e.execute("i", q)
+            assert got == want
+
+    def test_max_groups_cap(self, holder):
+        seed(holder, np.random.default_rng(1))
+        e = Executor(holder, device_policy="never", analytics_max_groups=4)
+        with pytest.raises(ValueError, match="analytics-max-groups"):
+            e.execute("i", "GroupBy(Rows(seg), Rows(dev))")
+
+
+# -- fused launch accounting --------------------------------------------------
+
+
+class TestFusedLaunch:
+    def test_panel_is_exactly_one_fused_launch(self, holder):
+        """A K-combination GroupBy panel must execute as ONE fused
+        segmented-reduction launch — counter-proven on the fuser and on
+        the fusion.groupby_launches metric family."""
+        model = seed(holder, np.random.default_rng(13))
+        e = Executor(holder, device_policy="always", fusion_enabled=True)
+        before_launch = e.fuser.fused_launches
+        before_metric = _counter(metrics.FUSION_GROUPBY_LAUNCHES)
+        (got,) = e.execute("i", "GroupBy(Rows(seg), Rows(dev), Sum(field=v))")
+        assert e.fuser.fused_launches - before_launch == 1
+        assert _counter(metrics.FUSION_GROUPBY_LAUNCHES) - before_metric == 1
+        k = len(model["seg"]) * len(model["dev"])
+        assert 0 < len(got) <= k
+
+    def test_mixed_query_single_launch(self, holder):
+        """Interactive calls and a panel in one query still fuse into a
+        single launch, and every result matches the classic path."""
+        seed(holder, np.random.default_rng(17))
+        e = Executor(holder, device_policy="always", fusion_enabled=True)
+        cpu = Executor(holder, device_policy="never")
+        q = (
+            "Count(Row(seg=1))"
+            "GroupBy(Rows(dev), Sum(field=v))"
+            "Distinct(field=v)"
+            "Percentile(field=v, nth=95)"
+        )
+        before = e.fuser.fused_launches
+        got = e.execute("i", q)
+        assert e.fuser.fused_launches - before == 1
+        assert got == cpu.execute("i", q)
+
+    def test_analytics_queries_metric_labels(self, holder):
+        seed(holder, np.random.default_rng(2))
+        e = Executor(holder, device_policy="never")
+        snap0 = metrics.REGISTRY.snapshot()
+        e.execute("i", "GroupBy(Rows(seg))")
+        e.execute("i", "Distinct(field=v)")
+        e.execute("i", "Percentile(field=v, nth=50)")
+        snap1 = metrics.REGISTRY.snapshot()
+        for call in ("GroupBy", "Distinct", "Percentile"):
+            key = f"{metrics.ANALYTICS_QUERIES};call:{call}"
+            assert snap1.get(key, 0) - snap0.get(key, 0) == 1, call
+
+
+# -- satellite: heat-ledger attribution at the batched launch sites -----------
+
+
+class TestHeatAttribution:
+    @pytest.fixture(autouse=True)
+    def _clean_ledger(self):
+        heat.LEDGER.clear()
+        heat.LEDGER.configure(True, 300.0)
+        yield
+        heat.LEDGER.clear()
+        heat.LEDGER.configure(True, 300.0)
+
+    def _reads(self):
+        cells = heat.LEDGER.snapshot()["cells"]
+        return {
+            (c["field"], c["shard"]): c["reads"]
+            for c in cells
+            if c["reads"] > 0
+        }
+
+    @pytest.mark.parametrize("fusion", [False, True])
+    def test_multi_shard_groupby_records_reads(self, holder, fusion):
+        """Regression (satellite 1): the segmented-reduction launch
+        sites bypass _map_reduce's per-shard loop, so they must record
+        their own read legs — every (field, shard) the panel touched."""
+        seed(holder, np.random.default_rng(29))
+        e = Executor(holder, device_policy="always", fusion_enabled=fusion)
+        e.execute("i", "GroupBy(Rows(seg), Rows(dev), Sum(field=v))")
+        reads = self._reads()
+        assert reads, "multi-shard GroupBy recorded no heat reads"
+        for field in ("seg", "dev", "v"):
+            for shard in range(3):
+                assert reads.get((field, shard), 0) > 0, (field, shard)
+
+    def test_distinct_and_percentile_record_reads(self, holder):
+        seed(holder, np.random.default_rng(31))
+        e = Executor(holder, device_policy="always", fusion_enabled=False)
+        e.execute("i", "Distinct(field=v)")
+        e.execute("i", "Percentile(field=v, nth=50)")
+        reads = self._reads()
+        for shard in range(3):
+            assert reads.get(("v", shard), 0) >= 2, shard
+
+
+# -- satellite: plan-driven prefetch sees analytic operands -------------------
+
+
+class TestPrefetchWidening:
+    def test_extract_row_operands_sees_analytic_calls(self):
+        q = parse(
+            "GroupBy(Rows(dev, ids=[4,1]), Rows(seg), Row(seg=2), Sum(field=v))"
+            "Percentile(Row(seg=7), field=v, nth=50)"
+        )
+        ops = __import__(
+            "pilosa_tpu.plan.planner", fromlist=["extract_row_operands"]
+        ).extract_row_operands(q.calls)
+        # explicit dim ids + filter Row leaves; discovered dims are
+        # unknowable pre-execution and stay out
+        assert ops == [("dev", 4), ("dev", 1), ("seg", 2), ("seg", 7)]
+
+    def test_prefetch_accuracy_attributed_on_queued_groupby(self, holder):
+        """A queued GroupBy's explicit dim rows stage ahead of the
+        launch; executing the panel then reaches the speculative blocks
+        and attributes them used (satellite 2)."""
+        from pilosa_tpu.executor.tiering import PrefetchScheduler
+
+        seed(holder, np.random.default_rng(37))
+        e = Executor(holder, device_policy="always", fusion_enabled=False)
+        sched = PrefetchScheduler(e, depth=2, enabled=True)
+        q = "GroupBy(Rows(dev, ids=[0,1]), Row(seg=2))"
+        item = types.SimpleNamespace(query=parse(q), index="i", shards=None)
+        n = sched.schedule([item])
+        assert n > 0 and sched.scheduled == n
+        deadline = time.monotonic() + 5.0
+        while e.stager.prefetch_issued < n and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert e.stager.prefetch_issued >= n
+        e.execute("i", q)
+        assert e.stager.prefetch_used > 0
+
+
+# -- satellite: quarantine degrades to the clean 503 --------------------------
+
+
+class TestQuarantineDegrade:
+    @pytest.mark.parametrize("fusion", [False, True])
+    def test_groupby_clean_503(self, holder, fusion):
+        from pilosa_tpu.core import VIEW_STANDARD
+
+        seed(holder, np.random.default_rng(41))
+        frag = holder.fragment("i", "seg", VIEW_STANDARD, 1)
+        frag.quarantine("test corruption")
+        e = Executor(holder, device_policy="always", fusion_enabled=fusion)
+        before = _counter(metrics.ANALYTICS_DEGRADED_LEGS)
+        with pytest.raises(FragmentQuarantinedError) as ei:
+            e.execute("i", "GroupBy(Rows(seg), Rows(dev))")
+        assert ei.value.status == 503
+        # the device leg degraded to the classic path (which then
+        # surfaced the quarantine cleanly) instead of poisoning the
+        # fused/batched launch with an opaque device error
+        assert _counter(metrics.ANALYTICS_DEGRADED_LEGS) > before
+
+    def test_distinct_clean_503(self, holder):
+        from pilosa_tpu.core import VIEW_BSI_GROUP_PREFIX
+
+        seed(holder, np.random.default_rng(43))
+        frag = holder.fragment("i", "v", VIEW_BSI_GROUP_PREFIX + "v", 0)
+        frag.quarantine("test corruption")
+        e = Executor(holder, device_policy="always", fusion_enabled=True)
+        with pytest.raises(FragmentQuarantinedError) as ei:
+            e.execute("i", "Distinct(field=v)")
+        assert ei.value.status == 503
+
+    def test_healthy_shards_unaffected_after_degrade(self, holder):
+        """After a quarantine-triggered failure, a query not touching
+        the quarantined fragment still runs on the device path."""
+        from pilosa_tpu.core import VIEW_STANDARD
+
+        model = seed(holder, np.random.default_rng(47))
+        holder.fragment("i", "seg", VIEW_STANDARD, 1).quarantine("test")
+        e = Executor(holder, device_policy="always", fusion_enabled=True)
+        with pytest.raises(FragmentQuarantinedError):
+            e.execute("i", "GroupBy(Rows(seg))")
+        (got,) = e.execute("i", "GroupBy(Rows(dev))")
+        assert got == oracle_groupby(model, [("dev", sorted(model["dev"]))])
+
+
+# -- merge / federation units -------------------------------------------------
+
+
+class TestMergeUnits:
+    def test_merge_group_lists_sums_and_copies(self):
+        a = [{"group": [{"field": "f", "rowID": 1}], "count": 2, "sum": 10}]
+        b = [
+            {"group": [{"field": "f", "rowID": 1}], "count": 3, "sum": 5},
+            {"group": [{"field": "f", "rowID": 0}], "count": 1},
+        ]
+        merged = analytics.merge_group_lists(a, b)
+        assert [analytics.group_key(e) for e in merged] == [(0,), (1,)]
+        assert merged[1]["count"] == 5 and merged[1]["sum"] == 15
+        # inputs never mutated (remote decodes can be cached)
+        assert a[0]["count"] == 2 and b[0]["count"] == 3
+
+    def test_finalize_ranks_explicit_ids_by_position(self):
+        plan = analytics.GroupByPlan([("f", [5, 2, 9])], None, None, 2)
+        merged = [
+            {"group": [{"field": "f", "rowID": r}], "count": c}
+            for r, c in ((2, 4), (9, 1), (5, 7))
+        ]
+        got = analytics.finalize_groups(plan, merged)
+        assert [analytics.group_key(e) for e in got] == [(5,), (2,)]
+
+    def test_finalize_drops_zero_counts(self):
+        plan = analytics.GroupByPlan([("f", None)], None, None, None)
+        merged = [
+            {"group": [{"field": "f", "rowID": 1}], "count": 0},
+            {"group": [{"field": "f", "rowID": 2}], "count": 3},
+        ]
+        assert [
+            analytics.group_key(e)
+            for e in analytics.finalize_groups(plan, merged)
+        ] == [(2,)]
+
+    @pytest.mark.parametrize("nth_bp,count,want", [
+        (0, 5, 1), (10000, 5, 5), (5000, 4, 2), (5000, 5, 3),
+        (9999, 10000, 9999), (1, 10000, 1), (2500, 7, 2),
+    ])
+    def test_nearest_rank(self, nth_bp, count, want):
+        assert analytics.nearest_rank(nth_bp, count) == want
+
+    def test_nearest_rank_matches_ceil_definition(self):
+        import math
+
+        for nth_bp in (0, 1, 37, 5000, 9999, 10000):
+            for count in (1, 2, 9, 100, 12345):
+                k = analytics.nearest_rank(nth_bp, count)
+                want = min(max(math.ceil(nth_bp * count / 10000), 1), count)
+                assert k == want, (nth_bp, count)
+
+    def test_decode_presence_words(self):
+        words = np.array([0b1010, 0, 1 << 31], dtype=np.uint32)
+        assert analytics.decode_presence_words(words, -3) == [-2, 0, 92]
+
+    def test_decode_remote_branches(self):
+        from pilosa_tpu.parallel.cluster import Cluster
+        from pilosa_tpu.pql.ast import Call
+
+        raw = [{"group": [{"field": "seg", "rowID": 1}], "count": 3}]
+        assert Cluster._decode_remote(Call("GroupBy"), raw) == raw
+        assert Cluster._decode_remote(Call("Distinct"), [3, 1]) == [3, 1]
+        vc = Cluster._decode_remote(Call("Percentile"), {"value": 7, "count": 2})
+        assert vc == ValCount(7, 2)
+
+    def test_heat_fields(self):
+        q = parse("GroupBy(Rows(seg), Rows(dev), Row(seg=1), Sum(field=v))")
+        assert analytics.heat_fields(q.calls[0]) == ["seg", "dev", "v"]
+        q2 = parse("Distinct(field=v)")
+        assert analytics.heat_fields(q2.calls[0]) == ["v"]
+
+
+# -- serving surface: bulk class + HTTP + /debug/heat -------------------------
+
+
+def _req(server, method, path, body=None):
+    url = server.uri + path
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    r = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(r) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+class TestServing:
+    @pytest.fixture()
+    def server(self, tmp_path):
+        from pilosa_tpu.server import Config, Server
+
+        heat.LEDGER.clear()
+        slo.MONITOR.clear()
+        cfg = Config(
+            data_dir=str(tmp_path / "data"),
+            bind="127.0.0.1:0",
+            metric="expvar",
+            device_policy="always",
+            device_timeout=0,
+        )
+        s = Server(cfg)
+        s.open()
+        yield s
+        s.close()
+        heat.LEDGER.clear()
+
+    def test_classify(self):
+        from pilosa_tpu.server.pipeline import classify_query
+
+        assert classify_query("GroupBy(Rows(seg))", False) == "bulk"
+        assert classify_query("Distinct(field=v)", False) == "bulk"
+        assert classify_query("Percentile(field=v, nth=1)", False) == "bulk"
+        assert classify_query("Count(Row(seg=1))", False) == "interactive"
+        assert classify_query("GroupBy(Rows(seg))", True) == "internal"
+
+    def test_http_groupby_bulk_class_and_heat(self, server):
+        """End to end over HTTP: wire shapes, bulk-class SLO accounting,
+        and the /debug/heat regression — a multi-shard GroupBy shows
+        nonzero reads on every touched (field, shard) cell."""
+        seg = server.holder.create_index("an").create_field("seg")
+        val = server.holder.index("an").create_field(
+            "v", FieldOptions(type=FIELD_TYPE_INT, min=0, max=100)
+        )
+        cols = list(range(0, 2 * SHARD_WIDTH, 131072))
+        seg.import_bits([c % 3 for c in cols], cols)
+        val.import_values(cols, [c % 97 for c in cols])
+        st, body = _req(
+            server, "POST", "/index/an/query",
+            b"GroupBy(Rows(seg), Sum(field=v))",
+        )
+        assert st == 200, body
+        groups = body["results"][0]
+        assert groups and all(
+            g["group"][0]["field"] == "seg" and g["count"] > 0 and "sum" in g
+            for g in groups
+        )
+        st, body = _req(
+            server, "POST", "/index/an/query",
+            b"Percentile(field=v, nth=50)",
+        )
+        assert st == 200 and set(body["results"][0]) == {"value", "count"}
+        # bulk-class SLO accounting took the analytic requests
+        cls = slo.MONITOR.snapshot()["classes"]["bulk"]["samples"]
+        assert cls["good"] >= 2
+        # /debug/heat regression: nonzero reads on both shards
+        st, snap = _req(server, "GET", "/debug/heat?index=an")
+        assert st == 200
+        reads = {
+            (c["field"], c["shard"]): c["reads"]
+            for c in snap["cells"]
+            if c["reads"] > 0
+        }
+        for shard in (0, 1):
+            assert reads.get(("seg", shard), 0) > 0, shard
+            assert reads.get(("v", shard), 0) > 0, shard
+
+
+# -- docs drift guard ---------------------------------------------------------
+
+
+def _doc(name: str) -> str:
+    root = os.path.join(os.path.dirname(__file__), "..", "docs")
+    with open(os.path.join(root, name)) as f:
+        return f.read()
+
+
+def test_docs_document_analytics_knobs_with_current_defaults():
+    from pilosa_tpu.server import Config
+
+    cfg = Config(data_dir="x")
+    conf = _doc("configuration.md")
+    for knob, default in (
+        ("analytics-max-groups", str(cfg.analytics_max_groups)),
+        ("analytics-timeout", str(cfg.analytics_timeout)),
+    ):
+        assert f"| `{knob}` | {default} |" in conf, knob
+    # the bulk-class SLO objective default the analytic class burns
+    assert "bulk=2000@0.99" in conf
+
+
+def test_docs_query_language_covers_analytic_calls():
+    ql = _doc("query-language.md")
+    for call in ("GroupBy(", "Distinct(", "Percentile(", "Rows("):
+        assert call in ql, call
+    for shape in ("`GroupBy`", "`Distinct`", "`Percentile`"):
+        assert f"| {shape} |" in ql, shape  # result-shape table rows
+
+
+def test_docs_administration_names_analytics_metrics():
+    admin = _doc("administration.md")
+    for m in (
+        metrics.FUSION_GROUPBY_LAUNCHES,
+        metrics.FUSION_GROUPBY_GROUPS,
+        metrics.ANALYTICS_QUERIES,
+        metrics.ANALYTICS_DEGRADED_LEGS,
+    ):
+        assert f"`{m}`" in admin, m
