@@ -297,7 +297,7 @@ def _pipeline_serving_probe(budget_s: float) -> dict:
     while the kernel sustained thousands. Also runs a short OVERLOAD
     segment (injected per-query delay + shrunken queue so offered load
     exceeds capacity) showing goodput holds near unloaded capacity
-    while the excess sheds as 429."""
+    while the excess sheds as 503 + Retry-After."""
     import json as _json
     import shutil as _shutil
     import tempfile
@@ -356,7 +356,7 @@ def _pipeline_serving_probe(budget_s: float) -> dict:
                             post("/index/pb/query", queries[i % len(queries)])
                             counts[ci] += 1
                         except urllib.error.HTTPError as e:
-                            if e.code == 429:
+                            if e.code in (429, 503):
                                 shed[ci] += 1
                             else:
                                 raise
@@ -424,12 +424,12 @@ def _pipeline_serving_probe(budget_s: float) -> dict:
                                 )
                                 ok[ci] += 1
                             except urllib.error.HTTPError as e:
-                                if e.code == 429:
+                                if e.code in (429, 503):
                                     shed[ci] += 1
                                     # brief backoff (well under the
                                     # advertised Retry-After): a shed
                                     # client that re-fires instantly
-                                    # melts the 1-core host with 429
+                                    # melts the 1-core host with shed
                                     # churn; offered load still far
                                     # exceeds capacity
                                     time.sleep(0.01)
@@ -474,7 +474,7 @@ def _pipeline_serving_probe(budget_s: float) -> dict:
                     "unique writes (non-coalescable) + 20 ms/query delay "
                     "+ interactive queue shrunk to 4, offered load ~4x "
                     "capacity; goodput should hold near unloaded "
-                    "capacity while the excess sheds as 429"
+                    "capacity while the excess sheds as 503"
                 ),
             }
         with urllib.request.urlopen(s.uri + "/debug/pipeline", timeout=30) as r:
@@ -1590,6 +1590,180 @@ def _plan_cache_probe(budget_s: float) -> dict:
     return out
 
 
+def _tenant_mix_probe(budget_s: float) -> dict:
+    """Multi-tenant isolation under an abusive neighbor (ISSUE 19):
+    dozens of index tenants with Zipf-distributed offered load share
+    one server, then one extra tenant goes flat-out at >=10x the rate
+    its weight entitles it to. The abuser's excess must be refused with
+    per-tenant 429s (admitted rate tracks its cap), and the p50 of the
+    well-behaved population must move <10% vs a no-abuser baseline
+    segment — its burst is invisible to everyone else."""
+    import json as _json
+    import shutil as _shutil
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from pilosa_tpu.server import Config, Server
+
+    n_tenants = int(os.environ.get("PILOSA_BENCH_TENANTS", 24))
+    zipf_s = 1.1
+    abuser = "noisy"
+    abuser_qps = 5.0  # explicit cap == what its weight-1 share buys it
+
+    out = {
+        "note": (
+            f"{n_tenants} Zipf-traffic tenants + 1 abusive tenant on one "
+            "server (chip-independent: measures per-tenant admission and "
+            "weighted-fair scheduling, not the kernel)"
+        ),
+        "tenants": n_tenants,
+        "zipf_s": zipf_s,
+    }
+    tenants = [f"t{i}" for i in range(n_tenants)]
+    tmp = tempfile.mkdtemp(prefix="pilosa_tenant_probe_")
+    cfg = Config(
+        data_dir=tmp,
+        bind="127.0.0.1:0",
+        device_policy="never",
+        device_timeout=0,
+        metric="none",
+        tenant_weights=f"*=4,{abuser}=1",
+        tenant_qps=f"{abuser}={abuser_qps:g}",
+        tenant_objectives="*=500@0.99",
+    )
+    s = Server(cfg)
+    s.open()
+    try:
+        def post(path, body):
+            r = urllib.request.Request(s.uri + path, data=body, method="POST")
+            with urllib.request.urlopen(r, timeout=30) as resp:
+                return resp.read()
+
+        for idx in tenants + [abuser]:
+            post(f"/index/{idx}", b"{}")
+            post(f"/index/{idx}/field/f", b"{}")
+            post(f"/index/{idx}/query", b"Set(1, f=1)")
+
+        # Zipf offered load: tenant i trickles at base/(i+1)^s qps. One
+        # thread per tenant — a paced open-ish loop (sleep between
+        # queries) so slow tenants don't block fast ones.
+        paces = [
+            1.0 / max(0.5, 8.0 / ((i + 1) ** zipf_s))
+            for i in range(n_tenants)
+        ]
+
+        def drive(seconds, with_abuser):
+            stop = time.perf_counter() + seconds
+            lats: dict[str, list] = {t: [] for t in tenants}
+            codes: dict[int, int] = {}
+            non200: dict[str, int] = {}
+            codes_lock = threading.Lock()
+            errors = []
+
+            def well_behaved(ti):
+                t = tenants[ti]
+                body = b"Count(Row(f=1))"
+                try:
+                    while time.perf_counter() < stop and not errors:
+                        t0 = time.perf_counter()
+                        try:
+                            post(f"/index/{t}/query", body)
+                            lats[t].append(time.perf_counter() - t0)
+                        except urllib.error.HTTPError as e:
+                            # a well-behaved tenant should never be
+                            # shed; record it rather than abort the run
+                            with codes_lock:
+                                k = f"wb_{e.code}"
+                                non200[k] = non200.get(k, 0) + 1
+                        time.sleep(paces[ti])
+                except BaseException as e:
+                    errors.append(e)
+
+            def abuse():
+                body = b"Count(Row(f=1))"
+                try:
+                    while time.perf_counter() < stop and not errors:
+                        try:
+                            post(f"/index/{abuser}/query", body)
+                            with codes_lock:
+                                codes[200] = codes.get(200, 0) + 1
+                        except urllib.error.HTTPError as e:
+                            with codes_lock:
+                                codes[e.code] = codes.get(e.code, 0) + 1
+                            if e.code not in (429, 503):
+                                raise
+                            # nudge under the advertised Retry-After so
+                            # shed churn doesn't melt the 1-core host
+                            time.sleep(0.005)
+                except BaseException as e:
+                    errors.append(e)
+
+            ts = [
+                threading.Thread(target=well_behaved, args=(ti,))
+                for ti in range(n_tenants)
+            ]
+            if with_abuser:
+                ts.append(threading.Thread(target=abuse))
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errors:
+                raise errors[0]
+            dt = time.perf_counter() - t0
+            return lats, codes, non200, dt
+
+        def p50(xs):
+            return sorted(xs)[len(xs) // 2] if xs else 0.0
+
+        seg = max(3.0, min(9.0, (budget_s - 4.0) / 2.0))
+        drive(min(2.0, budget_s * 0.1), with_abuser=False)  # warm
+        base_lats, _, _, _ = drive(seg, with_abuser=False)
+        mix_lats, codes, non200, dt = drive(seg, with_abuser=True)
+
+        pool_base = [v for xs in base_lats.values() for v in xs]
+        pool_mix = [v for xs in mix_lats.values() for v in xs]
+        b50, m50 = p50(pool_base), p50(pool_mix)
+        admitted = codes.get(200, 0)
+        throttled = codes.get(429, 0)
+        offered_rate = (admitted + throttled) / dt
+        admitted_rate = admitted / dt
+        out["abuser"] = {
+            "weight": 1,
+            "qps_cap": abuser_qps,
+            "offered_rate": round(offered_rate, 1),
+            "admitted_rate": round(admitted_rate, 2),
+            "throttled_429": throttled,
+            "offered_x_cap": round(offered_rate / abuser_qps, 1),
+            "codes": dict(codes),
+        }
+        out["well_behaved_p50_ms"] = {
+            "no_abuser": round(b50 * 1000.0, 3),
+            "with_abuser": round(m50 * 1000.0, 3),
+            "delta_pct": round((m50 - b50) / b50 * 100.0, 1) if b50 else 0.0,
+        }
+        out["per_tenant_p50_ms_with_abuser"] = {
+            t: round(p50(xs) * 1000.0, 3) for t, xs in mix_lats.items()
+        }
+        out["well_behaved_non_200s"] = dict(non200)
+        snap = _json.loads(
+            urllib.request.urlopen(s.uri + "/debug/tenancy", timeout=30).read()
+        )
+        out["isolated"] = bool(
+            throttled > 0
+            and not non200
+            and offered_rate >= abuser_qps * 10
+            and admitted_rate <= abuser_qps * (1.0 + 2.0 / seg) * 1.5
+            and snap.get("pipeline", {}).get("weighted_fair")
+        )
+    finally:
+        s.close()
+        _shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def main():
     import os
 
@@ -1964,6 +2138,38 @@ def main():
             except Exception as e:
                 print(
                     f"dashboard-mix probe failed: {type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
+
+    # ---- tenant-mix probe (ISSUE 19): dozens of Zipf-traffic tenants
+    # + one abusive tenant; abuser throttled to its weight's qps while
+    # the well-behaved population's p50 holds vs a no-abuser baseline.
+    if os.environ.get("PILOSA_BENCH_TENANCY", "1") != "0":
+        rem = child_budget - (time.monotonic() - _T_PROC_START)
+        if rem > 50:
+            try:
+                result["tenant_mix"] = _tenant_mix_probe(min(22.0, rem - 28))
+                try:
+                    with open(
+                        os.path.join(_REPO_DIR, "TENANCY_r19.json"), "w"
+                    ) as f:
+                        json.dump(
+                            {
+                                "ts": time.time(),
+                                "platform": result.get("platform"),
+                                **result["tenant_mix"],
+                            },
+                            f,
+                            indent=1,
+                        )
+                except OSError as e:
+                    print(
+                        f"could not write TENANCY_r19.json: {e}",
+                        file=sys.stderr,
+                    )
+            except Exception as e:
+                print(
+                    f"tenant-mix probe failed: {type(e).__name__}: {e}",
                     file=sys.stderr,
                 )
 

@@ -1,0 +1,234 @@
+"""Multi-tenant QoS soak (ISSUE 19) — one server, many index tenants,
+one deliberately abusive.
+
+Boots a server with per-tenant weights, an explicit qps cap on the
+abusive tenant, an HBM quota on one tenant, and a ``*`` SLO objective,
+then drives closed-loop traffic from every tenant concurrently:
+
+  * the ABUSER offers ~10x its admitted rate: its excess must be
+    refused with per-tenant 429 + Retry-After (never a global 503),
+    and its *admitted* throughput must track its configured qps,
+  * every WELL-BEHAVED tenant must see zero throttles and zero sheds —
+    the abuser's burst is invisible to them,
+  * one SINGLE scrape (/metrics) and one /debug/tenancy body must carry
+    per-tenant admission counters, latency waterfalls, and SLO burn
+    state for EVERY tenant,
+  * the quota'd tenant's HBM-domain attribution must stay bounded by
+    its quota (its own blocks are evicted first, nobody else's), and
+  * statuses stay ⊆ {200, 429}: a tenant hitting its own limits is
+    flow control, not an error budget for the fleet.
+
+    python dryrun_tenancy.py            # full soak + artifact
+    python dryrun_tenancy.py --smoke    # small/fast variant (CI)
+
+Artifact: TENANCY_SOAK_r19.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+ARTIFACT = "TENANCY_SOAK_r19.json"
+
+ABUSER = "noisy"
+ABUSER_QPS = 20.0
+
+
+def _post(base: str, path: str, body: bytes = b"", timeout: float = 10.0):
+    req = urllib.request.Request(base + path, data=body)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _get(base: str, path: str, timeout: float = 10.0):
+    with urllib.request.urlopen(base + path, timeout=timeout) as r:
+        return r.read()
+
+
+class _Tenant(threading.Thread):
+    """Closed-loop client for one index: post Count queries back to
+    back until the deadline; abusers skip client-side pacing entirely
+    (the server's bucket is the only thing slowing them down)."""
+
+    def __init__(self, base: str, index: str, stop_at: float, pace_s: float) -> None:
+        super().__init__(daemon=True)
+        self.base = base
+        self.index = index
+        self.stop_at = stop_at
+        self.pace_s = pace_s
+        self.codes: dict[int, int] = {}
+        self.lat_ok: list[float] = []
+
+    def run(self) -> None:
+        while time.monotonic() < self.stop_at:
+            t0 = time.monotonic()
+            st, _, _ = _post(
+                self.base, f"/index/{self.index}/query", b"Count(Row(f=1))"
+            )
+            self.codes[st] = self.codes.get(st, 0) + 1
+            if st == 200:
+                self.lat_ok.append(time.monotonic() - t0)
+            if self.pace_s > 0:
+                time.sleep(self.pace_s)
+
+
+def _p50(xs: list[float]) -> float:
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    return s[len(s) // 2]
+
+
+def main() -> int:
+    smoke = "--smoke" in sys.argv
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from pilosa_tpu.server.config import Config
+    from pilosa_tpu.server.server import Server
+
+    n_tenants = 4 if smoke else 12
+    duration = 5.0 if smoke else 20.0
+    tenants = [f"t{i}" for i in range(n_tenants)]
+    quota_tenant = tenants[0]
+
+    tmp = tempfile.mkdtemp(prefix="tenancy_soak_")
+    cfg = Config(
+        data_dir=tmp,
+        bind="127.0.0.1:0",
+        device_policy="never",
+        device_timeout=0,
+        metric="none",
+        tenant_weights=",".join([f"{t}=4" for t in tenants] + [f"{ABUSER}=1"]),
+        tenant_qps=f"{ABUSER}={ABUSER_QPS:g}",
+        tenant_hbm_quota=f"{quota_tenant}={64 << 10}",
+        tenant_objectives="*=500@0.99",
+    )
+    srv = Server(cfg)
+    srv.open()
+    base = f"http://127.0.0.1:{srv.httpd.server_address[1]}"
+    failures: list[str] = []
+    try:
+        for idx in tenants + [ABUSER]:
+            assert _post(base, f"/index/{idx}", b"{}")[0] == 200
+            assert (
+                _post(base, f"/index/{idx}/field/f", b'{"options":{}}')[0] == 200
+            )
+            assert _post(base, f"/index/{idx}/query", b"Set(1, f=1)")[0] == 200
+
+        stop_at = time.monotonic() + duration
+        # well-behaved tenants trickle (~10 qps offered each); the
+        # abuser goes flat out against its 20 qps bucket
+        clients = [_Tenant(base, t, stop_at, pace_s=0.1) for t in tenants]
+        clients.append(_Tenant(base, ABUSER, stop_at, pace_s=0.0))
+        t_start = time.monotonic()
+        for c in clients:
+            c.start()
+        for c in clients:
+            c.join(timeout=duration + 30.0)
+        elapsed = time.monotonic() - t_start
+
+        abuser = clients[-1]
+        ok = abuser.codes.get(200, 0)
+        throttled = abuser.codes.get(429, 0)
+        admitted_rate = ok / max(elapsed, 1e-9)
+        offered_rate = (ok + throttled) / max(elapsed, 1e-9)
+        # the bucket's burst (2s worth) pads the average over a short
+        # window; require the admitted rate to track qps + burst/T
+        cap = ABUSER_QPS * (1.0 + 2.0 / duration) * 1.35
+        if throttled == 0:
+            failures.append("abuser was never throttled (429s expected)")
+        if offered_rate < ABUSER_QPS * 2:
+            failures.append(
+                f"abuser offered only {offered_rate:.1f}/s — not abusive "
+                f"enough to prove throttling (want >= {ABUSER_QPS * 2:g}/s)"
+            )
+        if admitted_rate > cap:
+            failures.append(
+                f"abuser admitted {admitted_rate:.1f}/s, above its "
+                f"{ABUSER_QPS:g} qps cap (+burst tolerance {cap:.1f})"
+            )
+        bad = set(abuser.codes) - {200, 429}
+        if bad:
+            failures.append(f"abuser saw unexpected statuses: {sorted(bad)}")
+        for c in clients[:-1]:
+            if set(c.codes) - {200}:
+                failures.append(
+                    f"well-behaved tenant {c.index} saw non-200s: {c.codes}"
+                )
+
+        # one scrape must carry every tenant's burn state; one
+        # /debug/tenancy body must carry every tenant's counters +
+        # waterfalls
+        scrape = _get(base, "/metrics").decode()
+        snap = json.loads(_get(base, "/debug/tenancy"))
+        for idx in tenants + [ABUSER]:
+            if f'cls="tenant:{idx}"' not in scrape:
+                failures.append(f"fleet scrape missing SLO state for {idx}")
+            if idx not in snap.get("slo", {}):
+                failures.append(f"/debug/tenancy slo missing {idx}")
+            if idx not in snap.get("waterfalls", {}):
+                failures.append(f"/debug/tenancy waterfalls missing {idx}")
+            row = snap.get("pipeline", {}).get("tenants", {}).get(idx)
+            if not row or row.get("admitted", 0) <= 0:
+                failures.append(f"pipeline tenant counters missing {idx}")
+        if snap.get("tenants", {}).get(ABUSER, {}).get("throttled", 0) <= 0:
+            failures.append("/debug/tenancy shows no throttles for the abuser")
+        if not snap.get("pipeline", {}).get("weighted_fair"):
+            failures.append("pipeline is not weighted-fair with tenancy on")
+
+        # HBM quota attribution: the quota'd tenant's accounted
+        # HBM-domain bytes must not exceed its quota
+        used = snap.get("hbm", {}).get("index_used", {}).get(quota_tenant, 0)
+        quota = snap.get("hbm", {}).get("index_quotas", {}).get(quota_tenant, 0)
+        if quota != 64 << 10:
+            failures.append(f"quota for {quota_tenant} not wired: {quota}")
+        if used > quota:
+            failures.append(
+                f"{quota_tenant} holds {used} HBM-domain bytes over its "
+                f"{quota}-byte quota"
+            )
+
+        result = {
+            "smoke": smoke,
+            "tenants": n_tenants,
+            "duration_s": round(elapsed, 3),
+            "abuser": {
+                "qps_cap": ABUSER_QPS,
+                "offered_rate": round(offered_rate, 2),
+                "admitted_rate": round(admitted_rate, 2),
+                "throttled": throttled,
+                "codes": abuser.codes,
+            },
+            "tenant_p50_ms": {
+                c.index: round(_p50(c.lat_ok) * 1000.0, 3) for c in clients[:-1]
+            },
+            "quota": {"tenant": quota_tenant, "bytes": quota, "used": used},
+            "failures": failures,
+            "ok": not failures,
+        }
+    finally:
+        srv.close()
+
+    result["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(os.path.join(os.path.dirname(__file__), ARTIFACT), "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+    print(json.dumps(result, indent=2, sort_keys=True))
+    if failures:
+        print(f"TENANCY SOAK: {len(failures)} failure(s)", file=sys.stderr)
+        return 1
+    print("TENANCY SOAK: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

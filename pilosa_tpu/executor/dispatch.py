@@ -202,6 +202,20 @@ class DispatchEngine:
         self.combined_items = 0
         self.fallbacks = 0
         self.expired = 0
+        # per-tenant rollup (index = tenant, server/tenancy.py): who is
+        # filling the waves, who is expiring in queue — the dispatch
+        # leg of the per-tenant attribution story
+        self.by_tenant: dict[str, dict[str, int]] = {}
+
+    def _tenant_row_locked(self, index: str) -> dict:
+        row = self.by_tenant.get(index)
+        if row is None:
+            row = self.by_tenant[index] = {
+                "items": 0,
+                "dedup_hits": 0,
+                "expired": 0,
+            }
+        return row
 
     # -- admission -----------------------------------------------------------
 
@@ -238,6 +252,7 @@ class DispatchEngine:
             item.t_enq = time.monotonic()
             self._q.append(item)
             self.items += 1
+            self._tenant_row_locked(index)["items"] += 1
             self._cond.notify_all()
         return item
 
@@ -331,6 +346,7 @@ class DispatchEngine:
                     # parse/translate/kernel work; wave-mates unaffected
                     with self._mu:
                         self.expired += 1
+                        self._tenant_row_locked(it.index)["expired"] += 1
                     metrics.count(
                         metrics.PIPELINE_DEADLINE_EXPIRED, stage="dispatch"
                     )
@@ -374,6 +390,7 @@ class DispatchEngine:
                 dups.setdefault(id(lead), []).append(it)
                 with self._mu:
                     self.dedup_hits += 1
+                    self._tenant_row_locked(it.index)["dedup_hits"] += 1
                 if it.trace_ctx is not None and it.trace_ctx[2]:
                     # wave-level singleflight: the deduped item's trace
                     # span-links the executed item and names the wave
@@ -546,6 +563,7 @@ class DispatchEngine:
                 "combined_items": self.combined_items,
                 "fallbacks": self.fallbacks,
                 "deadline_expired": self.expired,
+                "tenants": {idx: dict(row) for idx, row in self.by_tenant.items()},
                 "device_idle_fraction": self._idle_fraction_locked(),
                 "fusion": (
                     self.executor.fuser.stats()

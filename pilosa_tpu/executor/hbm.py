@@ -87,7 +87,10 @@ def classify_device_error(exc: BaseException) -> Optional[str]:
 
 
 class _Tenant:
-    __slots__ = ("name", "share", "evict_fn", "tier", "domain", "used")
+    __slots__ = (
+        "name", "share", "evict_fn", "tier", "domain", "used",
+        "by_index", "prefer_ok",
+    )
 
     def __init__(
         self, name: str, share: int, evict_fn, tier: int, domain: str = "hbm"
@@ -102,6 +105,13 @@ class _Tenant:
         # swept by — device pressure relief (ISSUE 17)
         self.domain = domain
         self.used = 0
+        # sub-tenant accounting (ISSUE 19): bytes by owning INDEX —
+        # "tenant" in the multi-tenant sense, vs this class which is a
+        # registered SUBSYSTEM account. Only charges that name an index
+        # land here; used - sum(by_index) is unattributed scratch.
+        self.by_index: dict[str, int] = {}
+        # whether evict_fn accepts the quota-relief ``prefer=`` kwarg
+        self.prefer_ok = False
 
 
 class HbmGovernor:
@@ -131,6 +141,11 @@ class HbmGovernor:
         self.budget_bytes = int(budget_bytes)
         self._mu = OrderedLock("hbm.governor_mu")
         self._tenants: dict[str, _Tenant] = {}
+        # per-INDEX byte quotas (ISSUE 19, tenant-hbm-quota): caps one
+        # tenant's total footprint across all hbm-domain subsystems;
+        # 0 / absent = unlimited
+        self._index_quotas: dict[str, int] = {}
+        self._default_index_quota = 0
 
     # -- registration ---------------------------------------------------------
 
@@ -142,6 +157,14 @@ class HbmGovernor:
         tier: int = 99,
         domain: str = "hbm",
     ) -> None:
+        prefer_ok = False
+        if evict_fn is not None:
+            try:
+                import inspect
+
+                prefer_ok = "prefer" in inspect.signature(evict_fn).parameters
+            except (TypeError, ValueError):
+                prefer_ok = False
         with self._mu:
             t = self._tenants.get(name)
             if t is None:
@@ -152,6 +175,17 @@ class HbmGovernor:
                 t.evict_fn = evict_fn
                 t.tier = tier
                 t.domain = domain
+            t.prefer_ok = prefer_ok
+
+    def set_index_quotas(
+        self, quotas: dict[str, int], default: int = 0
+    ) -> None:
+        """Install per-index byte quotas (server wiring, from
+        ``tenant-hbm-quota``). A reserve that pushes an index past its
+        quota triggers a targeted sweep of THAT index's blocks only."""
+        with self._mu:
+            self._index_quotas = {k: int(v) for k, v in quotas.items()}
+            self._default_index_quota = int(default)
 
     # -- accounting -----------------------------------------------------------
 
@@ -186,14 +220,16 @@ class HbmGovernor:
         not just their share."""
         return max(0, -self.headroom())
 
-    def reserve(self, name: str, nbytes: int) -> bool:
-        """Record ``nbytes`` against ``name``'s account. Always records
-        (the bytes are already being uploaded — the ledger must reflect
-        reality); returns False when the ledger remains over budget
-        after relieving the OTHER tenants, in which case the caller
-        evicts its own LRU entries (its loop also checks
-        ``over_budget``)."""
+    def reserve(self, name: str, nbytes: int, index: str = "") -> bool:
+        """Record ``nbytes`` against ``name``'s account (and, when the
+        charge names its owning ``index``, that tenant's sub-account).
+        Always records (the bytes are already being uploaded — the
+        ledger must reflect reality); returns False when the ledger
+        remains over budget after relieving the OTHER tenants, in which
+        case the caller evicts its own LRU entries (its loop also
+        checks ``over_budget``)."""
         nbytes = int(nbytes)
+        quota_excess = 0
         with self._mu:
             t = self._tenants.get(name)
             if t is None:
@@ -201,20 +237,45 @@ class HbmGovernor:
                 self._tenants[name] = t
             t.used += nbytes
             used = t.used
+            if index:
+                t.by_index[index] = t.by_index.get(index, 0) + nbytes
+                idx_used = self._index_used_locked(index)
+                quota = self._index_quota_locked(index)
+                if quota > 0 and idx_used > quota:
+                    quota_excess = idx_used - quota
         metrics.gauge(metrics.HBM_GOVERNOR_BYTES, used, tenant=name)
+        if index:
+            metrics.gauge(
+                metrics.TENANT_HBM_BYTES, self.index_used(index), tenant=index
+            )
+        if quota_excess > 0:
+            # over ITS quota, not the global budget: sweep only this
+            # index's blocks — a tenant at quota degrades only its own
+            # queries (ISSUE 19)
+            self.relieve_index(index, quota_excess)
         if self.over_budget() > 0:
             self.relieve(exclude=name)
         self._telemetry_relief(exclude=name)
         return self.over_budget() <= 0
 
-    def release(self, name: str, nbytes: int) -> None:
+    def release(self, name: str, nbytes: int, index: str = "") -> None:
         with self._mu:
             t = self._tenants.get(name)
             if t is None:
                 return
             t.used = max(0, t.used - int(nbytes))
             used = t.used
+            if index and index in t.by_index:
+                left = t.by_index[index] - int(nbytes)
+                if left > 0:
+                    t.by_index[index] = left
+                else:
+                    del t.by_index[index]
         metrics.gauge(metrics.HBM_GOVERNOR_BYTES, used, tenant=name)
+        if index:
+            metrics.gauge(
+                metrics.TENANT_HBM_BYTES, self.index_used(index), tenant=index
+            )
 
     def reset(self, name: Optional[str] = None) -> None:
         """Zero an account (or every account): the wedge-recovery /
@@ -226,8 +287,85 @@ class HbmGovernor:
             ) if name is not None else list(self._tenants.values())
             for t in tenants:
                 t.used = 0
+                t.by_index.clear()
         for t in tenants:
             metrics.gauge(metrics.HBM_GOVERNOR_BYTES, 0, tenant=t.name)
+
+    # -- per-index (multi-tenant) accounting ----------------------------------
+
+    def _index_quota_locked(self, index: str) -> int:
+        return self._index_quotas.get(index, self._default_index_quota)
+
+    def _index_used_locked(self, index: str, domain: str = "hbm") -> int:
+        return sum(
+            t.by_index.get(index, 0)
+            for t in self._tenants.values()
+            if t.domain == domain
+        )
+
+    def index_used(self, index: str) -> int:
+        """One tenant's total HBM-domain bytes across subsystems."""
+        with self._mu:
+            return self._index_used_locked(index)
+
+    def index_over_quota(self, index: str) -> int:
+        """Bytes ``index`` currently exceeds its quota by (0 when under
+        or unlimited)."""
+        with self._mu:
+            quota = self._index_quota_locked(index)
+            if quota <= 0:
+                return 0
+            return max(0, self._index_used_locked(index) - quota)
+
+    def over_quota_indexes(self) -> list[str]:
+        """Indexes above their quota, worst offender first — the
+        relief sweep's preference list."""
+        with self._mu:
+            excess = {}
+            for t in self._tenants.values():
+                if t.domain != "hbm":
+                    continue
+                for idx, used in t.by_index.items():
+                    excess[idx] = excess.get(idx, 0) + used
+            out = []
+            for idx, used in excess.items():
+                quota = self._index_quota_locked(idx)
+                if quota > 0 and used > quota:
+                    out.append((used - quota, idx))
+        return [idx for _, idx in sorted(out, reverse=True)]
+
+    def relieve_index(self, index: str, need: int) -> int:
+        """Targeted quota sweep: free ``need`` bytes belonging to ONE
+        index, walking the tiers with ``prefer=[index]`` so only that
+        tenant's blocks are touched. Callbacks run without the
+        governor lock."""
+        with self._mu:
+            tiers = sorted(
+                (
+                    t
+                    for t in self._tenants.values()
+                    if t.evict_fn is not None
+                    and t.domain == "hbm"
+                    and t.prefer_ok
+                ),
+                key=lambda t: t.tier,
+            )
+        freed_total = 0
+        for t in tiers:
+            deficit = int(need) - freed_total
+            if deficit <= 0:
+                break
+            try:
+                freed = int(t.evict_fn(deficit, prefer=[index]) or 0)
+            except Exception:
+                freed = 0
+            if freed > 0:
+                freed_total += freed
+                metrics.count(metrics.HBM_GOVERNOR_EVICTIONS, tier=t.name)
+                metrics.count(
+                    metrics.TENANT_HBM_EVICTIONS, tenant=index, tier=t.name
+                )
+        return freed_total
 
     # -- admission + relief ---------------------------------------------------
 
@@ -245,9 +383,14 @@ class HbmGovernor:
     def relieve(self, need: int = 0, exclude: Optional[str] = None) -> int:
         """Evict through the tiers (device plan cache first, then cold
         stager blocks) until the ledger has ``need`` bytes of headroom
-        (or, with ``need=0``, is back under budget). Callbacks run
-        WITHOUT the governor lock — they take their owners' locks and
-        call ``release`` re-entrantly. Returns bytes freed."""
+        (or, with ``need=0``, is back under budget). When some index is
+        over its byte quota the sweep walks the tiers TWICE: first
+        constrained to the over-quota tenants' blocks (prefer pass),
+        then classic LRU for whatever deficit remains — an under-quota
+        tenant loses a block only after every over-quota tenant's
+        excess is gone. Callbacks run WITHOUT the governor lock — they
+        take their owners' locks and call ``release`` re-entrantly.
+        Returns bytes freed."""
         with self._mu:
             tiers = sorted(
                 (
@@ -257,22 +400,36 @@ class HbmGovernor:
                 ),
                 key=lambda t: t.tier,
             )
+            have_quotas = bool(self._index_quotas or self._default_index_quota)
         freed_total = 0
-        for t in tiers:
-            deficit = (
-                max(0, int(need) - self.headroom()) if need else self.over_budget()
-            )
-            if deficit <= 0:
-                break
-            if t.name == exclude:
-                continue
-            try:
-                freed = int(t.evict_fn(deficit) or 0)
-            except Exception:
-                freed = 0
-            if freed > 0:
-                freed_total += freed
-                metrics.count(metrics.HBM_GOVERNOR_EVICTIONS, tier=t.name)
+        passes = [None]
+        if have_quotas:
+            prefer = self.over_quota_indexes()
+            if prefer:
+                passes = [prefer, None]
+        for prefer in passes:
+            for t in tiers:
+                deficit = (
+                    max(0, int(need) - self.headroom())
+                    if need
+                    else self.over_budget()
+                )
+                if deficit <= 0:
+                    return freed_total
+                if t.name == exclude:
+                    continue
+                try:
+                    if prefer is not None:
+                        if not t.prefer_ok:
+                            continue
+                        freed = int(t.evict_fn(deficit, prefer=prefer) or 0)
+                    else:
+                        freed = int(t.evict_fn(deficit) or 0)
+                except Exception:
+                    freed = 0
+                if freed > 0:
+                    freed_total += freed
+                    metrics.count(metrics.HBM_GOVERNOR_EVICTIONS, tier=t.name)
         return freed_total
 
     def relieve_for_oom(self) -> int:
@@ -327,7 +484,13 @@ class HbmGovernor:
 
     def stats(self) -> dict:
         with self._mu:
-            return {
+            by_index: dict[str, int] = {}
+            for t in self._tenants.values():
+                if t.domain != "hbm":
+                    continue
+                for idx, used in t.by_index.items():
+                    by_index[idx] = by_index.get(idx, 0) + used
+            out = {
                 "budget_bytes": self._budget_locked(),
                 "used_bytes": sum(
                     t.used for t in self._tenants.values() if t.domain == "hbm"
@@ -340,10 +503,23 @@ class HbmGovernor:
                         "share": t.share,
                         "tier": t.tier,
                         **({"domain": t.domain} if t.domain != "hbm" else {}),
+                        **(
+                            {"by_index": dict(t.by_index)}
+                            if t.by_index
+                            else {}
+                        ),
                     }
                     for t in self._tenants.values()
                 },
             }
+            if self._index_quotas or self._default_index_quota:
+                out["index_quotas"] = {
+                    "default": self._default_index_quota,
+                    **self._index_quotas,
+                }
+            if by_index:
+                out["index_used"] = by_index
+        return out
 
 
 # -- OOM recovery at the device-call boundaries -------------------------------

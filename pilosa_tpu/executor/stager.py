@@ -73,12 +73,15 @@ class _InFlight:
 
 
 class _Entry:
-    __slots__ = ("value", "nbytes", "gen")
+    __slots__ = ("value", "nbytes", "gen", "tenant")
 
-    def __init__(self, value, nbytes: int, gen) -> None:
+    def __init__(self, value, nbytes: int, gen, tenant: str = "") -> None:
         self.value = value
         self.nbytes = nbytes
         self.gen = gen  # int, or tuple of per-fragment ints for stacks
+        # owning index (ISSUE 19): governor sub-tenant attribution and
+        # quota-preferring eviction; "" for untracked internal entries
+        self.tenant = tenant
 
 
 def _gen_fresh(have, want) -> bool:
@@ -189,6 +192,19 @@ class DeviceStager:
         # NOTE: no generation — entries persist across mutations and
         # track their snapshot generation in _Entry.gen instead
         return (id(frag), kind) + tuple(extra)
+
+    @staticmethod
+    def _tenant_of(frag) -> str:
+        """Owning index name for a fragment (or stack of fragments —
+        one field, one index); "" when untracked."""
+        if frag is None:
+            return ""
+        if isinstance(frag, (list, tuple)):
+            for f in frag:
+                if f is not None:
+                    return getattr(f, "index", "") or ""
+            return ""
+        return getattr(frag, "index", "") or ""
 
     @staticmethod
     def _heat_stage(frag, nbytes: int, hit: bool) -> None:
@@ -350,18 +366,25 @@ class DeviceStager:
             # relief sweep over OTHER tenants (device plan cache) and
             # MUST NOT hold _mu — its eviction callbacks take their
             # owners' locks (lock order: tenant lock → governor lock,
-            # never the reverse)
+            # never the reverse). The charge names the owning index so
+            # the governor's per-tenant quota accounting (ISSUE 19)
+            # sees who the bytes belong to; an over-quota index's
+            # reserve triggers a targeted sweep of its OWN blocks.
+            tenant = self._tenant_of(frag)
             gov = self.governor
             if gov is not None:
-                gov.reserve("stager", nbytes)
-            gov_return = 0  # bytes handed back to the ledger after insert
+                gov.reserve("stager", nbytes, index=tenant)
+            # bytes handed back to the ledger after insert, by index
+            gov_return: dict[str, int] = {}
             with self._mu:
                 if self._epoch == epoch:
                     old = self._cache.pop(key, None)
                     if old is not None:
                         self._bytes -= old.nbytes
-                        gov_return += old.nbytes
-                    self._cache[key] = _Entry(value, nbytes, built_gen)
+                        gov_return[old.tenant] = (
+                            gov_return.get(old.tenant, 0) + old.nbytes
+                        )
+                    self._cache[key] = _Entry(value, nbytes, built_gen, tenant)
                     self._bytes += nbytes
                     if prefetch:
                         self._prefetched.add(key)
@@ -375,27 +398,32 @@ class DeviceStager:
                     # evict LRU past the tenant share — and past the
                     # GLOBAL budget (over_budget already nets out the
                     # gov_return bytes released below)
+                    returned = sum(gov_return.values())
                     while (
                         self._bytes > self.budget_bytes
-                        or (gov is not None and gov.over_budget() > gov_return)
+                        or (gov is not None and gov.over_budget() > returned)
                     ) and len(self._cache) > 1:
                         old_key, old_ent = self._cache.popitem(last=False)
                         self._bytes -= old_ent.nbytes
-                        gov_return += old_ent.nbytes
+                        returned += old_ent.nbytes
+                        gov_return[old_ent.tenant] = (
+                            gov_return.get(old_ent.tenant, 0) + old_ent.nbytes
+                        )
                         self._note_evicted_locked(old_key)
                     self._inflight.pop(key, None)
                     metrics.gauge(metrics.STAGER_BYTES, self._bytes)
                 else:
                     # epoch-stale: the value never enters the cache, so
                     # its reservation goes straight back
-                    gov_return += nbytes
+                    gov_return[tenant] = gov_return.get(tenant, 0) + nbytes
                     if self._inflight.get(key) is fl:
                         # same epoch-stale builder still registered (no
                         # rebuild raced in): unregister without caching
                         # the stale value
                         self._inflight.pop(key, None)
-            if gov is not None and gov_return:
-                gov.release("stager", gov_return)
+            if gov is not None:
+                for t, n in gov_return.items():
+                    gov.release("stager", n, index=t)
             fl.gen = built_gen
             fl.value = value
             fl.event.set()
@@ -1162,23 +1190,50 @@ class DeviceStager:
             # device budget (executor/hbm.py domains)
             self.tier1.set_governor(governor)
 
-    def _evict_cold(self, need: int) -> int:
+    def _evict_cold(self, need: int, prefer=None) -> int:
         """Governor relief tier: drop cold (LRU) staged blocks until
         ``need`` bytes are freed, always keeping the hottest entry —
-        the block a query is most likely touching right now. Called by
-        the governor WITHOUT its lock held; the release below keeps the
-        ledger exact."""
+        the block a query is most likely touching right now. With
+        ``prefer`` (a list of over-quota indexes, ISSUE 19) the sweep
+        frees ONLY those tenants' blocks, coldest first — an
+        under-quota tenant never loses a block to someone else's quota
+        sweep. Called by the governor WITHOUT its lock held; the
+        releases below keep the ledger exact."""
         freed = 0
+        freed_by: dict[str, int] = {}
         with self._mu:
-            while freed < need and len(self._cache) > 1:
-                k, ent = self._cache.popitem(last=False)
-                self._bytes -= ent.nbytes
-                freed += ent.nbytes
-                self._note_evicted_locked(k)
+            if prefer is not None:
+                wanted = set(prefer)
+                # coldest-first among the preferred tenants' blocks
+                victims = [
+                    k
+                    for k, ent in self._cache.items()
+                    if ent.tenant in wanted
+                ]
+                for k in victims:
+                    if freed >= need or len(self._cache) <= 1:
+                        break
+                    ent = self._cache.pop(k)
+                    self._bytes -= ent.nbytes
+                    freed += ent.nbytes
+                    freed_by[ent.tenant] = (
+                        freed_by.get(ent.tenant, 0) + ent.nbytes
+                    )
+                    self._note_evicted_locked(k)
+            else:
+                while freed < need and len(self._cache) > 1:
+                    k, ent = self._cache.popitem(last=False)
+                    self._bytes -= ent.nbytes
+                    freed += ent.nbytes
+                    freed_by[ent.tenant] = (
+                        freed_by.get(ent.tenant, 0) + ent.nbytes
+                    )
+                    self._note_evicted_locked(k)
             if freed:
                 metrics.gauge(metrics.STAGER_BYTES, self._bytes)
         if freed and self.governor is not None:
-            self.governor.release("stager", freed)
+            for t, n in freed_by.items():
+                self.governor.release("stager", n, index=t)
         return freed
 
     def clear(self) -> None:

@@ -138,7 +138,7 @@ class Tier1Cache:
                         freed = self._evict_locked(ent)
                         self._gauge_locked()
                 if freed and self.governor is not None:
-                    self.governor.release("tier1", freed)
+                    self.governor.release("tier1", freed, index=ent.cell[0])
                 self.misses += 1
                 metrics.count(metrics.TIER1_MISSES)
                 return None
@@ -164,11 +164,17 @@ class Tier1Cache:
         cell = (frag.index, frag.field, frag.shard)
         cand = _value(nbytes, cost, cell)
         key = self._key(frag, row_ids)
+        # per-tenant freed ledger: evicted payloads credit back to the
+        # index that owned them (governor by_index attribution)
+        freed_by: dict = {}
         freed = 0
         with self._mu:
             old = self._cache.pop(key, None)
             if old is not None:
-                freed += self._evict_locked(old)
+                n = self._evict_locked(old)
+                freed += n
+                t = old.cell[0] if old.cell else ""
+                freed_by[t] = freed_by.get(t, 0) + n
             while self._bytes + nbytes > self.max_bytes:
                 k, ent = next(iter(self._cache.items()))
                 if _value(ent.nbytes, ent.cost, ent.cell) > cand:
@@ -176,7 +182,10 @@ class Tier1Cache:
                     admitted = False
                     break
                 del self._cache[k]
-                freed += self._evict_locked(ent)
+                n = self._evict_locked(ent)
+                freed += n
+                t = ent.cell[0] if ent.cell else ""
+                freed_by[t] = freed_by.get(t, 0) + n
             else:
                 self._cache[key] = _T1Entry(entries, nbytes, gen, cost, cell)
                 self._bytes += nbytes
@@ -191,9 +200,9 @@ class Tier1Cache:
         gov = self.governor
         if gov is not None:
             if admitted:
-                gov.reserve("tier1", nbytes)
-            if freed:
-                gov.release("tier1", freed)
+                gov.reserve("tier1", nbytes, index=cell[0])
+            for t, n in freed_by.items():
+                gov.release("tier1", n, index=t)
         return admitted
 
     def set_governor(self, governor) -> None:
@@ -213,12 +222,16 @@ class Tier1Cache:
 
     def clear(self) -> None:
         with self._mu:
-            freed = self._bytes
+            freed_by: dict = {}
+            for ent in self._cache.values():
+                t = ent.cell[0] if ent.cell else ""
+                freed_by[t] = freed_by.get(t, 0) + ent.nbytes
             self._cache.clear()
             self._bytes = 0
             self._gauge_locked()
-        if freed and self.governor is not None:
-            self.governor.release("tier1", freed)
+        if self.governor is not None:
+            for t, n in freed_by.items():
+                self.governor.release("tier1", n, index=t)
 
     def stats(self) -> dict:
         with self._mu:

@@ -352,21 +352,56 @@ class DevicePlanCache:
         if current:
             governor.reserve("device_cache", current)
 
-    def _evict_lru(self, need: int) -> int:
+    @staticmethod
+    def _index_of(key) -> str:
+        """The tenant index a cache key belongs to — device-cache keys
+        are ``(index, subtree_hash, shards)`` (executor.py), so the
+        first element is the attribution handle for per-tenant HBM
+        quotas. Defensive for non-conforming keys (direct tests)."""
+        if isinstance(key, tuple) and key and isinstance(key[0], str):
+            return key[0]
+        return ""
+
+    def _evict_lru(self, need: int, prefer=None) -> int:
         """Governor relief tier 0: drop LRU entries until ``need``
-        bytes are freed. Called WITHOUT the governor lock held."""
+        bytes are freed. Called WITHOUT the governor lock held.
+
+        ``prefer`` narrows eviction to the listed tenant indexes
+        (quota enforcement: an over-quota tenant sheds only its own
+        plans); None keeps the classic global LRU sweep."""
         freed = 0
+        freed_by: dict = {}
         with self._mu:
-            while freed < need and self._entries:
-                _, e = self._entries.popitem(last=False)
-                self.bytes -= e.nbytes
-                freed += e.nbytes
-                self.evictions += 1
-                metrics.count(metrics.PLANCACHE_DEVICE_EVICTIONS)
+            if prefer is not None:
+                want = set(prefer)
+                victims = [
+                    k for k in self._entries if self._index_of(k) in want
+                ]
+                for k in victims:
+                    if freed >= need:
+                        break
+                    e = self._entries.pop(k)
+                    self.bytes -= e.nbytes
+                    freed += e.nbytes
+                    freed_by[self._index_of(k)] = (
+                        freed_by.get(self._index_of(k), 0) + e.nbytes
+                    )
+                    self.evictions += 1
+                    metrics.count(metrics.PLANCACHE_DEVICE_EVICTIONS)
+            else:
+                while freed < need and self._entries:
+                    k, e = self._entries.popitem(last=False)
+                    self.bytes -= e.nbytes
+                    freed += e.nbytes
+                    idx = self._index_of(k)
+                    freed_by[idx] = freed_by.get(idx, 0) + e.nbytes
+                    self.evictions += 1
+                    metrics.count(metrics.PLANCACHE_DEVICE_EVICTIONS)
             if freed:
                 metrics.gauge(metrics.PLANCACHE_DEVICE_BYTES, self.bytes)
         if freed and self.governor is not None:
-            self.governor.release("device_cache", freed)
+            for idx, n in freed_by.items():
+                self.governor.release("device_cache", n, index=idx)
         return freed
 
     def get(self, key, genvec_fn: Callable[[], tuple]):
@@ -398,7 +433,9 @@ class DevicePlanCache:
                 return e.value
         finally:
             if freed and self.governor is not None:
-                self.governor.release("device_cache", freed)
+                self.governor.release(
+                    "device_cache", freed, index=self._index_of(key)
+                )
 
     def put(self, key, genvec, value, nbytes: int, epoch0=None) -> None:
         """Insert a device array stamped with the generation vector
@@ -413,32 +450,40 @@ class DevicePlanCache:
         # cold stager blocks, and those callbacks take the stager lock
         # (lock order: tenant lock → governor lock, never the reverse)
         gov = self.governor
+        tenant = self._index_of(key)
         if gov is not None:
-            gov.reserve("device_cache", nbytes)
-        gov_return = 0
+            gov.reserve("device_cache", nbytes, index=tenant)
+        # per-tenant return ledger: evicted entries credit back to the
+        # index that owned them, not the inserting tenant
+        gov_return: dict = {}
+        returned = 0
         with self._mu:
             if epoch0 is not None and self.epoch != epoch0:
-                gov_return = nbytes
+                gov_return[tenant] = nbytes
             else:
                 old = self._entries.pop(key, None)
                 if old is not None:
                     self.bytes -= old.nbytes
-                    gov_return += old.nbytes
+                    gov_return[tenant] = gov_return.get(tenant, 0) + old.nbytes
+                    returned += old.nbytes
                 self._entries[key] = _Entry(value, nbytes, genvec)
                 self.bytes += nbytes
                 self.inserts += 1
                 while (
                     self.bytes > self.max_bytes
-                    or (gov is not None and gov.over_budget() > gov_return)
+                    or (gov is not None and gov.over_budget() > returned)
                 ) and self._entries:
-                    _, e = self._entries.popitem(last=False)
+                    k, e = self._entries.popitem(last=False)
                     self.bytes -= e.nbytes
-                    gov_return += e.nbytes
+                    idx = self._index_of(k)
+                    gov_return[idx] = gov_return.get(idx, 0) + e.nbytes
+                    returned += e.nbytes
                     self.evictions += 1
                     metrics.count(metrics.PLANCACHE_DEVICE_EVICTIONS)
                 metrics.gauge(metrics.PLANCACHE_DEVICE_BYTES, self.bytes)
-        if gov is not None and gov_return:
-            gov.release("device_cache", gov_return)
+        if gov is not None:
+            for idx, n in gov_return.items():
+                gov.release("device_cache", n, index=idx)
 
     def epoch_reset(self) -> None:
         """Drop every resident array and fence out packs that started

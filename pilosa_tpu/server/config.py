@@ -340,6 +340,24 @@ class Config:
     # full queue DROPS (counted) rather than blocking producers
     export_interval: float = 5.0
     export_queue: int = 1024
+    # multi-tenant QoS (server/tenancy.py) — the index is the tenant.
+    # All five default to "" = tenancy disabled: single-tenant servers
+    # keep the exact FIFO/unlimited behavior, bit-for-bit.
+    # "index=weight,..." relative weighted-fair shares; "*" sets the
+    # default for unlisted tenants (1.0 when absent)
+    tenant_weights: str = ""
+    # "index=qps,..." admission token-bucket rates; "*" sets a default
+    # scaled by each tenant's weight; 0/absent = unlimited
+    tenant_qps: str = ""
+    # "index=bytes,..." HBM-domain byte quotas enforced by the governor
+    # (stager + device plan cache attribution); "*" = default quota
+    tenant_hbm_quota: str = ""
+    # "index=bytes,..." in-flight request-byte caps (admission ledger)
+    tenant_inflight_bytes: str = ""
+    # "index=latency_ms@target,..." per-tenant SLOs, monitored as
+    # tenant:<index> classes next to the per-class set; "*" lazily
+    # registers every tenant at first query
+    tenant_objectives: str = ""
     # opt-in diagnostics phone-home endpoint (reference diagnostics.go);
     # empty = disabled
     diagnostics_host: str = ""
@@ -465,6 +483,11 @@ class Config:
             f"hbm-watermark-pct = {self.hbm_watermark_pct}",
             f'slo-objectives = "{self.slo_objectives}"',
             f"slo-burn-threshold = {self.slo_burn_threshold}",
+            f'tenant-weights = "{self.tenant_weights}"',
+            f'tenant-qps = "{self.tenant_qps}"',
+            f'tenant-hbm-quota = "{self.tenant_hbm_quota}"',
+            f'tenant-inflight-bytes = "{self.tenant_inflight_bytes}"',
+            f'tenant-objectives = "{self.tenant_objectives}"',
             f"heat-enabled = {'true' if self.heat_enabled else 'false'}",
             f"heat-decay-halflife = {self.heat_decay_halflife}",
             f'journal-dir = "{self.journal_dir}"',

@@ -102,6 +102,7 @@ class Handler:
         default_timeout: float = 0.0,
         analytics_timeout: float = 0.0,
         ingest=None,
+        tenancy=None,
     ) -> None:
         self.api = api
         self.logger = logger
@@ -117,6 +118,9 @@ class Handler:
         # durable ingest queue (server/ingest.py); None = waves apply
         # synchronously through the bulk class (ingest-enabled = false)
         self.ingest = ingest
+        # multi-tenant QoS policy (server/tenancy.py); None/disabled =
+        # single-tenant passthrough
+        self.tenancy = tenancy
         a = api
         self.routes = [
             # public (reference handler.go:188-231)
@@ -234,6 +238,9 @@ class Handler:
             Route("GET", r"/debug/latency", self.get_debug_latency),
             Route("GET", r"/debug/profile", self.get_debug_profile),
             Route("GET", r"/debug/slo", self.get_debug_slo),
+            # multi-tenant QoS (ISSUE 19): per-tenant admission /
+            # scheduling / HBM / SLO state in one snapshot
+            Route("GET", r"/debug/tenancy", self.get_debug_tenancy),
             # index (with and without trailing slash, as net/http/pprof
             # serves it) plus the thread-dump profile; unknown names 404
             Route("GET", r"/debug/pprof/?", self.get_debug_pprof),
@@ -242,10 +249,22 @@ class Handler:
 
     # -- route handlers --
 
-    def _submit(self, cls, thunk, dl, signature=None, batch=None, trace_ctx=None):
+    def _submit(
+        self,
+        cls,
+        thunk,
+        dl,
+        signature=None,
+        batch=None,
+        trace_ctx=None,
+        index="",
+        nbytes=0,
+    ):
         """Run ``thunk`` through the serving pipeline (admission,
         deadline, coalescing, batching) — or directly, deadline still
-        honored, when no pipeline is wired."""
+        honored, when no pipeline is wired. ``index`` is the tenant for
+        per-tenant admission + weighted-fair scheduling; ``nbytes``
+        charges the tenant's in-flight byte ledger for the request."""
         if self.pipeline is not None:
             return self.pipeline.submit(
                 cls,
@@ -254,6 +273,8 @@ class Handler:
                 signature=signature,
                 batch=batch,
                 trace_ctx=trace_ctx,
+                index=index,
+                nbytes=nbytes,
             )
         with deadline_mod.activate(dl):
             return thunk()
@@ -363,23 +384,38 @@ class Handler:
         t0 = time.monotonic()
         try:
             resp = self._submit(
-                cls, thunk, dl, signature=signature, batch=batch, trace_ctx=trace_ctx
+                cls,
+                thunk,
+                dl,
+                signature=signature,
+                batch=batch,
+                trace_ctx=trace_ctx,
+                index=index,
+                nbytes=len(req.body) if req.body else 0,
             )
         except APIError as e:
             # client errors (4xx) don't burn error budget; 5xx does
-            slo.MONITOR.record(cls, time.monotonic() - t0, ok=e.status < 500)
+            dur = time.monotonic() - t0
+            slo.MONITOR.record(cls, dur, ok=e.status < 500)
+            if self.tenancy is not None and cls != CLASS_INTERNAL:
+                self.tenancy.observe(index, dur, ok=e.status < 500)
             raise
         except BaseException:
             # timeouts, sheds, internal failures all consume budget
-            slo.MONITOR.record(cls, time.monotonic() - t0, ok=False)
+            dur = time.monotonic() - t0
+            slo.MONITOR.record(cls, dur, ok=False)
+            if self.tenancy is not None and cls != CLASS_INTERNAL:
+                self.tenancy.observe(index, dur, ok=False)
             raise
         dur = time.monotonic() - t0
         slo.MONITOR.record(cls, dur, ok=True)
+        if self.tenancy is not None and cls != CLASS_INTERNAL:
+            self.tenancy.observe(index, dur, ok=True)
         # always-on waterfall: api.query attaches the summary; pop it
         # (shared dicts from coalesced responses aggregate only once)
         wf_summary = resp.pop("_waterfall", None)
         if wf_summary is not None:
-            profiler.WATERFALL.record_summary(cls, wf_summary)
+            profiler.WATERFALL.record_summary(cls, wf_summary, tenant=index)
         # slow-query logging (reference handler.go:257-261)
         if self.long_query_time and dur > self.long_query_time and self.logger:
             self.logger.printf("%.3fs SLOW QUERY %s %s", dur, index, body[:500])
@@ -1049,6 +1085,49 @@ class Handler:
         slo.MONITOR.tick()
         return slo.MONITOR.snapshot()
 
+    def get_debug_tenancy(self, req) -> dict:
+        """Multi-tenant QoS snapshot (server/tenancy.py): per-tenant
+        policy + bucket state, pipeline fairness counters, HBM
+        attribution and quotas, latency waterfalls, heat rollup, and
+        per-tenant SLO burn — the whole tenant story in one body."""
+        from pilosa_tpu.server.tenancy import TENANT_SLO_PREFIX
+
+        tn = self.tenancy
+        out: dict = (
+            tn.snapshot() if tn is not None else {"enabled": False, "tenants": {}}
+        )
+        if self.pipeline is not None:
+            ps = self.pipeline.stats()
+            out["pipeline"] = {
+                "weighted_fair": ps.get("weighted_fair", False),
+                "tenants": ps.get("tenants", {}),
+            }
+        gov = getattr(self.api.executor, "governor", None)
+        if gov is not None:
+            gs = gov.stats()
+            out["hbm"] = {
+                "index_quotas": gs.get("index_quotas", {}),
+                "index_used": gs.get("index_used", {}),
+            }
+        engine = getattr(self.api.executor, "dispatch_engine", None)
+        if engine is not None:
+            out["dispatch"] = engine.stats().get("tenants", {})
+        out["waterfalls"] = profiler.WATERFALL.tenant_waterfalls()
+        if heat.LEDGER.enabled:
+            out["heat"] = heat.tenant_rollup(
+                heat.LEDGER.snapshot().get("cells", [])
+            )
+        # per-tenant SLO burn state (tenant:<index> classes in the
+        # shared monitor)
+        slo.MONITOR.tick()
+        snap = slo.MONITOR.snapshot()
+        out["slo"] = {
+            cls[len(TENANT_SLO_PREFIX):]: st
+            for cls, st in snap.get("classes", {}).items()
+            if cls.startswith(TENANT_SLO_PREFIX)
+        }
+        return out
+
     def get_debug_fleet(self, req) -> dict:
         """Fleet collector membership + scrape health (JSON twin of
         ``/metrics?fleet=true``)."""
@@ -1302,14 +1381,15 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
                     ctype = "application/json"
                 self.send_response(200)
             except Overloaded as e:
-                # admission shed (429, retry later) or draining (503);
-                # Retry-After tells well-behaved clients when to come
-                # back instead of hammering an overloaded server
+                # tenant-throttled (429: only THIS tenant must back
+                # off) vs genuinely overloaded (503: class queue full /
+                # draining — retry against another node); both carry
+                # Retry-After so well-behaved clients come back instead
+                # of hammering an overloaded server
                 payload, ctype = self._error_payload(str(e))
-                if e.status == 429:
-                    extra_headers.append(
-                        ("Retry-After", str(max(1, round(e.retry_after))))
-                    )
+                extra_headers.append(
+                    ("Retry-After", str(max(1, round(e.retry_after))))
+                )
                 self.send_response(e.status)
             except GangUnavailable as e:
                 # multihost gang dead (follower loss): bounded clean

@@ -139,8 +139,12 @@ class IngestQueue:
                     events.INGEST_SHED, index=index, field=field, n=n,
                     depth=self._depth,
                 )
+                # 429 (not the pipeline's queue-full 503): ingest
+                # backpressure is flow control on THIS producer — back
+                # off and resend; the server is not otherwise unhealthy
                 raise Overloaded(
-                    "ingest queue full", retry_after=self.retry_after
+                    "ingest queue full", retry_after=self.retry_after,
+                    status=429,
                 )
             self._queue.append(b)
             self._depth += n

@@ -14,9 +14,21 @@ Orca-style continuous batching); this module is that layer:
   legs of distributed queries/imports), each with its own bounded queue
   and dedicated worker pool — a flood of user queries cannot starve the
   cluster data plane, and a bulk import cannot starve reads. A full
-  queue sheds the request immediately with ``Overloaded`` (HTTP 429 +
-  ``Retry-After``) instead of piling up threads until the process
-  falls over.
+  queue sheds the request immediately with ``Overloaded`` (HTTP 503 +
+  ``Retry-After`` — the server as a whole is out of capacity; distinct
+  from the per-tenant 429 below) instead of piling up threads until
+  the process falls over.
+* **Per-tenant admission + weighted-fair scheduling** (ISSUE 19). With
+  a ``TenancyManager`` attached (server/tenancy.py), ``submit`` first
+  charges the request's *index* against that tenant's token bucket —
+  an exhausted tenant is refused with ``TenantThrottled`` (HTTP 429 +
+  its own ``Retry-After``) while everyone else proceeds — and each
+  class queue dequeues weighted-fair across tenants (virtual-time WFQ:
+  an entry's virtual finish time advances its tenant's clock by
+  ``1/weight``, the queue pops minimum finish time), so a tenant's
+  burst queues behind its own weight instead of the whole fleet.
+  Deadline expiry and shed semantics are unchanged; without tenancy
+  (the single-tenant default) the queue is plain FIFO.
 * **Deadline scheduling.** Each entry carries its request deadline
   (server/deadline.py); work whose deadline passed while queued is
   cancelled at dequeue — before the parse, the executor, or any shard
@@ -52,10 +64,10 @@ expiries — docs/administration.md §Metric reference) and in the
 
 from __future__ import annotations
 
+import heapq
 import re
 import threading
 import time
-from collections import deque
 from typing import Any, Callable, Optional
 
 from pilosa_tpu.analysis.locks import OrderedLock
@@ -90,10 +102,13 @@ def classify_query(body: str, remote: bool) -> str:
 
 
 class Overloaded(Exception):
-    """Admission refused. ``status`` 429 (queue full — retry after
-    ``retry_after`` seconds) or 503 (server draining)."""
+    """Admission refused. ``status`` 503 for genuine overload (class
+    queue full, server draining or shut down — retry after
+    ``retry_after`` seconds, ideally against another node) or 429 for
+    a per-tenant refusal (``TenantThrottled``, server/tenancy.py —
+    only that tenant must back off)."""
 
-    def __init__(self, message: str, retry_after: float = 1.0, status: int = 429) -> None:
+    def __init__(self, message: str, retry_after: float = 1.0, status: int = 503) -> None:
         super().__init__(message)
         self.retry_after = retry_after
         self.status = status
@@ -122,6 +137,11 @@ class _Entry:
         "t_enq",
         "wait_s",
         "trace_ctx",
+        "index",
+        "seq",
+        "vstart",
+        "vft",
+        "skip",
     )
 
     def __init__(
@@ -133,6 +153,7 @@ class _Entry:
         batch_payload=None,
         deadline: Optional[Deadline] = None,
         trace_ctx: Optional[tuple] = None,
+        index: str = "",
     ) -> None:
         self.cls = cls
         self.thunk = thunk
@@ -148,6 +169,112 @@ class _Entry:
         # distributed trace context (utils/trace.py tuple): carried so
         # a coalesced follower can link the leader's trace
         self.trace_ctx = trace_ctx
+        # the tenant (ISSUE 19): per-tenant counters + WFQ scheduling
+        self.index = index
+        # _TenantFairQueue bookkeeping: arrival order, virtual
+        # start/finish time, and the lazy-removal marker
+        self.seq = 0
+        self.vstart = 0.0
+        self.vft = 0.0
+        self.skip = False
+
+
+class _TenantFairQueue:
+    """Virtual-time weighted-fair queue over ``_Entry.index`` with the
+    small deque-ish surface the workers use (append / popleft / remove
+    / len / iteration in dequeue order).
+
+    Classic WFQ collapsed to unit cost per entry: an arriving entry's
+    virtual start is ``max(V, finish[tenant])``, its finish is
+    ``start + 1/weight``, and ``popleft`` returns the minimum finish
+    time — over any backlogged window each tenant dequeues in
+    proportion to its weight, and an idle tenant re-enters at the
+    current virtual time V (no banked credit, no starvation). With no
+    ``weight_fn`` (the single-tenant default) every entry gets finish
+    0 and the seq tie-break makes the queue exactly FIFO — bit-for-bit
+    the pre-tenancy order. Callers hold the pipeline lock."""
+
+    __slots__ = ("weight_fn", "_heap", "_len", "_seq", "_vtime", "_finish", "_nq")
+
+    def __init__(self, weight_fn: Optional[Callable[[str], float]] = None) -> None:
+        self.weight_fn = weight_fn
+        self._heap: list[tuple[float, int, _Entry]] = []
+        self._len = 0
+        self._seq = 0
+        self._vtime = 0.0
+        # tenant -> virtual finish of its latest queued entry
+        self._finish: dict[str, float] = {}
+        # tenant -> live queued entries (prunes _finish when it can)
+        self._nq: dict[str, int] = {}
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __iter__(self):
+        """Live entries in dequeue order — the batch-collection scans
+        (_dequeue_gang / _collect_window) see the same order popleft
+        would produce."""
+        live = sorted(t for t in self._heap if not t[2].skip)
+        return iter(e for _, _, e in live)
+
+    def append(self, e: _Entry) -> None:
+        e.seq = self._seq
+        self._seq += 1
+        if self.weight_fn is not None:
+            t = e.index
+            try:
+                w = float(self.weight_fn(t) or 1.0)
+            except Exception:
+                w = 1.0
+            start = max(self._vtime, self._finish.get(t, 0.0))
+            e.vstart = start
+            e.vft = start + 1.0 / max(1e-3, w)
+            self._finish[t] = e.vft
+            self._nq[t] = self._nq.get(t, 0) + 1
+        heapq.heappush(self._heap, (e.vft, e.seq, e))
+        self._len += 1
+
+    def popleft(self) -> _Entry:
+        while self._heap:
+            _, _, e = heapq.heappop(self._heap)
+            if e.skip:
+                continue
+            self._drop(e)
+            # virtual time advances to the dequeued entry's start: a
+            # tenant arriving later starts from here, not from zero
+            if e.vstart > self._vtime:
+                self._vtime = e.vstart
+            return e
+        raise IndexError("pop from an empty _TenantFairQueue")
+
+    def remove(self, e: _Entry) -> None:
+        """Lazy removal: mark; the heap tuple is discarded at pop."""
+        if e.skip:
+            raise ValueError("entry not in queue")
+        e.skip = True
+        self._drop(e)
+
+    def _drop(self, e: _Entry) -> None:
+        self._len -= 1
+        if self.weight_fn is None:
+            return
+        t = e.index
+        n = self._nq.get(t, 1) - 1
+        if n > 0:
+            self._nq[t] = n
+        else:
+            self._nq.pop(t, None)
+            # the finish stamp only matters while it is ahead of V
+            # (recent credit); once V caught up it is dead weight
+            if self._finish.get(t, 0.0) <= self._vtime:
+                self._finish.pop(t, None)
+        if len(self._finish) > 2 * len(self._nq) + 64:
+            for k in [
+                k
+                for k, f in self._finish.items()
+                if f <= self._vtime and k not in self._nq
+            ]:
+                del self._finish[k]
 
 
 class _ClassQueue:
@@ -164,11 +291,17 @@ class _ClassQueue:
         "completed",
     )
 
-    def __init__(self, name: str, limit: int, workers: int) -> None:
+    def __init__(
+        self,
+        name: str,
+        limit: int,
+        workers: int,
+        weight_fn: Optional[Callable[[str], float]] = None,
+    ) -> None:
         self.name = name
         self.limit = limit
         self.workers = workers
-        self.q: deque[_Entry] = deque()
+        self.q = _TenantFairQueue(weight_fn)
         self.busy = 0
         self.admitted = 0
         self.sheds = 0
@@ -220,6 +353,7 @@ class QueryPipeline:
         shed_retry_after: float = 1.0,
         drain_timeout: float = 10.0,
         dispatch_handoff: bool = False,
+        tenancy=None,
     ) -> None:
         workers = workers or {}
         queue_limits = queue_limits or {}
@@ -227,11 +361,21 @@ class QueryPipeline:
         defaults_q = {CLASS_INTERACTIVE: 64, CLASS_BULK: 16, CLASS_INTERNAL: 128}
         self._mu = OrderedLock("pipeline.mu")
         self._cond = threading.Condition(self._mu)
+        # server/tenancy.py TenancyManager (duck-typed: weight / admit /
+        # release). None or a disabled manager keeps the pre-tenancy
+        # fast path: FIFO queues, no admission charge, no extra lock.
+        self.tenancy = tenancy
+        weight_fn = (
+            tenancy.weight
+            if tenancy is not None and getattr(tenancy, "enabled", False)
+            else None
+        )
         self._classes = {
             c: _ClassQueue(
                 c,
                 max(1, int(queue_limits.get(c, defaults_q[c]))),
                 max(1, int(workers.get(c, defaults_w[c]))),
+                weight_fn=weight_fn,
             )
             for c in CLASSES
         }
@@ -254,6 +398,11 @@ class QueryPipeline:
         self.batches = 0
         self.batched_entries = 0
         self.expired = 0
+        # per-tenant counters (ISSUE 19 satellite: under mixed load the
+        # lumped counters above are misleading — /debug/pipeline and
+        # /debug/tenancy break them out by index). Keyed by index, ""
+        # excluded (direct submit callers with no tenant context).
+        self.tenant_counters: dict[str, dict[str, int]] = {}
         self._threads: list[threading.Thread] = []
         for c, cq in self._classes.items():
             for i in range(cq.workers):
@@ -274,10 +423,59 @@ class QueryPipeline:
         signature=None,
         batch: Optional[dict] = None,
         trace_ctx: Optional[tuple] = None,
+        index: str = "",
+        nbytes: int = 0,
     ) -> Any:
         """Run ``thunk`` through the pipeline and return its result.
-        Raises Overloaded (shed / draining), DeadlineExceeded, or
-        whatever the thunk raised."""
+        Raises Overloaded (shed / draining / tenant-throttled),
+        DeadlineExceeded, or whatever the thunk raised. ``index`` is
+        the tenant; ``nbytes`` its in-flight byte charge (the request
+        body size — released when submit returns)."""
+        tenancy = self.tenancy
+        charged = False
+        if tenancy is not None:
+            # per-tenant token bucket BEFORE the shared queue: raises
+            # TenantThrottled (429 + the tenant's own Retry-After)
+            try:
+                tenancy.admit(index, cls, nbytes)
+            except Overloaded:
+                if index:
+                    with self._mu:
+                        self._tenant_counter(index)["throttled"] += 1
+                raise
+            charged = True
+        try:
+            return self._submit_admitted(
+                cls, thunk, deadline, signature, batch, trace_ctx, index
+            )
+        finally:
+            if charged:
+                tenancy.release(index, cls, nbytes)
+
+    def _tenant_counter(self, index: str) -> dict[str, int]:
+        """Per-tenant counter row; caller holds _mu."""
+        d = self.tenant_counters.get(index)
+        if d is None:
+            d = self.tenant_counters[index] = {
+                "admitted": 0,
+                "sheds": 0,
+                "throttled": 0,
+                "expired": 0,
+                "completed": 0,
+                "coalesce_hits": 0,
+            }
+        return d
+
+    def _submit_admitted(
+        self,
+        cls: str,
+        thunk: Callable[[], Any],
+        deadline: Optional[Deadline],
+        signature,
+        batch: Optional[dict],
+        trace_ctx: Optional[tuple],
+        index: str,
+    ) -> Any:
         cq = self._classes[cls]
         entry = _Entry(
             cls,
@@ -287,6 +485,7 @@ class QueryPipeline:
             batch_payload=batch,
             deadline=deadline,
             trace_ctx=trace_ctx,
+            index=index,
         )
         leader: Optional[_Entry] = None
         with self._mu:
@@ -299,23 +498,39 @@ class QueryPipeline:
                     # no queue slot, no worker
                     self.coalesce_hits += 1
                     metrics.count(metrics.PIPELINE_COALESCE_HITS)
+                    if index:
+                        self._tenant_counter(index)["coalesce_hits"] += 1
                 else:
                     self._inflight[signature] = entry
             if leader is None:
                 if len(cq.q) >= cq.limit:
                     cq.sheds += 1
                     metrics.count(metrics.PIPELINE_SHEDS, cls=cls)
+                    if index:
+                        self._tenant_counter(index)["sheds"] += 1
+                        metrics.count(
+                            metrics.TENANT_SHEDS, tenant=index, cls=cls
+                        )
                     if signature is not None:
                         self._inflight.pop(signature, None)
+                    # 503, not 429: the CLASS queue is full — the server
+                    # (not one tenant) is out of capacity, and internal
+                    # retry policy treats 503 as retryable-elsewhere
                     raise Overloaded(
                         f"{cls} admission queue full "
                         f"({len(cq.q)}/{cq.limit}); retry later",
                         retry_after=self.shed_retry_after,
+                        status=503,
                     )
                 entry.t_enq = time.monotonic()
                 cq.q.append(entry)
                 cq.admitted += 1
                 metrics.count(metrics.PIPELINE_ADMITTED, cls=cls)
+                if index:
+                    self._tenant_counter(index)["admitted"] += 1
+                    metrics.count(
+                        metrics.TENANT_ADMITTED, tenant=index, cls=cls
+                    )
                 metrics.gauge(metrics.PIPELINE_QUEUE_DEPTH, len(cq.q), cls=cls)
                 self._cond.notify_all()
         if leader is not None and trace_ctx is not None and trace_ctx[2]:
@@ -368,11 +583,15 @@ class QueryPipeline:
                 with self._mu:
                     cq.busy -= len(gang)
                     cq.completed += len(gang)
+                    for e in gang:
+                        if e.index:
+                            self._tenant_counter(e.index)["completed"] += 1
 
     def _dequeue_gang(self, cq: _ClassQueue) -> list[_Entry]:
         """Pop the head entry plus every queued peer sharing its batch
         key (up to batch_max) — the backlog IS the batching window.
-        Caller holds the lock."""
+        The batch key carries the index, so a gang is always a single
+        tenant's work. Caller holds the lock."""
         head = cq.q.popleft()
         gang = [head]
         if (
@@ -383,14 +602,10 @@ class QueryPipeline:
         ):
             return gang
         if cq.q:
-            keep: deque[_Entry] = deque()
-            for e in cq.q:
-                if e.batch_key == head.batch_key and len(gang) < self.batch_max:
-                    gang.append(e)
-                else:
-                    keep.append(e)
-            cq.q.clear()
-            cq.q.extend(keep)
+            took = [e for e in cq.q if e.batch_key == head.batch_key]
+            for e in took[: self.batch_max - 1]:
+                cq.q.remove(e)
+                gang.append(e)
         return gang
 
     def _collect_window(self, cq: _ClassQueue, gang: list[_Entry]) -> list[_Entry]:
@@ -421,11 +636,20 @@ class QueryPipeline:
         for e in gang:
             e.wait_s = now - e.t_enq
             metrics.observe(metrics.PIPELINE_WAIT_SECONDS, e.wait_s, cls=cq.name)
+            if e.index:
+                metrics.observe(
+                    metrics.TENANT_QUEUE_WAIT_SECONDS,
+                    e.wait_s,
+                    tenant=e.index,
+                    cls=cq.name,
+                )
             if e.deadline is not None and e.deadline.expired():
                 # expired while queued: cancel BEFORE any parse/executor
                 # work (its waiter already raised or will immediately)
                 with self._mu:
                     self.expired += 1
+                    if e.index:
+                        self._tenant_counter(e.index)["expired"] += 1
                 metrics.count(metrics.PIPELINE_DEADLINE_EXPIRED, stage="queue")
                 self._finish(e, error=DeadlineExceeded("queue"))
                 continue
@@ -458,6 +682,8 @@ class QueryPipeline:
         if e.deadline is not None and e.deadline.expired():
             with self._mu:
                 self.expired += 1
+                if e.index:
+                    self._tenant_counter(e.index)["expired"] += 1
             metrics.count(metrics.PIPELINE_DEADLINE_EXPIRED, stage="queue")
             self._finish(e, error=DeadlineExceeded("queue"))
             return
@@ -525,6 +751,13 @@ class QueryPipeline:
                 "batches": self.batches,
                 "batched_entries": self.batched_entries,
                 "deadline_expired": self.expired,
+                "weighted_fair": any(
+                    cq.q.weight_fn is not None for cq in self._classes.values()
+                ),
+                "tenants": {
+                    idx: dict(row)
+                    for idx, row in self.tenant_counters.items()
+                },
                 "classes": {
                     c: {
                         "queue_depth": len(cq.q),

@@ -330,6 +330,26 @@ class Server:
         from pilosa_tpu.server.fleet import FleetCollector
 
         self.fleet = FleetCollector(self)
+        # multi-tenant QoS (server/tenancy.py): per-index admission
+        # buckets, weighted-fair scheduling, HBM quotas, per-tenant
+        # SLOs. Disabled (zero-cost passthrough) when no tenant-* knob
+        # is configured — the single-tenant default stays bit-identical
+        from pilosa_tpu.server.tenancy import TenancyManager
+
+        self.tenancy = TenancyManager(
+            weights=self.config.tenant_weights,
+            qps=self.config.tenant_qps,
+            hbm_quota=self.config.tenant_hbm_quota,
+            inflight_bytes=self.config.tenant_inflight_bytes,
+            objectives=self.config.tenant_objectives,
+        )
+        if self.tenancy.enabled and (
+            self.tenancy.hbm_quotas() or self.tenancy.default_hbm_quota
+        ):
+            self.executor.governor.set_index_quotas(
+                self.tenancy.hbm_quotas(),
+                default=self.tenancy.default_hbm_quota,
+            )
         # serving pipeline (server/pipeline.py): every query/import
         # request flows through bounded per-class admission queues with
         # deadline scheduling, singleflight coalescing, and
@@ -364,6 +384,7 @@ class Server:
                 dispatch_handoff=(
                     self.executor.dispatch_engine is not None
                 ),
+                tenancy=self.tenancy,
             )
         # durable ingest queue (server/ingest.py): its own admission
         # class beside interactive/bulk — bounded write-ahead queue,
@@ -388,6 +409,7 @@ class Server:
             default_timeout=self.config.pipeline_default_timeout,
             analytics_timeout=self.config.analytics_timeout,
             ingest=self.ingest,
+            tenancy=self.tenancy,
         )
         self.diagnostics = DiagnosticsCollector(
             host=getattr(self.config, "diagnostics_host", ""),
@@ -584,6 +606,11 @@ class Server:
             objectives=slo.parse_objectives(self.config.slo_objectives),
             burn_threshold=self.config.slo_burn_threshold,
         )
+        # per-tenant SLOs ride the same monitor as tenant:<index>
+        # classes — one tick, one scrape (server/tenancy.py); tenants
+        # covered only by the "*" default register lazily at first query
+        if self.tenancy.enabled:
+            slo.MONITOR.merge(self.tenancy.slo_objectives())
         profiler.TELEMETRY.watermark_pct = self.config.hbm_watermark_pct
         stager = self.stager
 

@@ -249,6 +249,31 @@ def compute_skew(cells: list[dict], dim: str = "heat", top_k: int = 10) -> dict:
     }
 
 
+def tenant_rollup(cells: list[dict]) -> dict:
+    """Aggregate cell dicts by *index* — the tenant boundary
+    (server/tenancy.py). One row per tenant: decayed heat plus every
+    raw counter summed over the tenant's cells, so /debug/tenancy and
+    the fleet scrape answer "who is generating the load" without a
+    second ledger. Module-level (like ``compute_skew``) so the fleet
+    branch can run it over merged multi-instance cells."""
+    out: dict[str, dict] = {}
+    for c in cells:
+        row = out.get(c["index"])
+        if row is None:
+            row = out[c["index"]] = {
+                "heat": 0.0,
+                "cells": 0,
+                **{d: 0 for d in DIMS},
+            }
+        row["heat"] += float(c.get("heat", 0.0))
+        row["cells"] += 1
+        for d in DIMS:
+            row[d] += int(c.get(d, 0))
+    for row in out.values():
+        row["heat"] = round(row["heat"], 6)
+    return out
+
+
 def merge_fleet(pairs: list, dim: str = "heat", top_k: int = 10) -> dict:
     """Fleet aggregation for ``/debug/heat?fleet=true``: ``pairs`` is
     ``[(label, snapshot), ...]`` from every reachable instance. Cells

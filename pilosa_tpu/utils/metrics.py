@@ -146,6 +146,16 @@ MULTIHOST_FOLLOWER_ERRORS = "multihost.follower_errors"
 # serving pipeline (server/pipeline.py)
 PIPELINE_ADMITTED = "pipeline.admitted"
 PIPELINE_SHEDS = "pipeline.sheds"
+# multi-tenant QoS (ISSUE 19, server/tenancy.py): per-index admission
+# buckets, weighted-fair scheduling, HBM quotas, per-tenant SLOs
+TENANT_ADMITTED = "tenant.admitted"
+TENANT_THROTTLED = "tenant.throttled"
+TENANT_SHEDS = "tenant.sheds"
+TENANT_QUEUE_WAIT_SECONDS = "tenant.queue_wait_seconds"
+TENANT_STAGE_SECONDS = "tenant.stage_seconds"
+TENANT_INFLIGHT_BYTES = "tenant.inflight_bytes"
+TENANT_HBM_BYTES = "tenant.hbm_bytes"
+TENANT_HBM_EVICTIONS = "tenant.hbm_evictions"
 PIPELINE_QUEUE_DEPTH = "pipeline.queue_depth"
 PIPELINE_WAIT_SECONDS = "pipeline.wait_seconds"
 PIPELINE_COALESCE_HITS = "pipeline.coalesce_hits"
@@ -472,7 +482,50 @@ METRICS: dict[str, tuple[str, str]] = {
     ),
     PIPELINE_SHEDS: (
         "counter",
-        "requests shed 429 because a class admission queue was full (label: cls)",
+        "requests shed 503 + Retry-After because a class admission "
+        "queue was full — whole-server overload, distinct from the "
+        "per-tenant 429 throttle (label: cls)",
+    ),
+    TENANT_ADMITTED: (
+        "counter",
+        "requests admitted through a tenant's token bucket into the "
+        "pipeline (labels: tenant, cls)",
+    ),
+    TENANT_THROTTLED: (
+        "counter",
+        "requests refused 429 + Retry-After by a tenant's own "
+        "admission bucket (labels: tenant; reason = qps | bytes)",
+    ),
+    TENANT_SHEDS: (
+        "counter",
+        "per-tenant view of class-queue sheds: requests this tenant "
+        "lost to whole-server overload (labels: tenant, cls)",
+    ),
+    TENANT_QUEUE_WAIT_SECONDS: (
+        "summary",
+        "per-tenant admission-queue wait under weighted-fair dequeue "
+        "(labels: tenant, cls)",
+    ),
+    TENANT_STAGE_SECONDS: (
+        "summary",
+        "per-tenant latency waterfall: seconds spent in one pipeline "
+        "stage serving one tenant's queries (labels: tenant, stage)",
+    ),
+    TENANT_INFLIGHT_BYTES: (
+        "gauge",
+        "request bytes currently in flight per tenant (admission "
+        "ledger, label: tenant)",
+    ),
+    TENANT_HBM_BYTES: (
+        "gauge",
+        "HBM-domain bytes attributed to one tenant across governor "
+        "subsystems: staged blocks + device plan cache (label: tenant)",
+    ),
+    TENANT_HBM_EVICTIONS: (
+        "counter",
+        "blocks evicted from an over-quota tenant by a quota-preferring "
+        "relief sweep or same-tenant insert eviction (labels: tenant; "
+        "tier = stager | device_cache)",
     ),
     PIPELINE_QUEUE_DEPTH: (
         "gauge",
@@ -816,12 +869,13 @@ METRICS: dict[str, tuple[str, str]] = {
     SLO_BURN_RATE: (
         "gauge",
         "error-budget burn rate over a trailing window (labels: cls, "
-        "window = 5m | 1h); 1.0 burns the budget exactly at period end",
+        "window = 5m | 1h); 1.0 burns the budget exactly at period "
+        "end. Per-tenant objectives appear as cls=tenant:<index>",
     ),
     SLO_BUDGET_REMAINING: (
         "gauge",
         "fraction of the error budget left over the long (1h) window, "
-        "per request class (label: cls)",
+        "per request class or tenant objective (label: cls)",
     ),
     SLO_BURNS: (
         "counter",

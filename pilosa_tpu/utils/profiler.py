@@ -63,6 +63,10 @@ class WaterfallAggregator:
         self.ema_alpha = ema_alpha
         self._rtt_ema: Optional[float] = None
         self.recorded = 0
+        # per-tenant waterfall rollup (tenant = index, server/tenancy.py):
+        # stage-ms sums + query count per tenant, read by /debug/tenancy
+        # and the fleet scrape — who spends their latency where
+        self._by_tenant: dict[str, dict] = {}
 
     @staticmethod
     def summarize(stages: dict, total_s: float) -> dict:
@@ -96,21 +100,36 @@ class WaterfallAggregator:
             out["wave"] = wave
         return out
 
-    def record(self, cls: str, total_s: float, stages: Optional[dict]) -> Optional[dict]:
+    def record(
+        self,
+        cls: str,
+        total_s: float,
+        stages: Optional[dict],
+        tenant: str = "",
+    ) -> Optional[dict]:
         """Aggregate one served query from a raw attribution dict;
         returns the summary (also appended to the ring), or None when no
         attribution ran."""
         if stages is None:
             return None
-        return self.record_summary(cls, self.summarize(stages, total_s))
+        return self.record_summary(cls, self.summarize(stages, total_s), tenant=tenant)
 
-    def record_summary(self, cls: str, summary: dict) -> dict:
+    def record_summary(self, cls: str, summary: dict, tenant: str = "") -> dict:
         """Aggregate an already-summarized waterfall (the form api.query
-        attaches to the response as ``_waterfall``)."""
+        attaches to the response as ``_waterfall``). ``tenant`` (the
+        query's index) additionally folds the waterfall into the
+        per-tenant rollup and the tenant-labelled stage summary."""
         for name, ms in summary["stages"].items():
             metrics.observe(
                 metrics.LATENCY_STAGE_SECONDS, ms / 1000.0, cls=cls, stage=name
             )
+            if tenant:
+                metrics.observe(
+                    metrics.TENANT_STAGE_SECONDS,
+                    ms / 1000.0,
+                    tenant=tenant,
+                    stage=name,
+                )
         frac = summary["rtt_fraction"]
         with self._mu:
             self._rtt_ema = (
@@ -119,10 +138,37 @@ class WaterfallAggregator:
                 else self._rtt_ema + self.ema_alpha * (frac - self._rtt_ema)
             )
             ema = self._rtt_ema
-            self._ring.append({"cls": cls, **summary})
+            entry = {"cls": cls, **summary}
+            if tenant:
+                entry["tenant"] = tenant
+                row = self._by_tenant.get(tenant)
+                if row is None:
+                    row = self._by_tenant[tenant] = {
+                        "queries": 0,
+                        "total_ms": 0.0,
+                        "stages": {},
+                    }
+                row["queries"] += 1
+                row["total_ms"] += summary["total_ms"]
+                for name, ms in summary["stages"].items():
+                    row["stages"][name] = row["stages"].get(name, 0.0) + ms
+            self._ring.append(entry)
             self.recorded += 1
         metrics.gauge(metrics.EXECUTOR_RTT_FRACTION, round(ema, 4))
         return summary
+
+    def tenant_waterfalls(self) -> dict:
+        """{tenant: {queries, total_ms, stages: {stage: ms}}} — the
+        per-tenant latency waterfall rollup for /debug/tenancy."""
+        with self._mu:
+            return {
+                t: {
+                    "queries": row["queries"],
+                    "total_ms": round(row["total_ms"], 3),
+                    "stages": {n: round(v, 3) for n, v in row["stages"].items()},
+                }
+                for t, row in self._by_tenant.items()
+            }
 
     def rtt_fraction(self) -> Optional[float]:
         with self._mu:
@@ -146,6 +192,7 @@ class WaterfallAggregator:
             self._ring.clear()
             self._rtt_ema = None
             self.recorded = 0
+            self._by_tenant.clear()
 
 
 # -- XLA compile tracking -----------------------------------------------------

@@ -97,6 +97,27 @@ class SLOMonitor:
                 cls: _ClassState(lat, tgt) for cls, (lat, tgt) in objectives.items()
             }
 
+    def has_class(self, cls: str) -> bool:
+        with self._mu:
+            return cls in self._classes
+
+    def ensure_class(self, cls: str, objective: tuple) -> None:
+        """Register one objective without touching the rest — the
+        lazy-registration path for ``tenant:<index>`` keys covered by a
+        ``*`` default (server/tenancy.py): tenant names are not known
+        at configure time, only at first query."""
+        lat, tgt = objective
+        with self._mu:
+            if cls not in self._classes:
+                self._classes[cls] = _ClassState(lat, tgt)
+
+    def merge(self, objectives: dict) -> None:
+        """Add/replace objectives, keeping existing ones — used to lay
+        per-tenant objectives over the per-class set."""
+        with self._mu:
+            for cls, (lat, tgt) in objectives.items():
+                self._classes[cls] = _ClassState(lat, tgt)
+
     def record(self, cls: str, duration_s: float, ok: bool, now: Optional[float] = None) -> None:
         """Account one served query. Unknown classes are ignored (no
         objective → no budget to burn)."""

@@ -1,4 +1,4 @@
-"""Serving pipeline (ISSUE 2): bounded admission with 429 + Retry-After
+"""Serving pipeline (ISSUE 2): bounded admission with 503 + Retry-After
 sheds, deadline propagation/cancellation at stage boundaries,
 singleflight coalescing, cross-request batching, graceful drain, and
 the /debug/pipeline + metrics surface.
@@ -197,7 +197,10 @@ def test_http_deadline_504_and_bad_values(tmp_path):
 # -- overload shedding ------------------------------------------------------
 
 
-def test_overload_sheds_429_with_retry_after(tmp_path):
+def test_overload_sheds_503_with_retry_after(tmp_path):
+    # queue-full is WHOLE-SERVER overload → 503 + Retry-After (the
+    # internal client retries 503 against replicas); the per-tenant
+    # throttle is the only 429 (tests/test_tenancy.py)
     s = make_server(
         tmp_path,
         pipeline_interactive_workers=2,
@@ -241,8 +244,8 @@ def test_overload_sheds_429_with_retry_after(tmp_path):
         s.executor.execute = orig
         codes = sorted(st for st, _ in results)
         assert codes.count(200) == 4, codes
-        assert codes.count(429) == 12, codes
-        shed_headers = [hd for st, hd in results if st == 429]
+        assert codes.count(503) == 12, codes
+        shed_headers = [hd for st, hd in results if st == 503]
         assert all(hd.get("Retry-After") == "3" for hd in shed_headers)
         stats = s.pipeline.stats()
         assert stats["classes"]["interactive"]["sheds"] == 12
