@@ -1764,6 +1764,118 @@ def _tenant_mix_probe(budget_s: float) -> dict:
     return out
 
 
+def _keyed_mix_probe(budget_s: float) -> dict:
+    """Keyed-vs-raw serving cost (ISSUE 20): the same warm TopN traffic
+    through a keyed index (string keys resolved pre-canonicalization,
+    results reverse-translated through the bounded LRU) and through its
+    raw-id twin. Acceptance: keyed warm qps >= 0.9x raw (the translate
+    layer must be a lookup, not a tax); the LRU hit ratio is reported —
+    warm reverse translation should be ~all hits."""
+    import json as _json
+    import shutil as _shutil
+    import tempfile
+    import urllib.request
+
+    from pilosa_tpu.server import Config, Server
+
+    n_cols = int(os.environ.get("PILOSA_BENCH_KEYED_COLS", 2000))
+    n_rows = 16
+
+    out = {
+        "note": (
+            "warm TopN qps through a keyed index vs its raw-id twin "
+            "(chip-independent: measures the translate layer, not the "
+            "kernel)"
+        ),
+        "columns": n_cols,
+        "rows": n_rows,
+    }
+    tmp = tempfile.mkdtemp(prefix="pilosa_keyed_probe_")
+    cfg = Config(
+        data_dir=tmp,
+        bind="127.0.0.1:0",
+        device_policy="never",
+        device_timeout=0,
+        metric="none",
+    )
+    s = Server(cfg)
+    s.open()
+    try:
+        def post(path, body):
+            r = urllib.request.Request(s.uri + path, data=body, method="POST")
+            with urllib.request.urlopen(r, timeout=60) as resp:
+                return resp.read()
+
+        post("/index/k", _json.dumps({"options": {"keys": True}}).encode())
+        post(
+            "/index/k/field/f",
+            _json.dumps({"options": {"keys": True}}).encode(),
+        )
+        post("/index/r", b"{}")
+        post("/index/r/field/f", b"{}")
+
+        # keyed load (mints every key), then the identical bits by
+        # pre-translated raw ids into the twin
+        batch = 500
+        for at in range(0, n_cols, batch):
+            cols = [f"user-{j:05d}" for j in range(at, min(at + batch, n_cols))]
+            rows = [f"seg-{j % n_rows:02d}" for j in range(at, min(at + batch, n_cols))]
+            post(
+                "/index/k/field/f/ingest",
+                _json.dumps({"rowKeys": rows, "columnKeys": cols}).encode(),
+            )
+        ts = s.translate_store
+        for at in range(0, n_cols, batch):
+            cols = [f"user-{j:05d}" for j in range(at, min(at + batch, n_cols))]
+            rows = [f"seg-{j % n_rows:02d}" for j in range(at, min(at + batch, n_cols))]
+            cids = ts.translate_columns_to_ids("k", cols, create=False)
+            rids = ts.translate_rows_to_ids("k", "f", rows, create=False)
+            post(
+                "/index/r/field/f/ingest",
+                _json.dumps({"rowIDs": rids, "columnIDs": cids}).encode(),
+            )
+
+        # bulk ingest bypasses the ranked TopN cache — force the
+        # recalculation so TopN serves real candidate rows (and the
+        # keyed side really pays/amortizes reverse translation)
+        post("/recalculate-caches", b"")
+        q = b"TopN(f, n=10)"
+
+        def drive(index, seconds):
+            # warm first (stager fill + LRU fill), then a timed
+            # closed loop; ?cache=false so the plan cache doesn't
+            # collapse the measurement into one lookup
+            path = f"/index/{index}/query?cache=false"
+            for _ in range(5):
+                post(path, q)
+            n = 0
+            t0 = time.perf_counter()
+            stop = t0 + seconds
+            while time.perf_counter() < stop:
+                post(path, q)
+                n += 1
+            return n / (time.perf_counter() - t0)
+
+        seg = max(2.0, min(8.0, (budget_s - 4.0) / 2.0))
+        raw_qps = drive("r", seg)
+        keyed_qps = drive("k", seg)
+        ratio = keyed_qps / raw_qps if raw_qps else 0.0
+        dbg = _json.loads(
+            urllib.request.urlopen(s.uri + "/debug/translate", timeout=30).read()
+        )
+        out["raw_qps"] = round(raw_qps, 1)
+        out["keyed_qps"] = round(keyed_qps, 1)
+        out["keyed_vs_raw"] = round(ratio, 3)
+        out["lru_hit_ratio"] = dbg["cache"].get("hitRatio")
+        out["keys"] = dbg["keys"]
+        out["acceptance"] = ">=0.9 warm"
+        out["pass"] = ratio >= 0.9
+    finally:
+        s.close()
+        _shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def main():
     import os
 
@@ -2170,6 +2282,20 @@ def main():
             except Exception as e:
                 print(
                     f"tenant-mix probe failed: {type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
+
+    # ---- keyed-mix probe (ISSUE 20): warm TopN through a keyed index
+    # vs its raw-id twin; keyed must hold >=0.9x raw qps with the
+    # reverse-translation LRU absorbing the id->key cost.
+    if os.environ.get("PILOSA_BENCH_KEYED", "1") != "0":
+        rem = child_budget - (time.monotonic() - _T_PROC_START)
+        if rem > 45:
+            try:
+                result["keyed_mix"] = _keyed_mix_probe(min(18.0, rem - 25))
+            except Exception as e:
+                print(
+                    f"keyed-mix probe failed: {type(e).__name__}: {e}",
                     file=sys.stderr,
                 )
 

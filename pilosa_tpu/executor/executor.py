@@ -657,8 +657,12 @@ class Executor:
         if shards is None and self._needs_shards(query.calls):
             shards = list(range(idx.max_shard() + 1))
         if self.translate_store is not None and not opt.remote:
-            for call in query.calls:
-                self._translate_call(index_name, idx, call)
+            # keys→ids BEFORE canonicalization (plan/planner.py): the
+            # CSE hashes, plan-cache keys, and dispatch signatures all
+            # see resolved integer ids only
+            from pilosa_tpu.plan import planner as _planner
+
+            _planner.resolve_keys(self, index_name, idx, query.calls)
         calls = query.calls
         if (
             self.plan_cache is not None
@@ -748,87 +752,18 @@ class Executor:
     #    executor.go:1595-1696) --------------------------------------------
 
     def _translate_call(self, index, idx, c: Call) -> None:
-        if c.name in ("Set", "Clear", "Row"):
-            col_key = "_col"
-            try:
-                field_name = c.field_arg()
-            except ValueError:
-                field_name = ""
-            row_key = field_name
-        else:
-            col_key = "col"
-            field_name = c.args.get("field") or ""
-            row_key = "row"
-        from pilosa_tpu.pql.ast import WRITE_CALLS
+        # delegated to the translate subsystem (translate/resolve.py);
+        # kept as a method so direct callers and tests keep working
+        from pilosa_tpu.translate import resolve
 
-        # Writes mint ids; reads look up only (create=False) — minting
-        # on reads would durably pollute the cluster WAL with typo'd
-        # keys and make read availability depend on the translate
-        # primary being up. An unknown key on a read resolves to id 0,
-        # which is never minted (ids start at 1) and so matches nothing.
-        create = c.name in WRITE_CALLS
-        ts = self.translate_store
-        if idx.keys:
-            v = c.args.get(col_key)
-            if v is not None and not isinstance(v, str):
-                raise ValueError(
-                    "column value must be a string when index 'keys' option enabled"
-                )
-            if isinstance(v, str) and v:
-                tid = ts.translate_columns_to_ids(index, [v], create=create)[0]
-                c.args[col_key] = tid if tid is not None else 0
-        else:
-            if isinstance(c.args.get(col_key), str):
-                raise ValueError(
-                    "string 'col' value not allowed unless index 'keys' option enabled"
-                )
-        if field_name:
-            fld = idx.field(field_name)
-            if fld is None:
-                raise NotFoundError(f"field not found: {field_name}")
-            if fld.options.keys:
-                v = c.args.get(row_key)
-                if v is not None and not isinstance(v, str):
-                    raise ValueError(
-                        "row value must be a string when field 'keys' option enabled"
-                    )
-                if isinstance(v, str) and v:
-                    tid = ts.translate_rows_to_ids(
-                        index, field_name, [v], create=create
-                    )[0]
-                    c.args[row_key] = tid if tid is not None else 0
-            else:
-                if isinstance(c.args.get(row_key), str):
-                    raise ValueError(
-                        "string 'row' value not allowed unless field 'keys' option enabled"
-                    )
-        for child in c.children:
-            self._translate_call(index, idx, child)
+        resolve.resolve_call(self.translate_store, index, idx, c)
 
     def _translate_result(self, index, idx, call: Call, result):
-        ts = self.translate_store
-        if isinstance(result, Row):
-            if idx.keys:
-                result.keys = [
-                    ts.translate_column_to_string(index, int(col))
-                    for col in result.columns()
-                ]
-            return result
-        if isinstance(result, list) and result and isinstance(result[0], dict) and "id" in result[0]:
-            field_name = call.args.get("_field") or ""
-            if field_name:
-                fld = idx.field(field_name)
-                if fld is not None and fld.options.keys:
-                    return [
-                        {
-                            "key": ts.translate_row_to_string(
-                                index, field_name, p["id"]
-                            ),
-                            "count": p["count"],
-                        }
-                        for p in result
-                    ]
-        return result
+        from pilosa_tpu.translate import resolve
+
+        return resolve.translate_result(
+            self.translate_store, index, idx, call, result
+        )
 
     @staticmethod
     def _needs_shards(calls: list[Call]) -> bool:

@@ -483,7 +483,18 @@ class InternalClient:
     def max_shards(self, uri: str) -> dict:
         return self._request("GET", uri, "/internal/shards/max").get("standard", {})
 
-    def translate_data(self, uri: str, offset: int) -> bytes:
+    def translate_data(self, uri: str, offset: int, store: str = "") -> bytes:
+        """Raw translate-log frames from ``offset``; ``store`` names one
+        key space (pilosa_tpu/translate/), empty = the legacy
+        whole-WAL stream."""
+        q: dict = {"offset": offset}
+        if store:
+            q["store"] = store
         return self._request(
-            "GET", uri, "/internal/translate/data", query={"offset": offset}, raw=True
+            "GET", uri, "/internal/translate/data", query=q, raw=True
         )
+
+    def translate_stores(self, uri: str) -> list[dict]:
+        """A peer's durable translate stores with their current byte
+        offsets — the pull-replication listing."""
+        return self._request("GET", uri, "/internal/translate/stores")

@@ -342,3 +342,19 @@ def rewrite_for_cse(executor, index: str, calls: list, shards, opt) -> list:
             continue
         out.append(substitute(c, True))
     return out
+
+
+def resolve_keys(executor, index: str, idx, calls) -> None:
+    """Keyed-surface entry point: resolve string keys to integer ids
+    in-place across every call tree BEFORE canonicalization, so the
+    CSE hashes and plan-cache keys above only ever see resolved ids —
+    two spellings of the same keyed subtree share one cache entry, and
+    re-keying an id can never serve a stale cached row. Delegates to
+    the translate subsystem (translate/resolve.py)."""
+    from pilosa_tpu.translate import resolve
+
+    ts = executor.translate_store
+    if ts is None:
+        return
+    for c in calls:
+        resolve.resolve_call(ts, index, idx, c)

@@ -815,6 +815,14 @@ class API:
                         entries.append(
                             (f"fragments/{iname}/{fname}/{vname}/{shard}", data)
                         )
+        # key translation logs ride along: a restored holder must
+        # resolve exactly the archive's keys (translate/<store>.log
+        # members; older restore targets verify-then-ignore unknown
+        # prefixes, so the manifest version stays 1)
+        ts = self.executor.translate_store
+        if ts is not None and hasattr(ts, "store_files"):
+            for name, blob in ts.store_files():
+                entries.append((f"translate/{name}.log", blob))
         manifest = {
             "version": self.BACKUP_MANIFEST_VERSION,
             "entries": {
@@ -850,6 +858,7 @@ class API:
 
         self._validate("fragment_data")
         from pilosa_tpu.roaring import Bitmap
+        from pilosa_tpu.translate.store import SpaceStore
 
         def refuse(reason: str) -> APIError:
             metrics.count(metrics.RESTORE_REFUSED)
@@ -906,6 +915,28 @@ class API:
             except Exception:
                 raise refuse(f"backup entry {name} unparseable")
             fragments.append((parts[1], parts[2], parts[3], int(parts[4]), storage))
+        translate_blobs = {}
+        ts = self.executor.translate_store
+        for name, blob in blobs.items():
+            if not name.startswith("translate/") or not name.endswith(".log"):
+                continue
+            store = name[len("translate/") : -len(".log")]
+            if (
+                "/" not in store
+                or ".." in store
+                or store.startswith(("/", "\\"))
+            ):
+                raise refuse(f"backup entry {name} has a malformed path")
+            # a tampered translate log would silently rebind every key
+            # written through it — every frame must verify (intact CRC
+            # prefix covering the whole member), same
+            # verify-everything-before-apply bar as fragments
+            probe = SpaceStore(None, "probe")
+            if probe._replay(blob) != len(blob):
+                raise refuse(f"backup entry {name} unparseable")
+            translate_blobs[store] = blob
+        if translate_blobs and (ts is None or not hasattr(ts, "restore_stores")):
+            raise refuse("backup has translate entries but no translate store")
         # -- verification complete: apply --
         self.holder.apply_schema(schema)
         for iname, fname, vname, shard, storage in fragments:
@@ -913,6 +944,12 @@ class API:
             view = fld.create_view_if_not_exists(vname)
             frag = view.create_fragment_if_not_exists(shard)
             self._replace_fragment_storage(frag, storage)
+        if translate_blobs:
+            # replace-all semantics WITHIN the translate plane: the
+            # restored holder resolves exactly the archive's keys
+            # (archives without translate members leave local stores
+            # untouched, like fragments the archive doesn't name)
+            ts.restore_stores(translate_blobs)
         metrics.count(metrics.RESTORE_APPLIED)
         if self.server is not None:
             self.server.send_sync({"type": "schema", "schema": schema})
@@ -1116,40 +1153,90 @@ class API:
         except Exception:
             return False
 
-    def get_translate_data(self, offset: int) -> bytes:
+    def get_translate_data(self, offset: int, store: str = "") -> bytes:
         ts = self.executor.translate_store
         if ts is None:
             raise APIError("translate store not configured")
+        if store:
+            try:
+                return ts.read_store(store, offset)
+            except ValueError as e:
+                raise APIError(str(e), status=400)
         data, _ = ts.read_from(offset)
         return data
 
-    def translate_keys(self, index: str, field: str, keys: list) -> list:
-        """Mint (or look up) ids for keys — the follower-forward target;
-        this node must be the translate primary. Mints LOCALLY
-        unconditionally (never re-forwards — see TranslateStore.mint).
-
-        When this node's OWN resolution names a different primary, the
-        request is rejected with 409: minting here would permanently
-        fork the cluster's id space (each mint is durable in the local
-        WAL). The bind-vs-advertise case — the primary's advertised
-        name differing from its bind address — is handled inside
-        ``translate_primary`` via URI equivalence + DNS resolution
-        (``Server._is_self``), NOT via anything request-controlled: a
-        client-supplied header must never be able to open the mint
-        gate on a follower."""
+    def translate_stores(self) -> list:
+        """Durable translate stores with byte offsets — what a peer
+        polls to pull-replicate key assignments."""
         ts = self.executor.translate_store
         if ts is None:
             raise APIError("translate store not configured")
-        if self.server is not None:
-            p = self.server.translate_primary()
-            if p:
-                raise APIError(
-                    f"not the translate primary (primary={p}); minting "
-                    "here would fork the cluster id space — post to the "
-                    "primary or fix translate-primary-url",
-                    status=409,
-                )
-        return ts.mint(index, field, [str(k) for k in keys])
+        return ts.stores()
+
+    def translate_debug(self) -> dict:
+        ts = self.executor.translate_store
+        if ts is None:
+            return {"enabled": False}
+        out = ts.stats()
+        out["enabled"] = True
+        return out
+
+    def translate_ingest_keys(
+        self, index: str, field: str, row_keys, column_keys
+    ) -> tuple:
+        """Keyed-ingest resolution: translate the batch's key lists to
+        id lists BEFORE the ingest queue sees it, so write waves (and
+        their routed local legs) carry integer ids only. One translate
+        batch per ingest wave — assignments group-commit with one
+        fsync per store touched."""
+        ts = self.executor.translate_store
+        if ts is None:
+            raise APIError("translate store not configured")
+        rows = cols = None
+        if column_keys:
+            cols = ts.translate_columns_to_ids(
+                index, [str(k) for k in column_keys]
+            )
+        if row_keys:
+            rows = ts.translate_rows_to_ids(
+                index, field, [str(k) for k in row_keys]
+            )
+        return rows, cols
+
+    def translate_keys(self, index: str, field: str, keys: list) -> list:
+        """Mint (or look up) ids for keys — the federated-forward
+        target; this node must OWN every key space the batch touches.
+        Mints LOCALLY unconditionally (never re-forwards — see
+        Translator.mint).
+
+        When this node's OWN ownership resolution names a different
+        owner for any key, the request is rejected with 409: minting
+        here would permanently fork the cluster's id space (each mint
+        is durable in the local log). The bind-vs-advertise case — an
+        owner's advertised name differing from its bind address — is
+        handled inside ``Server._translate_owner`` via URI equivalence
+        + DNS resolution (``Server._is_self``), NOT via anything
+        request-controlled: a client-supplied header must never be
+        able to open the mint gate on a non-owner."""
+        ts = self.executor.translate_store
+        if ts is None:
+            raise APIError("translate store not configured")
+        keys = [str(k) for k in keys]
+        check = getattr(ts, "misowned", None)
+        if check is not None:
+            owner = check(index, field, keys)
+        elif self.server is not None:
+            owner = self.server.translate_primary()
+        else:
+            owner = ""
+        if owner:
+            raise APIError(
+                f"not the owner of these keys (owner={owner}); minting "
+                "here would fork the cluster id space — post to the "
+                "owner or fix translate-primary-url",
+                status=409,
+            )
+        return ts.mint(index, field, keys)
 
 
 def _parse_timestamps(timestamps):

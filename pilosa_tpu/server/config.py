@@ -361,9 +361,18 @@ class Config:
     # opt-in diagnostics phone-home endpoint (reference diagnostics.go);
     # empty = disabled
     diagnostics_host: str = ""
-    # translate-store primary to replicate the key WAL from (reference
-    # TranslateFile primary/replica streaming, translate.go:259-310)
+    # translate-store primary (reference TranslateFile primary/replica
+    # streaming, translate.go:259-310). LEGACY override: when set, that
+    # one node owns every key space. Unset (the default), ownership is
+    # partitioned — each column-key partition / row space is owned by
+    # the jump-hash-selected cluster node (pilosa_tpu/translate/).
     translate_primary_url: str = ""
+    # key translation (ISSUE 20, pilosa_tpu/translate/): column-key
+    # partition count per index (fixed for the life of the data dir —
+    # ids encode their partition) and the byte budget of the hot
+    # id→key reverse-translation LRU
+    translate_partitions: int = 16
+    translate_cache_bytes: int = 1 << 20
 
     @property
     def host(self) -> str:

@@ -199,6 +199,7 @@ class Handler:
             Route("POST", r"/internal/fleet/register", self.post_fleet_register),
             Route("GET", r"/internal/fleet/snapshots", self.get_fleet_snapshots),
             Route("GET", r"/internal/translate/data", self.get_translate_data),
+            Route("GET", r"/internal/translate/stores", self.get_translate_stores),
             Route("POST", r"/internal/translate/keys", self.post_translate_keys),
             Route(
                 "POST",
@@ -241,6 +242,7 @@ class Handler:
             # multi-tenant QoS (ISSUE 19): per-tenant admission /
             # scheduling / HBM / SLO state in one snapshot
             Route("GET", r"/debug/tenancy", self.get_debug_tenancy),
+            Route("GET", r"/debug/translate", self.get_debug_translate),
             # index (with and without trailing slash, as net/http/pprof
             # serves it) plus the thread-dump profile; unknown names 404
             Route("GET", r"/debug/pprof/?", self.get_debug_pprof),
@@ -525,6 +527,23 @@ class Handler:
         rows = body.get("rowIDs", [])
         cols = body.get("columnIDs", [])
         sets = body.get("sets")
+        row_keys = body.get("rowKeys")
+        column_keys = body.get("columnKeys")
+        if row_keys or column_keys:
+            # keyed ingest: resolve the whole batch to ids BEFORE the
+            # queue sees it — write waves (and their routed local legs,
+            # which never carry keys) are id-only, and the translate
+            # assignments group-commit ahead of the wave's own fsync
+            t_rows, t_cols = self.api.translate_ingest_keys(
+                req.params["index"],
+                req.params["field"],
+                row_keys,
+                column_keys,
+            )
+            if t_rows is not None:
+                rows = t_rows
+            if t_cols is not None:
+                cols = t_cols
         dl = deadline_mod.from_request(req.headers, req.query, self.default_timeout)
         if body.get("local"):
             # owner-side leg of a routed wave: apply directly (the
@@ -560,6 +579,12 @@ class Handler:
             dl,
         )
         return {"acked": len(rows), "changed": changed}
+
+    def get_debug_translate(self, req) -> dict:
+        """Key-translation snapshot: per-store key counts and log
+        bytes, minted/adopted/forward counters, reverse-LRU hit
+        ratio."""
+        return self.api.translate_debug()
 
     def get_debug_ingest(self, req) -> dict:
         """Ingest write-ahead queue snapshot: depth/limit, wave and
@@ -745,12 +770,18 @@ class Handler:
 
     def get_translate_data(self, req):
         q = req.query
-        data = self.api.get_translate_data(int(q.get("offset", ["0"])[0]))
+        data = self.api.get_translate_data(
+            int(q.get("offset", ["0"])[0]), q.get("store", [""])[0]
+        )
         return RawResponse(data, "application/octet-stream")
 
+    def get_translate_stores(self, req) -> list:
+        """Durable translate stores + byte offsets (pull replication)."""
+        return self.api.translate_stores()
+
     def post_translate_keys(self, req) -> dict:
-        """Primary-side key minting for follower forwards: one id space
-        per cluster (reference TranslateFile primary semantics)."""
+        """Owner-side key minting for federated forwards: one id space
+        per key partition across the cluster (pilosa_tpu/translate/)."""
         body = json.loads(req.body or b"{}")
         _require(body, "index")
         ids = self.api.translate_keys(

@@ -41,7 +41,7 @@ from pilosa_tpu.utils.stats import (
     NOP_STATS,
     StatsDClient,
 )
-from pilosa_tpu.utils.translate import TranslateStore
+from pilosa_tpu.translate import Translator
 
 
 def _host_resolves_to_local(host: str, bind_host: str) -> bool:
@@ -156,7 +156,15 @@ class Server:
             new_attr_store=new_attr_store,
             broadcaster=self._broadcast_create_shard,
         )
-        self.translate_store = TranslateStore(os.path.join(data_dir, ".keys"))
+        # key translation (ISSUE 20, pilosa_tpu/translate/): partitioned
+        # durable key↔id stores under <data>/translate; ownership,
+        # forwarding and replication are wired in open() once the
+        # listener (and so this node's own URI) is known
+        self.translate_store = Translator(
+            os.path.join(data_dir, "translate"),
+            partitions=self.config.translate_partitions,
+            cache_bytes=self.config.translate_cache_bytes,
+        )
         self.cluster = cluster
         # multihost serving (parallel/multihost.py): bootstrap the
         # jax.distributed runtime BEFORE the mesh is built, so
@@ -426,6 +434,9 @@ class Server:
         # memoized translate-primary resolution (see translate_primary)
         # (value, monotonic-expiry-or-None); see translate_primary
         self._translate_primary_cache: Optional[tuple] = None
+        # memoized key-space ownership: (index, field, partition) ->
+        # (owner uri or "", monotonic expiry); see _translate_owner
+        self._translate_owner_cache: dict = {}
 
     def _build_mesh(self):
         """Resolve config.mesh_devices into a jax Mesh over the shard
@@ -568,10 +579,10 @@ class Server:
                 os.path.expanduser(self.config.tls.certificate_key_path),
             )
             self.httpd.socket = ctx.wrap_socket(self.httpd.socket, server_side=True)
-        # wire key-translation forwarding BEFORE serving: a keyed write
-        # arriving in the startup window would otherwise mint locally
-        # and permanently diverge the cluster id space
-        self._wire_translate_primary()
+        # wire key-translation ownership + forwarding BEFORE serving: a
+        # keyed write arriving in the startup window would otherwise
+        # mint locally and permanently diverge the cluster id space
+        self._wire_translate_plane()
         self._serve_thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True
         )
@@ -797,18 +808,71 @@ class Server:
             return "" if self._is_self(p) else p
         return ""
 
-    def _wire_translate_primary(self) -> None:
-        primary = self.translate_primary()
-        if not primary:
-            return
+    def _translate_owner(self, index: str, field: str, partition: int) -> str:
+        """Owning node's URI for one key space ("" = this node owns it).
+        Explicit ``translate-primary-url`` is the legacy override — one
+        node owns everything; otherwise each column partition / row
+        space lands on a cluster node by jump hash over the sorted
+        member list, so every node computes the same owner with no
+        coordinator. Memoized with a TTL: resolution consults DNS
+        (``_is_self``), which must stay off the keyed-write hot path,
+        but membership can change, so a cached answer may not outlive
+        the TTL."""
+        key = (index, field, partition)
+        cached = self._translate_owner_cache.get(key)
+        if cached is not None and time.monotonic() < cached[1]:
+            return cached[0]
+        explicit = self.config.translate_primary_url
+        if explicit:
+            p = self._normalize_host_uri(explicit)
+            out = "" if self._is_self(p) else p
+        else:
+            cl = self.cluster
+            if cl is None or len(cl.nodes) <= 1:
+                out = ""
+            else:
+                from pilosa_tpu.parallel.hashing import fnv64a, jump_hash
+
+                nodes = cl.nodes  # kept sorted by node id
+                i = jump_hash(
+                    fnv64a(f"{index}/{field}/{partition}".encode()), len(nodes)
+                )
+                uri = self._normalize_host_uri(nodes[i].uri)
+                out = "" if self._is_self(uri) else uri
+        if self.httpd is not None:  # port known → answer is cacheable
+            self._translate_owner_cache[key] = (out, time.monotonic() + 60.0)
+        return out
+
+    def _wire_translate_plane(self) -> None:
+        """Wire the translate subsystem's server seams: ownership
+        (jump-hash partitioned, or the legacy single primary), minting
+        forwards over InternalClient, and assignment push replication
+        over the existing gang-descriptor + cluster message planes."""
+        ts = self.translate_store
         from pilosa_tpu.parallel.client import InternalClient
 
         client = InternalClient(ssl_context=self.client_ssl_context())
+        ts.owner_resolver = self._translate_owner
 
-        def forward(index, field, keys):
-            return client.translate_keys(primary, index, field, keys)
+        def forward_to(uri, index, field, keys):
+            return client.translate_keys(uri, index, field, keys)
 
-        self.translate_store.forward = forward
+        def on_assign(index, field, keys, ids):
+            # locally-minted assignments ride the same broadcast plane
+            # as schema ops (gang descriptors + cluster messages); the
+            # per-store pull loop below is the catch-up backstop
+            self.send_async(
+                {
+                    "type": "translate-keys",
+                    "index": index,
+                    "field": field,
+                    "keys": list(keys),
+                    "ids": [int(i) for i in ids],
+                }
+            )
+
+        ts.forward_to = forward_to
+        ts.on_assign = on_assign
 
     def _set_file_limit(self) -> None:
         """Raise RLIMIT_NOFILE toward the reference's 262,144 target
@@ -912,20 +976,48 @@ class Server:
                 self.diagnostics.flush()
 
         def translate_replication_loop():
-            primary = self.translate_primary()
-            if not primary:
-                return
+            # pull catch-up for key assignments: every peer's stores
+            # are polled from a per-(peer, store) byte offset and raw
+            # CRC frames are applied locally (by-key idempotent). This
+            # is the backstop under the broadcast push (translate-keys
+            # messages) — a node that missed a broadcast converges
+            # here. Offsets are in-memory only: logs are append-only,
+            # so a restart just re-pulls from 0 and applies no-ops.
             from pilosa_tpu.parallel.client import ClientError, InternalClient
 
             client = InternalClient(ssl_context=self.client_ssl_context())
+            ts = self.translate_store
+            offsets: dict = {}
+            self_uris: dict = {}
             while not self._closed.wait(1.0):
-                try:
-                    ts = self.translate_store
-                    data = client.translate_data(primary, ts.replica_offset)
-                    if data:
-                        ts.replica_offset += ts.apply_log(data)
-                except ClientError:
-                    pass
+                uris = []
+                if self.cluster is not None and len(self.cluster.nodes) > 1:
+                    for n in self.cluster.nodes:
+                        u = n.uri
+                        if u not in self_uris:
+                            self_uris[u] = self._is_self(
+                                self._normalize_host_uri(u)
+                            )
+                        if not self_uris[u]:
+                            uris.append(u)
+                else:
+                    legacy = self.translate_primary()
+                    if legacy:
+                        uris.append(legacy)
+                for uri in uris:
+                    try:
+                        for entry in client.translate_stores(uri):
+                            name = entry.get("name", "")
+                            off = offsets.get((uri, name), 0)
+                            if int(entry.get("offset", 0)) <= off:
+                                continue
+                            data = client.translate_data(uri, off, store=name)
+                            if data:
+                                offsets[(uri, name)] = off + ts.apply_frames(
+                                    data
+                                )
+                    except (ClientError, ValueError):
+                        pass
 
         def liveness_loop():
             # reference memberlist probing (gossip/gossip.go:431-494):
@@ -1232,6 +1324,18 @@ class Server:
                             frag.cache.recalculate()
         elif typ == "schema":
             self.holder.apply_schema(msg.get("schema", []))
+        elif typ == "translate-keys":
+            # push replication of key→id assignments minted elsewhere:
+            # adopt durably (by-key idempotent; never re-broadcast)
+            try:
+                self.translate_store.adopt(
+                    msg["index"],
+                    msg.get("field", ""),
+                    msg.get("keys", []),
+                    msg.get("ids", []),
+                )
+            except (ValueError, KeyError, IndexError) as e:
+                self.logger.printf("translate-keys apply error: %s", e)
         elif typ == "leader-uri":
             # gang replay of the leader's boot-time handshake: followers
             # adopt the push target and register with the leader's fleet

@@ -173,6 +173,15 @@ INGEST_SHEDS = "ingest.sheds"
 INGEST_RECOVERY_REPLAYS = "ingest.recovery_replays"
 INGEST_RECOVERY_TRUNCATED_BYTES = "ingest.recovery_truncated_bytes"
 INGEST_FAULTS_INJECTED = "ingest.faults_injected"
+# key translation (ISSUE 20, pilosa_tpu/translate/): durable sharded
+# key↔id stores, federated assignment, hot reverse-translation LRU
+TRANSLATE_CACHE_HITS = "translate.cache_hits"
+TRANSLATE_CACHE_MISSES = "translate.cache_misses"
+TRANSLATE_MINTED = "translate.minted"
+TRANSLATE_ADOPTED = "translate.adopted"
+TRANSLATE_FORWARDS = "translate.forwards"
+TRANSLATE_STORE_BYTES = "translate.store_bytes"
+TRANSLATE_RECOVERY_TRUNCATED_BYTES = "translate.recovery_truncated_bytes"
 # end-to-end data integrity (ISSUE 15): background scrubber findings,
 # quarantine/repair lifecycle, holder backup/restore
 SCRUB_SWEEPS = "scrub.sweeps"
@@ -595,6 +604,38 @@ METRICS: dict[str, tuple[str, str]] = {
         "storage faults injected by the storage-faults schedule "
         "(label: fault = fsync_fail | torn_write | enospc | "
         "corrupt_write | bitrot)",
+    ),
+    TRANSLATE_CACHE_HITS: (
+        "counter",
+        "ids→keys reverse translations served from the bounded hot-"
+        "translation LRU (no log pread)",
+    ),
+    TRANSLATE_CACHE_MISSES: (
+        "counter",
+        "ids→keys reverse translations that missed the LRU and pread "
+        "the key bytes back from a translate log",
+    ),
+    TRANSLATE_MINTED: (
+        "counter",
+        "key→id assignments minted locally (this node owns the key's "
+        "partition and is its sole id allocator)",
+    ),
+    TRANSLATE_ADOPTED: (
+        "counter",
+        "key→id assignments adopted durably from another node (owner "
+        "forward replies and replicated frames)",
+    ),
+    TRANSLATE_FORWARDS: (
+        "counter",
+        "key batches forwarded to a partition's owning node for minting",
+    ),
+    TRANSLATE_STORE_BYTES: (
+        "gauge",
+        "bytes across this node's translate logs (all key spaces)",
+    ),
+    TRANSLATE_RECOVERY_TRUNCATED_BYTES: (
+        "counter",
+        "bytes of torn/corrupt translate-log tail truncated at open",
     ),
     SCRUB_SWEEPS: (
         "counter",

@@ -886,13 +886,26 @@ class TestTranslateReplication:
             try:
                 import time as _t
 
+                # the ids are whatever the primary minted (partitioned
+                # assignment interleaves residue classes) — the replica
+                # must converge on the SAME ids via the pull loop
+                cid = s0.translate_store.translate_columns_to_ids(
+                    "u", ["alice"], create=False
+                )[0]
+                rid = s0.translate_store.translate_rows_to_ids(
+                    "u", "l", ["pizza"], create=False
+                )[0]
+                assert cid and rid
                 deadline = _t.monotonic() + 15
                 while _t.monotonic() < deadline:
-                    if s1.translate_store.translate_column_to_string("u", 1) == "alice":
+                    if (
+                        s1.translate_store.translate_column_to_string("u", cid)
+                        == "alice"
+                    ):
                         break
                     _t.sleep(0.2)
-                assert s1.translate_store.translate_column_to_string("u", 1) == "alice"
-                assert s1.translate_store.translate_row_to_string("u", "l", 1) == "pizza"
+                assert s1.translate_store.translate_column_to_string("u", cid) == "alice"
+                assert s1.translate_store.translate_row_to_string("u", "l", rid) == "pizza"
             finally:
                 s1.close()
         finally:
@@ -1376,25 +1389,44 @@ class TestStatusAuthority:
             for s in servers:
                 s.close()
 
-    def test_mint_on_non_primary_is_409(self, tmp_path):
+    def test_mint_on_non_owner_is_409(self, tmp_path):
         servers = boot_static_cluster(tmp_path, n=2)
         try:
             s0, s1 = servers
             req(s0.uri, "POST", "/index/i", {"options": {"keys": True}})
-            # minting on the primary (node 0) works
+            # ownership is partitioned (jump hash): find a key each
+            # node owns, and one it does not
+            def owner_of(key):
+                return [
+                    s
+                    for s in servers
+                    if not s.translate_store.misowned("i", "", [key])
+                ]
+
+            key = next(f"k{i}" for i in range(64) if owner_of(f"k{i}"))
+            owners = owner_of(key)
+            assert len(owners) == 1, "exactly one node owns each key"
+            owner = owners[0]
+            other = s1 if owner is s0 else s0
+            # minting on the owner works, and re-minting is idempotent
             st, body = req(
-                s0.uri, "POST", "/internal/translate/keys",
-                {"index": "i", "keys": ["a", "b"]},
+                owner.uri, "POST", "/internal/translate/keys",
+                {"index": "i", "keys": [key]},
             )
-            assert st == 200 and body["ids"] == [1, 2], body
-            # posting the same internal mint to a NON-primary must be
+            assert st == 200 and len(body["ids"]) == 1 and body["ids"][0] >= 1
+            st2, body2 = req(
+                owner.uri, "POST", "/internal/translate/keys",
+                {"index": "i", "keys": [key]},
+            )
+            assert st2 == 200 and body2["ids"] == body["ids"]
+            # posting the same internal mint to a NON-owner must be
             # rejected, not silently minted into a forked id space
             st, body = req(
-                s1.uri, "POST", "/internal/translate/keys",
-                {"index": "i", "keys": ["c"]},
+                other.uri, "POST", "/internal/translate/keys",
+                {"index": "i", "keys": [key]},
             )
             assert st == 409, body
-            assert "primary" in body.get("error", str(body))
+            assert "owner" in body.get("error", str(body))
             # and a missing body field is a 400, not a 500
             st, body = req(s0.uri, "POST", "/internal/translate/keys", {})
             assert st == 400, body
